@@ -1,0 +1,297 @@
+"""Dataset: binned feature matrix + metadata, host & device views.
+
+Equivalent surface to the reference Dataset/DatasetLoader/Metadata
+(reference: include/LightGBM/dataset.h:41-641, src/io/dataset_loader.cpp).
+TPU-first storage decision: instead of per-group Bin objects (dense/sparse/
+4-bit variants, src/io/*_bin.hpp), the binned matrix is ONE dense (N, F)
+uint8/uint16 device array — XLA-friendly static shape, rows gatherable for
+leaf-wise histogram work. Sparse inputs are densified through binning (bins
+are small ints; the zero bin is the default bin, so sparsity costs only
+storage, which EFB-style bundling can reclaim later).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
+                      MISSING_NONE, MISSING_ZERO, BinMapper)
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores
+    (reference: dataset.h:41-250, src/io/metadata.cpp)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label) -> None:
+        label = np.asarray(label, dtype=np.float64).reshape(-1)
+        log.check(len(label) == self.num_data, "label length mismatch")
+        self.label = label
+
+    def set_weight(self, weight) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float64).reshape(-1)
+        log.check(len(weight) == self.num_data, "weight length mismatch")
+        self.weight = weight
+
+    def set_group(self, group) -> None:
+        """group = per-query row counts -> cumulative boundaries."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        log.check(int(group.sum()) == self.num_data,
+                  "sum of group counts != num_data")
+        self.query_boundaries = np.concatenate(
+            [[0], np.cumsum(group)]).astype(np.int32)
+
+    def set_init_score(self, init_score) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64)
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class Dataset:
+    """Binned training data.
+
+    Core construction flow mirrors DatasetLoader::LoadFromFile/
+    ConstructFromSampleData (reference: dataset_loader.cpp:168-722): sample
+    rows -> per-feature BinMapper::FindBin -> bin every value.
+    """
+
+    def __init__(self, data: np.ndarray, config: Optional[Config] = None,
+                 label=None, weight=None, group=None, init_score=None,
+                 feature_names: Optional[List[str]] = None,
+                 categorical_feature: Optional[Sequence] = None,
+                 reference: Optional["Dataset"] = None,
+                 params: Optional[Dict[str, Any]] = None):
+        self.config = config or Config(params or {})
+        data = self._to_numpy(data)
+        self.num_data, self.num_total_features = data.shape
+        self.metadata = Metadata(self.num_data)
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weight(weight)
+        self.metadata.set_group(group)
+        self.metadata.set_init_score(init_score)
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}" for i in range(self.num_total_features)])
+        self.reference = reference
+
+        if reference is not None:
+            self.bin_mappers = reference.bin_mappers
+            self.used_features = reference.used_features
+            self.max_num_bins = reference.max_num_bins
+            self.feature_names = reference.feature_names
+        else:
+            cat_idx = self._resolve_categorical(categorical_feature)
+            self.bin_mappers = self._build_mappers(data, cat_idx)
+            self.used_features = [i for i, m in enumerate(self.bin_mappers)
+                                  if not m.is_trivial]
+            if not self.used_features:
+                log.warning("All features are trivial (constant); nothing to train on")
+            self.max_num_bins = max(
+                [self.bin_mappers[i].num_bin for i in self.used_features], default=1)
+
+        self.binned = self._bin_data(data)
+        # raw column stats used for leaf renewal on some objectives
+        self._device_cache: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _to_numpy(data) -> np.ndarray:
+        try:
+            import scipy.sparse as sp
+            if sp.issparse(data):
+                return np.asarray(data.todense(), dtype=np.float64)
+        except ImportError:
+            pass
+        if hasattr(data, "values"):  # pandas
+            data = data.values
+        arr = np.asarray(data, dtype=np.float64)
+        if arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        return arr
+
+    def _resolve_categorical(self, categorical_feature) -> set:
+        cats = set()
+        for c in (categorical_feature or self.config.categorical_feature or []):
+            if isinstance(c, str):
+                if c.startswith("name:"):
+                    c = c[5:]
+                if c in self.feature_names:
+                    cats.add(self.feature_names.index(c))
+            else:
+                cats.add(int(c))
+        return cats
+
+    def _build_mappers(self, data: np.ndarray, cat_idx: set) -> List[BinMapper]:
+        cfg = self.config
+        n = self.num_data
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        rng = np.random.RandomState(cfg.data_random_seed)
+        if sample_cnt < n:
+            sample_rows = np.sort(rng.choice(n, sample_cnt, replace=False))
+        else:
+            sample_rows = np.arange(n)
+        max_bin_by_feature = cfg.max_bin_by_feature
+        ignore = set()
+        for c in cfg.ignore_column or []:
+            if isinstance(c, str) and c.startswith("name:"):
+                name = c[5:]
+                if name in self.feature_names:
+                    ignore.add(self.feature_names.index(name))
+            else:
+                try:
+                    ignore.add(int(c))
+                except (TypeError, ValueError):
+                    pass
+        mappers = []
+        for f in range(self.num_total_features):
+            m = BinMapper()
+            if f in ignore:
+                m.is_trivial = True
+                m.num_bin = 1
+                mappers.append(m)
+                continue
+            col = data[sample_rows, f]
+            # the sampling contract: pass non-zero values, zeros implied
+            nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
+            max_bin = (max_bin_by_feature[f]
+                       if max_bin_by_feature and f < len(max_bin_by_feature)
+                       else cfg.max_bin)
+            m.find_bin(
+                nonzero, total_sample_cnt=len(sample_rows), max_bin=max_bin,
+                min_data_in_bin=cfg.min_data_in_bin,
+                min_split_data=cfg.min_data_in_leaf,
+                bin_type=BIN_CATEGORICAL if f in cat_idx else BIN_NUMERICAL,
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing)
+            mappers.append(m)
+        return mappers
+
+    def _bin_data(self, data: np.ndarray) -> np.ndarray:
+        n_used = len(self.used_features)
+        dtype = np.uint8 if self.max_num_bins <= 256 else np.uint16
+        out = np.zeros((self.num_data, max(n_used, 1)), dtype=dtype)
+        for j, f in enumerate(self.used_features):
+            out[:, j] = self.bin_mappers[f].values_to_bins(data[:, f]).astype(dtype)
+        return out
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        return len(self.used_features)
+
+    @property
+    def label(self):
+        return self.metadata.label
+
+    def feature_meta_arrays(self):
+        """(num_bins, missing_type, default_bin, is_categorical, monotone)
+        int32 arrays over *inner* (used) features, for the device ops."""
+        import jax.numpy as jnp
+        if "meta" not in self._device_cache:
+            nb = np.array([self.bin_mappers[f].num_bin for f in self.used_features],
+                          dtype=np.int32)
+            mt = np.array([self.bin_mappers[f].missing_type for f in self.used_features],
+                          dtype=np.int32)
+            db = np.array([self.bin_mappers[f].default_bin for f in self.used_features],
+                          dtype=np.int32)
+            cat = np.array([self.bin_mappers[f].bin_type == BIN_CATEGORICAL
+                            for f in self.used_features], dtype=np.int32)
+            mono_all = self.config.monotone_constraints or []
+            mono = np.array([mono_all[f] if f < len(mono_all) else 0
+                             for f in self.used_features], dtype=np.int32)
+            self._device_cache["meta"] = tuple(
+                jnp.asarray(a) for a in (nb, mt, db, cat, mono))
+        return self._device_cache["meta"]
+
+    def device_binned(self):
+        import jax.numpy as jnp
+        if "binned" not in self._device_cache:
+            self._device_cache["binned"] = jnp.asarray(self.binned)
+        return self._device_cache["binned"]
+
+    def inner_to_real(self, inner: int) -> int:
+        return self.used_features[inner]
+
+    def real_threshold(self, inner_feature: int, bin_thr: int) -> float:
+        """Bin threshold -> stored real threshold (reference
+        Dataset::RealThreshold -> BinMapper::BinToValue)."""
+        return self.bin_mappers[self.used_features[inner_feature]].bin_to_value(bin_thr)
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None) -> "Dataset":
+        """Validation set binned with this dataset's mappers
+        (reference: Dataset::CreateValid / CheckAlign)."""
+        return Dataset(data, config=self.config, label=label, weight=weight,
+                       group=group, init_score=init_score, reference=self)
+
+    def feature_infos(self) -> List[str]:
+        return [m.feature_info() for m in self.bin_mappers]
+
+    # ------------------------------------------------------------------
+    def save_binary(self, path: str) -> None:
+        """Binary cache (reference: Dataset::SaveBinaryFile; ours is npz)."""
+        import json
+        mappers = json.dumps([m.to_dict() for m in self.bin_mappers])
+        np.savez_compressed(
+            path, binned=self.binned, mappers=mappers,
+            used_features=np.asarray(self.used_features, dtype=np.int64),
+            feature_names=np.asarray(self.feature_names, dtype=object),
+            label=(self.metadata.label if self.metadata.label is not None
+                   else np.zeros(0)),
+            weight=(self.metadata.weight if self.metadata.weight is not None
+                    else np.zeros(0)),
+            query_boundaries=(self.metadata.query_boundaries
+                              if self.metadata.query_boundaries is not None
+                              else np.zeros(0, dtype=np.int32)),
+            init_score=(self.metadata.init_score
+                        if self.metadata.init_score is not None
+                        else np.zeros(0)),
+        )
+
+    @classmethod
+    def load_binary(cls, path: str, params: Optional[dict] = None) -> "Dataset":
+        import json
+        z = np.load(path, allow_pickle=True)
+        obj = cls.__new__(cls)
+        obj.config = Config(params or {})
+        obj.binned = z["binned"]
+        obj.num_data = obj.binned.shape[0]
+        obj.bin_mappers = [BinMapper.from_dict(d) for d in json.loads(str(z["mappers"]))]
+        obj.num_total_features = len(obj.bin_mappers)
+        obj.used_features = [int(i) for i in z["used_features"]]
+        obj.feature_names = [str(s) for s in z["feature_names"]]
+        obj.max_num_bins = max(
+            [obj.bin_mappers[i].num_bin for i in obj.used_features], default=1)
+        obj.metadata = Metadata(obj.num_data)
+        if len(z["label"]):
+            obj.metadata.label = z["label"]
+        if len(z["weight"]):
+            obj.metadata.weight = z["weight"]
+        if len(z["query_boundaries"]):
+            obj.metadata.query_boundaries = z["query_boundaries"]
+        if len(z["init_score"]):
+            obj.metadata.init_score = z["init_score"]
+        obj.reference = None
+        obj._device_cache = {}
+        return obj

@@ -1,0 +1,4 @@
+"""Evaluation metrics (reference factory: src/metric/metric.cpp:16-61)."""
+from .metric import METRIC_NAMES, Metric, create_metric, create_metrics
+
+__all__ = ["Metric", "create_metric", "create_metrics", "METRIC_NAMES"]

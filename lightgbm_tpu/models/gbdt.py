@@ -1,0 +1,742 @@
+"""GBDT boosting orchestrator + DART / GOSS / RF variants.
+
+Equivalent of the reference boosting layer (reference: src/boosting/gbdt.cpp,
+dart.hpp, goss.hpp, rf.hpp, gbdt_model_text.cpp). The per-iteration flow
+mirrors GBDT::TrainOneIter (gbdt.cpp:368-451): boost-from-average on the
+first iteration, objective gradients, bagging, one tree per class, leaf
+renewal, shrinkage, score update, metric eval.
+
+TPU mapping: scores and gradients live on device as (K, N) f32; gradient
+computation is one fused jitted op; score updates run the vectorized binned
+traversal (ops/predict.py); only the tiny tree structures and split decisions
+ride on host.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..io.dataset import Dataset
+from ..metrics import create_metrics
+from ..objectives import create_objective
+from ..objectives.objective import MAPE
+from ..ops import predict as predict_ops
+from ..utils import log
+from .serial_learner import SerialTreeLearner
+from .tree import Tree
+
+K_EPSILON = 1e-15
+MODEL_VERSION = "v3"
+
+
+class ScoreUpdater:
+    """Per-dataset raw scores (reference: src/boosting/score_updater.hpp)."""
+
+    def __init__(self, dataset: Dataset, num_class: int):
+        self.dataset = dataset
+        n = dataset.num_data
+        init = np.zeros((num_class, n), dtype=np.float32)
+        self.has_init_score = dataset.metadata.init_score is not None
+        if self.has_init_score:
+            s = np.asarray(dataset.metadata.init_score, dtype=np.float32)
+            if s.size == n * num_class:
+                init = s.reshape(num_class, n)
+            else:
+                init = np.tile(s.reshape(1, n), (num_class, 1))
+        self.score = jnp.asarray(init)
+        (self.f_numbins, self.f_missing, self.f_default,
+         _, _) = dataset.feature_meta_arrays()
+
+    def add_constant(self, val: float, class_id: int) -> None:
+        self.score = self.score.at[class_id].add(jnp.float32(val))
+
+    def add_tree(self, tree: Tree, class_id: int) -> None:
+        vals = predict_ops.predict_binned_tree_values(
+            self.dataset.device_binned(), self.f_missing, self.f_default,
+            self.f_numbins, tree)
+        self.score = self.score.at[class_id].add(vals)
+
+    def multiply(self, factor: float, class_id: int) -> None:
+        self.score = self.score.at[class_id].multiply(jnp.float32(factor))
+
+    def host_scores(self) -> np.ndarray:
+        return np.asarray(jax.device_get(self.score), dtype=np.float64)
+
+
+class GBDT:
+    """The boosting engine (reference: src/boosting/gbdt.cpp GBDT)."""
+
+    average_output = False
+
+    def __init__(self, config: Config, train_set: Optional[Dataset],
+                 objective=None):
+        self.config = config
+        self.train_set = train_set
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.num_init_iteration = 0
+        self.shrinkage_rate = config.learning_rate
+        self.objective = objective
+        self.valid_sets: List[Dataset] = []
+        self.valid_names: List[str] = []
+        self.valid_updaters: List[ScoreUpdater] = []
+        self.valid_metrics: List[List] = []
+        self.train_metrics: List = []
+        self.best_iteration = 0
+        self.label_idx = 0
+        self.loaded_parameter = ""
+
+        if train_set is not None:
+            self._init_train(train_set)
+
+    def _init_train(self, train_set: Dataset) -> None:
+        cfg = self.config
+        if self.objective is None and cfg.objective != "none":
+            self.objective = create_objective(cfg.objective, cfg)
+        if self.objective is not None:
+            self.objective.init(train_set.metadata, train_set.num_data)
+            self.num_class = self.objective.num_model_per_iteration
+        else:
+            self.num_class = max(1, cfg.num_class)
+        self.num_tree_per_iteration = self.num_class
+        self.learner = SerialTreeLearner(cfg, train_set)
+        self.score_updater = ScoreUpdater(train_set, self.num_class)
+        self.num_data = train_set.num_data
+        self.train_metrics = create_metrics(cfg.metric, cfg, cfg.objective)
+        for m in self.train_metrics:
+            m.init(train_set.metadata, train_set.num_data)
+        self._bag_rng = np.random.RandomState(cfg.bagging_seed % (2**31 - 1))
+        self._bag_indices: Optional[np.ndarray] = None
+        self._class_need_train = [
+            self.objective.class_need_train(k) if self.objective else True
+            for k in range(self.num_class)]
+        self.feature_names = train_set.feature_names
+        self.max_feature_idx = train_set.num_total_features - 1
+
+    # ------------------------------------------------------------------
+    def add_valid(self, valid_set: Dataset, name: str) -> None:
+        self.valid_sets.append(valid_set)
+        self.valid_names.append(name)
+        self.valid_updaters.append(ScoreUpdater(valid_set, self.num_class))
+        metrics = create_metrics(self.config.metric, self.config,
+                                 self.config.objective)
+        for m in metrics:
+            m.init(valid_set.metadata, valid_set.num_data)
+        self.valid_metrics.append(metrics)
+
+    # ------------------------------------------------------------------
+    def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
+        cfg = self.config
+        if (self.models or self.score_updater.has_init_score
+                or self.objective is None):
+            return 0.0
+        if not (cfg.boost_from_average or self.train_set.num_features == 0):
+            if self.objective.name in ("regression_l1", "quantile", "mape"):
+                log.warning("Disabling boost_from_average in %s may cause the "
+                            "slow convergence", self.objective.name)
+            return 0.0
+        init_score = self.objective.boost_from_score(class_id)
+        if abs(init_score) > K_EPSILON:
+            if update_scorer:
+                self.score_updater.add_constant(init_score, class_id)
+                for vu in self.valid_updaters:
+                    vu.add_constant(init_score, class_id)
+            log.info("Start training from score %f", init_score)
+            return init_score
+        return 0.0
+
+    def _compute_gradients(self):
+        """objective->GetGradients over the whole score tensor."""
+        score = self.score_updater.score
+        if self.num_class == 1:
+            g, h = self.objective.get_gradients(score[0])
+            return g[None, :], h[None, :]
+        return self.objective.get_gradients(score)
+
+    def _bagging(self, iteration: int):
+        """Row sampling per iteration (reference gbdt.cpp:210-276)."""
+        cfg = self.config
+        n = self.num_data
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0) \
+                    and cfg.bagging_freq > 0:
+                pass  # balanced bagging handled below
+            else:
+                return None
+        if iteration % max(cfg.bagging_freq, 1) != 0 and self._bag_indices is not None:
+            return self._bag_indices
+        if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0) \
+                and self.objective is not None and self.objective.name == "binary":
+            pos = np.nonzero(self.train_set.label > 0)[0]
+            neg = np.nonzero(self.train_set.label <= 0)[0]
+            kp = max(1, int(len(pos) * cfg.pos_bagging_fraction))
+            kn = max(1, int(len(neg) * cfg.neg_bagging_fraction))
+            idx = np.concatenate([
+                self._bag_rng.choice(pos, kp, replace=False),
+                self._bag_rng.choice(neg, kn, replace=False)])
+        else:
+            k = max(1, int(n * cfg.bagging_fraction))
+            idx = self._bag_rng.choice(n, k, replace=False)
+        idx = np.sort(idx).astype(np.int32)
+        self._bag_indices = idx
+        return idx
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        """One boosting iteration; returns True when training should stop
+        (no tree with >1 leaf was produced)."""
+        init_scores = [0.0] * self.num_tree_per_iteration
+        if gradients is None or hessians is None:
+            for k in range(self.num_tree_per_iteration):
+                init_scores[k] = self._boost_from_average(k, True)
+            grad, hess = self._compute_gradients()
+        else:
+            grad = jnp.asarray(gradients, dtype=jnp.float32).reshape(
+                self.num_tree_per_iteration, self.num_data)
+            hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
+                self.num_tree_per_iteration, self.num_data)
+
+        bag_indices = self._bagging(self.iter)
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            new_tree = Tree(2)
+            if self._class_need_train[k] and self.train_set.num_features > 0:
+                new_tree = self.learner.train(
+                    grad[k], hess[k], bag_indices,
+                    iter_seed=self.iter * self.num_tree_per_iteration + k)
+            if new_tree.num_leaves > 1:
+                should_continue = True
+                if (self.objective is not None
+                        and self.objective.is_renew_tree_output):
+                    self._renew_tree_output(new_tree, k)
+                new_tree.apply_shrinkage(self.shrinkage_rate)
+                self._update_score(new_tree, k)
+                if abs(init_scores[k]) > K_EPSILON:
+                    new_tree.add_bias(init_scores[k])
+            else:
+                if len(self.models) < self.num_tree_per_iteration:
+                    if not self._class_need_train[k] and self.objective is not None:
+                        output = self.objective.boost_from_score(k)
+                    else:
+                        output = init_scores[k]
+                    new_tree.as_constant_tree(output)
+                    self.score_updater.add_constant(output, k)
+                    for vu in self.valid_updaters:
+                        vu.add_constant(output, k)
+            self.models.append(new_tree)
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+            return True
+        self.iter += 1
+        return False
+
+    def _update_score(self, tree: Tree, class_id: int) -> None:
+        self.score_updater.add_tree(tree, class_id)
+        for vu in self.valid_updaters:
+            vu.add_tree(tree, class_id)
+
+    def _renew_tree_output(self, tree: Tree, class_id: int) -> None:
+        """Leaf re-fit for L1-family objectives (reference:
+        serial_tree_learner.cpp:855-893 RenewTreeOutput)."""
+        scores = np.asarray(jax.device_get(
+            self.score_updater.score[class_id]), dtype=np.float64)
+        label = np.asarray(self.train_set.label, dtype=np.float64)
+        if isinstance(self.objective, MAPE):
+            weights = self.objective.leaf_renew_weight
+        else:
+            weights = self.train_set.metadata.weight
+        for leaf in range(tree.num_leaves):
+            rows = self.learner.leaf_rows(leaf)
+            if len(rows) == 0:
+                continue
+            residuals = label[rows] - scores[rows]
+            w = weights[rows] if weights is not None else None
+            tree.set_leaf_output(
+                leaf, self.objective.renew_leaf_output(residuals, w))
+
+    def rollback_one_iter(self) -> None:
+        if self.iter <= 0:
+            return
+        for k in range(self.num_tree_per_iteration):
+            tree = self.models[len(self.models) - self.num_tree_per_iteration + k]
+            tree.apply_shrinkage(-1.0)
+            self.score_updater.add_tree(tree, k)
+            for vu in self.valid_updaters:
+                vu.add_tree(tree, k)
+        del self.models[-self.num_tree_per_iteration:]
+        self.iter -= 1
+
+    # ------------------------------------------------------------------
+    def eval_metrics(self) -> Dict[str, List]:
+        """(dataset_name, metric_name, value, higher_better) tuples."""
+        out = []
+        if self.train_metrics:
+            scores = self.score_updater.host_scores()
+            s = scores[0] if self.num_class == 1 else scores
+            for m in self.train_metrics:
+                for name, val in zip(m.names, m.eval(s, self.objective)):
+                    out.append(("training", name, val, m.higher_better))
+        for vi, (vset, vname, vup) in enumerate(
+                zip(self.valid_sets, self.valid_names, self.valid_updaters)):
+            scores = vup.host_scores()
+            s = scores[0] if self.num_class == 1 else scores
+            for m in self.valid_metrics[vi]:
+                for name, val in zip(m.names, m.eval(s, self.objective)):
+                    out.append((vname, name, val, m.higher_better))
+        return out
+
+    # ------------------------------------------------------------------
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def predict_raw(self, x: np.ndarray, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0) -> np.ndarray:
+        """(N, K) raw scores over raw feature values."""
+        x = np.ascontiguousarray(np.asarray(x, dtype=np.float32))
+        if x.ndim == 1:
+            x = x.reshape(1, -1)
+        models = self._used_models(num_iteration, start_iteration)
+        if not models:
+            return np.zeros((x.shape[0], self.num_class))
+        arrays = predict_ops.trees_to_arrays(models)
+        tree_class = jnp.asarray(
+            np.arange(len(models), dtype=np.int32) % self.num_tree_per_iteration)
+        out = predict_ops.predict_raw_ensemble(
+            jnp.asarray(x), arrays, tree_class,
+            max_depth=arrays.max_depth, num_class=self.num_class)
+        out = np.asarray(jax.device_get(out), dtype=np.float64)
+        if self.average_output:
+            out /= max(1, len(models) // self.num_tree_per_iteration)
+        return out
+
+    def predict(self, x, num_iteration=None, raw_score=False,
+                pred_leaf=False, pred_contrib=False, start_iteration=0):
+        if pred_leaf:
+            models = self._used_models(num_iteration, start_iteration)
+            arrays = predict_ops.trees_to_arrays(models)
+            x = np.asarray(x, dtype=np.float32)
+            if x.ndim == 1:
+                x = x.reshape(1, -1)
+            leaves = predict_ops.predict_leaf_index_ensemble(
+                jnp.asarray(x), arrays, max_depth=arrays.max_depth)
+            return np.asarray(jax.device_get(leaves))
+        if pred_contrib:
+            return self.predict_contrib(x, num_iteration)
+        raw = self.predict_raw(x, num_iteration, start_iteration)
+        if raw_score:
+            return raw[:, 0] if self.num_class == 1 else raw
+        if self.objective is not None:
+            converted = self.objective.convert_output(jnp.asarray(raw.T))
+            out = np.asarray(jax.device_get(converted)).T
+        else:
+            out = raw
+        return out[:, 0] if self.num_class == 1 else out
+
+    def predict_contrib(self, x, num_iteration=None) -> np.ndarray:
+        """TreeSHAP feature contributions (reference: tree.cpp:669-713
+        PredictContrib). Host implementation — irregular recursion."""
+        from .treeshap import predict_contrib
+        return predict_contrib(self, x, num_iteration)
+
+    def _used_models(self, num_iteration, start_iteration=0) -> List[Tree]:
+        total_iter = len(self.models) // max(self.num_tree_per_iteration, 1)
+        start_iteration = max(0, min(start_iteration, total_iter))
+        start = start_iteration * self.num_tree_per_iteration
+        if num_iteration is not None and num_iteration > 0:
+            end = min((start_iteration + num_iteration)
+                      * self.num_tree_per_iteration, len(self.models))
+        else:
+            end = len(self.models)
+        return self.models[start:end]
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        n = self.max_feature_idx + 1
+        out = np.zeros(n, dtype=np.float64)
+        models = self._used_models(iteration)
+        for tree in models:
+            for node in range(tree.num_leaves - 1):
+                if importance_type == "split":
+                    out[tree.split_feature[node]] += 1.0
+                else:
+                    if tree.split_gain[node] > 0:
+                        out[tree.split_feature[node]] += tree.split_gain[node]
+        return out
+
+    # -- model serialization -------------------------------------------
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1) -> str:
+        """reference: gbdt_model_text.cpp:250 SaveModelToString."""
+        lines = ["tree", f"version={MODEL_VERSION}",
+                 f"num_class={self.num_class}",
+                 f"num_tree_per_iteration={self.num_tree_per_iteration}",
+                 f"label_index={self.label_idx}",
+                 f"max_feature_idx={self.max_feature_idx}"]
+        if self.objective is not None:
+            lines.append(f"objective={self.objective.to_string()}")
+        if self.average_output:
+            lines.append("average_output")
+        lines.append("feature_names=" + " ".join(self.feature_names))
+        if self.config.monotone_constraints:
+            lines.append("monotone_constraints=" + " ".join(
+                str(c) for c in self.config.monotone_constraints))
+        feature_infos = (self.train_set.feature_infos() if self.train_set
+                         else getattr(self, "_feature_infos", []))
+        lines.append("feature_infos=" + " ".join(feature_infos))
+
+        models = self._used_models(
+            num_iteration if num_iteration > 0 else None, start_iteration)
+        tree_strs = []
+        for i, tree in enumerate(models):
+            s = f"Tree={i}\n" + tree.to_string() + "\n"
+            tree_strs.append(s)
+        sizes = [len(s) for s in tree_strs]
+        lines.append("tree_sizes=" + " ".join(str(s) for s in sizes))
+        lines.append("")
+        body = "\n".join(lines) + "\n" + "".join(tree_strs)
+        body += "end of trees\n"
+        imp = self.feature_importance("split")
+        pairs = [(int(imp[i]), self.feature_names[i])
+                 for i in range(len(imp)) if imp[i] > 0]
+        pairs.sort(key=lambda p: -p[0])
+        body += "\nfeature importances:\n"
+        for v, name in pairs:
+            body += f"{name}={v}\n"
+        body += "\nparameters:\n" + self.config.to_string() + "\n"
+        body += "end of parameters\n"
+        return body
+
+    def save_model(self, filename: str, num_iteration: int = -1,
+                   start_iteration: int = 0) -> None:
+        with open(filename, "w") as f:
+            f.write(self.save_model_to_string(start_iteration, num_iteration))
+
+    @classmethod
+    def load_model_from_string(cls, text: str,
+                               config: Optional[Config] = None) -> "GBDT":
+        """reference: gbdt_model_text.cpp:365 LoadModelFromString."""
+        from ..objectives.objective import parse_objective_from_model
+        config = config or Config()
+        booster = cls(config, None)
+        header, _, rest = text.partition("Tree=0")
+        kv = {}
+        for line in header.splitlines():
+            if "=" in line:
+                k, _, v = line.partition("=")
+                kv[k.strip()] = v.strip()
+        booster.num_class = int(kv.get("num_class", 1))
+        booster.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
+        booster.label_idx = int(kv.get("label_index", 0))
+        booster.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        booster.feature_names = kv.get("feature_names", "").split()
+        booster._feature_infos = kv.get("feature_infos", "").split()
+        booster.average_output = "average_output" in header.split("\n")
+        if "objective" in kv:
+            config.num_class = booster.num_class
+            booster.objective = parse_objective_from_model(kv["objective"], config)
+        # parse trees
+        tree_blocks = ("Tree=0" + rest).split("end of trees")[0]
+        chunks = tree_blocks.split("Tree=")
+        for chunk in chunks:
+            chunk = chunk.strip()
+            if not chunk:
+                continue
+            body = chunk.split("\n", 1)[1] if "\n" in chunk else ""
+            booster.models.append(Tree.from_string(body))
+        booster.num_init_iteration = (len(booster.models)
+                                      // max(booster.num_tree_per_iteration, 1))
+        booster.iter = 0
+        return booster
+
+    @classmethod
+    def load_model(cls, filename: str,
+                   config: Optional[Config] = None) -> "GBDT":
+        with open(filename) as f:
+            return cls.load_model_from_string(f.read(), config)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> dict:
+        """reference: gbdt_model_text.cpp:28 DumpModel (JSON)."""
+        models = self._used_models(num_iteration, start_iteration)
+        return {
+            "name": "tree",
+            "version": MODEL_VERSION,
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": self.label_idx,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": (self.objective.to_string() if self.objective else ""),
+            "average_output": self.average_output,
+            "feature_names": list(self.feature_names),
+            "feature_importances": {
+                self.feature_names[i]: float(v)
+                for i, v in enumerate(self.feature_importance("split"))
+                if v > 0},
+            "tree_info": [
+                dict(tree_index=i, **t.to_json()) for i, t in enumerate(models)],
+        }
+
+
+class DART(GBDT):
+    """Dropout boosting (reference: src/boosting/dart.hpp)."""
+
+    def __init__(self, config, train_set, objective=None):
+        super().__init__(config, train_set, objective)
+        self._drop_rng = np.random.RandomState(
+            (config.drop_seed) % (2**31 - 1))
+        self._tree_weights: List[float] = []
+        self._sum_weight = 0.0
+        self.shrinkage_rate = config.learning_rate
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        drop_index = self._drop_trees()
+        stop = super().train_one_iter(gradients, hessians)
+        if not stop:
+            self._normalize(drop_index)
+        return stop
+
+    def _drop_trees(self) -> List[int]:
+        cfg = self.config
+        drop_index: List[int] = []
+        n_iter = self.iter
+        if self._drop_rng.rand() >= cfg.skip_drop and n_iter > 0:
+            drop_rate = cfg.drop_rate
+            if cfg.uniform_drop:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / max(n_iter, 1))
+                for i in range(n_iter):
+                    if self._drop_rng.rand() < drop_rate:
+                        drop_index.append(self.num_init_iteration + i)
+                        if cfg.max_drop > 0 and len(drop_index) >= cfg.max_drop:
+                            break
+            else:
+                inv_avg = len(self._tree_weights) / max(self._sum_weight, 1e-20)
+                if cfg.max_drop > 0:
+                    drop_rate = min(
+                        drop_rate, cfg.max_drop * inv_avg / max(self._sum_weight, 1e-20))
+                for i in range(n_iter):
+                    if self._drop_rng.rand() < drop_rate * self._tree_weights[i] * inv_avg:
+                        drop_index.append(self.num_init_iteration + i)
+                        if cfg.max_drop > 0 and len(drop_index) >= cfg.max_drop:
+                            break
+        # un-apply dropped trees from train scores
+        for i in drop_index:
+            for k in range(self.num_tree_per_iteration):
+                tree = self.models[i * self.num_tree_per_iteration + k]
+                tree.apply_shrinkage(-1.0)
+                self.score_updater.add_tree(tree, k)
+        k_drop = len(drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k_drop)
+        else:
+            self.shrinkage_rate = (cfg.learning_rate if k_drop == 0 else
+                                   cfg.learning_rate / (cfg.learning_rate + k_drop))
+        self._drop_index = drop_index
+        return drop_index
+
+    def _normalize(self, drop_index: List[int]) -> None:
+        cfg = self.config
+        k = float(len(drop_index))
+        for i in drop_index:
+            for c in range(self.num_tree_per_iteration):
+                tree = self.models[i * self.num_tree_per_iteration + c]
+                if not cfg.xgboost_dart_mode:
+                    tree.apply_shrinkage(1.0 / (k + 1.0))
+                    for vu in self.valid_updaters:
+                        vu.add_tree(tree, c)
+                    tree.apply_shrinkage(-k)
+                    self.score_updater.add_tree(tree, c)
+                    tree.apply_shrinkage(-1.0 / k if k else 1.0)
+                else:
+                    tree.apply_shrinkage(self.shrinkage_rate)
+                    for vu in self.valid_updaters:
+                        vu.add_tree(tree, c)
+                    tree.apply_shrinkage(-(1.0 + k) / k if k else 1.0)
+                    self.score_updater.add_tree(tree, c)
+                    tree.apply_shrinkage(-k / (1.0 + k))
+            if not cfg.uniform_drop and self._tree_weights:
+                ti = i - self.num_init_iteration
+                self._sum_weight -= self._tree_weights[ti] * (1.0 / (k + 1.0))
+                self._tree_weights[ti] *= k / (k + 1.0)
+        self._tree_weights.append(self.shrinkage_rate)
+        self._sum_weight += self.shrinkage_rate
+
+
+class GOSS(GBDT):
+    """Gradient-based one-side sampling (reference: src/boosting/goss.hpp)."""
+
+    def _goss_sample(self):
+        """Top |g*h| rows kept; others sampled with gradient amplification
+        (reference goss.hpp:91 BaggingHelper)."""
+        cfg = self.config
+        grad, hess = self._last_grad_hess
+        g = np.abs(np.asarray(jax.device_get(grad)) *
+                   np.asarray(jax.device_get(hess))).sum(axis=0)
+        n = self.num_data
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        order = np.argsort(-g, kind="stable")
+        top_idx = order[:top_k]
+        rest = order[top_k:]
+        sampled = self._bag_rng.choice(
+            len(rest), min(other_k, len(rest)), replace=False)
+        other_idx = rest[sampled]
+        multiply = (n - top_k) / max(other_k, 1)
+        self._goss_amplify = (other_idx, multiply)
+        idx = np.sort(np.concatenate([top_idx, other_idx])).astype(np.int32)
+        return idx
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        # compute gradients first so GOSS sampling can see them
+        init_scores = [0.0] * self.num_tree_per_iteration
+        if gradients is None or hessians is None:
+            for k in range(self.num_tree_per_iteration):
+                init_scores[k] = self._boost_from_average(k, True)
+            grad, hess = self._compute_gradients()
+        else:
+            grad = jnp.asarray(gradients, dtype=jnp.float32).reshape(
+                self.num_tree_per_iteration, self.num_data)
+            hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
+                self.num_tree_per_iteration, self.num_data)
+        self._last_grad_hess = (grad, hess)
+        bag_indices = self._goss_sample()
+        other_idx, multiply = self._goss_amplify
+        amp = jnp.ones(self.num_data, dtype=jnp.float32).at[
+            jnp.asarray(other_idx)].set(float(multiply))
+        grad = grad * amp[None, :]
+        hess = hess * amp[None, :]
+
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            new_tree = Tree(2)
+            if self._class_need_train[k] and self.train_set.num_features > 0:
+                new_tree = self.learner.train(
+                    grad[k], hess[k], bag_indices,
+                    iter_seed=self.iter * self.num_tree_per_iteration + k)
+            if new_tree.num_leaves > 1:
+                should_continue = True
+                if (self.objective is not None
+                        and self.objective.is_renew_tree_output):
+                    self._renew_tree_output(new_tree, k)
+                new_tree.apply_shrinkage(self.shrinkage_rate)
+                self._update_score(new_tree, k)
+                if abs(init_scores[k]) > K_EPSILON:
+                    new_tree.add_bias(init_scores[k])
+            else:
+                if len(self.models) < self.num_tree_per_iteration:
+                    output = init_scores[k]
+                    new_tree.as_constant_tree(output)
+                    self.score_updater.add_constant(output, k)
+                    for vu in self.valid_updaters:
+                        vu.add_constant(output, k)
+            self.models.append(new_tree)
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+            return True
+        self.iter += 1
+        return False
+
+
+class RF(GBDT):
+    """Random forest mode (reference: src/boosting/rf.hpp): bagging
+    mandatory, no shrinkage, fixed gradients from the init score, averaged
+    output."""
+
+    average_output = True
+
+    def __init__(self, config, train_set, objective=None):
+        super().__init__(config, train_set, objective)
+        self.shrinkage_rate = 1.0
+        # gradients computed once from constant init scores
+        init_scores = [self._boost_from_average(k, False)
+                       for k in range(self.num_tree_per_iteration)]
+        self._rf_init_scores = init_scores
+        tmp = jnp.asarray(
+            np.tile(np.asarray(init_scores, dtype=np.float32)[:, None],
+                    (1, self.num_data)))
+        if self.num_class == 1:
+            g, h = self.objective.get_gradients(tmp[0])
+            self._rf_grad, self._rf_hess = g[None, :], h[None, :]
+        else:
+            self._rf_grad, self._rf_hess = self.objective.get_gradients(tmp)
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        if self.objective is None:
+            log.fatal("RF mode does not support custom objective")
+        bag_indices = self._bagging(self.iter)
+        grad, hess = self._rf_grad, self._rf_hess
+        should_continue = False
+        prev_iters = self.iter
+        for k in range(self.num_tree_per_iteration):
+            new_tree = Tree(2)
+            if self._class_need_train[k] and self.train_set.num_features > 0:
+                new_tree = self.learner.train(
+                    grad[k], hess[k], bag_indices,
+                    iter_seed=self.iter * self.num_tree_per_iteration + k)
+            if new_tree.num_leaves > 1:
+                should_continue = True
+                if self.objective.is_renew_tree_output:
+                    self._renew_tree_output_rf(new_tree, k)
+                # running average: score = (score*t + tree)/(t+1)
+                if prev_iters > 0:
+                    self.score_updater.multiply(
+                        prev_iters / (prev_iters + 1.0), k)
+                    for vu in self.valid_updaters:
+                        vu.multiply(prev_iters / (prev_iters + 1.0), k)
+                new_tree.apply_shrinkage(1.0 / (prev_iters + 1.0))
+                self._update_score(new_tree, k)
+                new_tree.apply_shrinkage(prev_iters + 1.0)
+            self.models.append(new_tree)
+        if not should_continue:
+            log.warning("Stopped training: no splittable leaves (RF)")
+            if len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+            return True
+        self.iter += 1
+        return False
+
+    def _renew_tree_output_rf(self, tree, class_id):
+        init = self._rf_init_scores[class_id]
+        label = np.asarray(self.train_set.label, dtype=np.float64)
+        weights = self.train_set.metadata.weight
+        for leaf in range(tree.num_leaves):
+            rows = self.learner.leaf_rows(leaf)
+            if len(rows) == 0:
+                continue
+            residuals = label[rows] - init
+            w = weights[rows] if weights is not None else None
+            tree.set_leaf_output(
+                leaf, self.objective.renew_leaf_output(residuals, w))
+
+
+def create_boosting(config: Config, train_set: Optional[Dataset],
+                    objective=None) -> GBDT:
+    """Factory (reference: src/boosting/boosting.cpp:35 CreateBoosting)."""
+    name = config.boosting
+    if name in ("gbdt", "gbrt", "plain"):
+        return GBDT(config, train_set, objective)
+    if name == "dart":
+        return DART(config, train_set, objective)
+    if name == "goss":
+        return GOSS(config, train_set, objective)
+    if name in ("rf", "random_forest"):
+        return RF(config, train_set, objective)
+    log.fatal("Unknown boosting type %s", name)

@@ -1,0 +1,367 @@
+"""Serial (single-device) leaf-wise tree learner.
+
+Equivalent of the reference SerialTreeLearner (reference:
+src/treelearner/serial_tree_learner.cpp:173-893): leaf-wise growth with
+histogram subtraction. TPU-native execution model: the tree loop runs on
+host (tiny bookkeeping), while each step dispatches three jitted device
+programs — partition (stable-sort window), histogram build (MXU one-hot
+contraction, smaller child only), and the vectorized split scan. Dynamic
+leaf sizes are handled by padding windows to power-of-two buckets so XLA
+sees a small, fixed set of shapes.
+
+Histogram-cache choreography (parent moved to larger child, smaller built
+fresh, larger = parent - smaller) matches serial_tree_learner.cpp:400-605.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..io.binning import BIN_CATEGORICAL
+from ..io.dataset import Dataset
+from ..ops import histogram as hist_ops
+from ..ops import partition as part_ops
+from ..ops import split as split_ops
+from ..utils import log
+from .tree import Tree
+
+_MIN_BUCKET = 256
+
+
+def _bucket(count: int, cap: int) -> int:
+    b = _MIN_BUCKET
+    while b < count:
+        b *= 2
+    return min(b, cap)
+
+
+class _LeafState:
+    __slots__ = ("begin", "count", "sum_grad", "sum_hess", "depth",
+                 "hist", "split", "min_c", "max_c")
+
+    def __init__(self, begin, count, sum_grad, sum_hess, depth,
+                 min_c=-np.inf, max_c=np.inf):
+        self.begin = begin
+        self.count = count
+        self.sum_grad = sum_grad
+        self.sum_hess = sum_hess
+        self.depth = depth
+        self.hist = None         # device (F, B, 3)
+        self.split = None        # host dict of the best split, or None
+        self.min_c = min_c
+        self.max_c = max_c
+
+
+class SerialTreeLearner:
+    def __init__(self, config: Config, dataset: Dataset):
+        self.config = config
+        self.dataset = dataset
+        self.binned = dataset.device_binned()
+        (self.f_numbins, self.f_missing, self.f_default,
+         self.f_categorical, self.f_monotone) = dataset.feature_meta_arrays()
+        self.num_features = dataset.num_features
+        self.num_bins = int(dataset.max_num_bins)
+        # pad bin axis to a lane-friendly size
+        b = 1 << max(4, (self.num_bins - 1).bit_length())
+        self.device_bins = min(b, 256) if self.num_bins <= 256 else b
+        n = dataset.num_data
+        self.max_bucket = _bucket(n, 1 << 30)
+        self._has_categorical = any(
+            dataset.bin_mappers[f].bin_type == BIN_CATEGORICAL
+            for f in dataset.used_features)
+        self._use_pallas = bool(int(_env("LGBM_TPU_PALLAS_HIST", "1")))
+        self._mono_enabled = bool(np.any(np.asarray(self.f_monotone) != 0))
+
+    # ------------------------------------------------------------------
+    def _scan_args(self):
+        cfg = self.config
+        return dict(
+            num_bins=self.device_bins,
+            l1=float(cfg.lambda_l1), l2=float(cfg.lambda_l2),
+            max_delta_step=float(cfg.max_delta_step),
+            min_data_in_leaf=int(cfg.min_data_in_leaf),
+            min_sum_hessian=float(cfg.min_sum_hessian_in_leaf),
+            min_gain_to_split=float(cfg.min_gain_to_split),
+        )
+
+    def _feature_mask(self, rng: np.random.RandomState) -> np.ndarray:
+        frac = self.config.feature_fraction
+        mask = np.ones(self.num_features, dtype=bool)
+        if 0.0 < frac < 1.0:
+            k = max(1, int(self.num_features * frac))
+            chosen = rng.choice(self.num_features, k, replace=False)
+            mask[:] = False
+            mask[chosen] = True
+        return mask
+
+    def _node_feature_mask(self, base_mask: np.ndarray,
+                           rng: np.random.RandomState) -> jax.Array:
+        frac = self.config.feature_fraction_bynode
+        if 0.0 < frac < 1.0:
+            k = max(1, int(self.num_features * frac))
+            chosen = rng.choice(self.num_features, k, replace=False)
+            node_mask = np.zeros(self.num_features, dtype=bool)
+            node_mask[chosen] = True
+            return jnp.asarray(base_mask & node_mask)
+        return jnp.asarray(base_mask)
+
+    # ------------------------------------------------------------------
+    def _build_hist(self, indices_buf, grad, hess, begin: int, count: int):
+        return hist_ops.gather_and_build(
+            self.binned, indices_buf, grad, hess,
+            jnp.int32(begin), jnp.int32(count),
+            num_bins=self.device_bins, bucket=_bucket(count, self.max_bucket))
+
+    def _scan_leaf(self, leaf: _LeafState, feature_mask) -> dict:
+        """Run the split scan for a leaf; returns a host-side split record."""
+        res = split_ops.find_best_split(
+            leaf.hist, jnp.float32(leaf.sum_grad), jnp.float32(leaf.sum_hess),
+            jnp.float32(leaf.count), self.f_numbins, self.f_missing,
+            self.f_default, feature_mask & (self.f_categorical == 0),
+            self.f_monotone, jnp.float32(leaf.min_c), jnp.float32(leaf.max_c),
+            **self._scan_args())
+        rec = self._fetch_split(res)
+        if self._has_categorical:
+            cres = split_ops.find_best_split_categorical(
+                leaf.hist, jnp.float32(leaf.sum_grad),
+                jnp.float32(leaf.sum_hess), jnp.float32(leaf.count),
+                self.f_numbins, self.f_missing,
+                feature_mask & (self.f_categorical == 1),
+                jnp.float32(leaf.min_c), jnp.float32(leaf.max_c),
+                **self._cat_scan_args())
+            crec = self._fetch_split(cres, categorical=True)
+            if crec["gain"] > rec["gain"]:
+                rec = crec
+        return rec
+
+    def _cat_scan_args(self):
+        cfg = self.config
+        return dict(
+            num_bins=self.device_bins,
+            l1=float(cfg.lambda_l1), l2=float(cfg.lambda_l2),
+            cat_l2=float(cfg.cat_l2), cat_smooth=float(cfg.cat_smooth),
+            max_delta_step=float(cfg.max_delta_step),
+            min_data_in_leaf=int(cfg.min_data_in_leaf),
+            min_sum_hessian=float(cfg.min_sum_hessian_in_leaf),
+            min_gain_to_split=float(cfg.min_gain_to_split),
+            max_cat_threshold=int(cfg.max_cat_threshold),
+            max_cat_to_onehot=int(cfg.max_cat_to_onehot),
+            min_data_per_group=int(cfg.min_data_per_group),
+        )
+
+    @staticmethod
+    def _fetch_split(res, categorical: bool = False) -> dict:
+        vals = jax.device_get(res)
+        rec = {
+            "gain": float(vals.gain),
+            "feature": int(vals.feature),
+            "threshold": int(vals.threshold),
+            "default_left": bool(vals.default_left),
+            "left_sum_grad": float(vals.left_sum_grad),
+            "left_sum_hess": float(vals.left_sum_hess),
+            "left_count": int(round(float(vals.left_count))),
+            "right_sum_grad": float(vals.right_sum_grad),
+            "right_sum_hess": float(vals.right_sum_hess),
+            "right_count": int(round(float(vals.right_count))),
+            "left_output": float(vals.left_output),
+            "right_output": float(vals.right_output),
+            "categorical": categorical,
+        }
+        if categorical:
+            mask = np.asarray(vals.left_mask)
+            rec["cat_bitset_inner"] = _make_bitset(
+                [int(i) for i in np.nonzero(mask)[0]])
+        return rec
+
+    # ------------------------------------------------------------------
+    def train(self, grad: jax.Array, hess: jax.Array,
+              bag_indices: Optional[np.ndarray] = None,
+              iter_seed: int = 0) -> Tree:
+        cfg = self.config
+        ds = self.dataset
+        n = ds.num_data
+        if bag_indices is not None:
+            bag_cnt = len(bag_indices)
+        else:
+            bag_cnt = n
+        indices_buf = part_ops.make_indices_buffer(n, self.max_bucket, bag_indices)
+        rng = np.random.RandomState(
+            (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
+        base_mask = self._feature_mask(rng)
+
+        tree = Tree(cfg.num_leaves)
+        root_hist = self._build_hist(indices_buf, grad, hess, 0, bag_cnt)
+        totals = jax.device_get(root_hist[0].sum(axis=0))
+        root = _LeafState(0, bag_cnt, float(totals[0]), float(totals[1]), 0)
+        root.hist = root_hist
+        root.split = self._scan_leaf(root, self._node_feature_mask(base_mask, rng))
+        leaves: Dict[int, _LeafState] = {0: root}
+
+        for _split_idx in range(cfg.num_leaves - 1):
+            # pick the splittable leaf with max gain (leaf-wise growth)
+            best_leaf, best_gain = -1, 1e-10  # kEpsilon threshold: gain must be > 0
+            for li, st in leaves.items():
+                if st.split is not None and st.split["gain"] > best_gain:
+                    best_leaf, best_gain = li, st.split["gain"]
+            if best_leaf < 0:
+                if _split_idx == 0:
+                    log.warning(
+                        "No further splits with positive gain, best gain: %f",
+                        best_gain)
+                break
+            st = leaves[best_leaf]
+            sp = st.split
+            self._apply_split(tree, leaves, best_leaf, sp, indices_buf,
+                              grad, hess, base_mask, rng)
+            indices_buf = self._last_indices_buf
+
+        self.indices_buf = indices_buf
+        self.leaves = leaves
+        return tree
+
+    def _apply_split(self, tree: Tree, leaves: Dict[int, _LeafState],
+                     leaf_id: int, sp: dict, indices_buf,
+                     grad, hess, base_mask, rng) -> None:
+        ds = self.dataset
+        cfg = self.config
+        st = leaves[leaf_id]
+        inner_f = sp["feature"]
+        real_f = ds.inner_to_real(inner_f)
+        mapper = ds.bin_mappers[real_f]
+        bucket = _bucket(st.count, self.max_bucket)
+
+        if not sp["categorical"]:
+            new_buf, left_cnt_dev = part_ops.partition_step(
+                indices_buf, self.binned, jnp.int32(st.begin),
+                jnp.int32(st.count), jnp.int32(inner_f),
+                jnp.int32(sp["threshold"]), jnp.bool_(sp["default_left"]),
+                jnp.int32(mapper.missing_type), jnp.int32(mapper.default_bin),
+                jnp.int32(mapper.num_bin), bucket=bucket)
+        else:
+            bitset_words = jnp.asarray(
+                sp["cat_bitset_inner"].view(np.int32))
+            new_buf, left_cnt_dev = part_ops.partition_step_categorical(
+                indices_buf, self.binned, jnp.int32(st.begin),
+                jnp.int32(st.count), jnp.int32(inner_f), bitset_words,
+                bucket=bucket)
+        self._last_indices_buf = new_buf
+        left_cnt = int(jax.device_get(left_cnt_dev))
+        # partition and scan counts can differ by padding rounding only if
+        # something is wrong — guard it
+        if left_cnt != sp["left_count"]:
+            log.debug("partition/scan count mismatch: %d vs %d",
+                      left_cnt, sp["left_count"])
+
+        # tree bookkeeping (leaf_id keeps left, new leaf is right)
+        if not sp["categorical"]:
+            thr_real = ds.real_threshold(inner_f, sp["threshold"])
+            new_leaf = tree.split(
+                leaf_id, inner_f, real_f, sp["threshold"], thr_real,
+                sp["left_output"], sp["right_output"], sp["left_count"],
+                sp["right_count"], sp["left_sum_hess"], sp["right_sum_hess"],
+                sp["gain"], mapper.missing_type, sp["default_left"])
+        else:
+            inner_bits = sp["cat_bitset_inner"]
+            # real-category bitset: map inner bins -> category values
+            cats = [mapper.bin_2_categorical[b]
+                    for b in _bits_set(inner_bits)
+                    if b < len(mapper.bin_2_categorical)]
+            real_bits = _make_bitset(cats)
+            new_leaf = tree.split_categorical(
+                leaf_id, inner_f, real_f,
+                [int(w) for w in inner_bits], [int(w) for w in real_bits],
+                sp["left_output"], sp["right_output"], sp["left_count"],
+                sp["right_count"], sp["left_sum_hess"], sp["right_sum_hess"],
+                sp["gain"], mapper.missing_type)
+
+        # children states; monotone constraint propagation (basic mode,
+        # reference serial_tree_learner.cpp:771-852)
+        lmin, lmax, rmin, rmax = st.min_c, st.max_c, st.min_c, st.max_c
+        mono = int(np.asarray(self.f_monotone)[inner_f]) if self._mono_enabled else 0
+        if mono != 0:
+            mid = (sp["left_output"] + sp["right_output"]) / 2.0
+            if mono > 0:
+                lmax = min(lmax, mid)
+                rmin = max(rmin, mid)
+            else:
+                lmin = max(lmin, mid)
+                rmax = min(rmax, mid)
+        left = _LeafState(st.begin, sp["left_count"], sp["left_sum_grad"],
+                          sp["left_sum_hess"], st.depth + 1, lmin, lmax)
+        right = _LeafState(st.begin + sp["left_count"], sp["right_count"],
+                           sp["right_sum_grad"], sp["right_sum_hess"],
+                           st.depth + 1, rmin, rmax)
+
+        # histogram subtraction: build smaller fresh, larger = parent - smaller
+        smaller, larger = (left, right) if left.count <= right.count else (right, left)
+        if self._splittable(smaller, tree):
+            smaller.hist = self._build_hist(
+                self._last_indices_buf, grad, hess, smaller.begin, smaller.count)
+        if self._splittable(larger, tree):
+            if smaller.hist is not None:
+                larger.hist = hist_ops.subtract_histogram(st.hist, smaller.hist)
+            else:
+                larger.hist = self._build_hist(
+                    self._last_indices_buf, grad, hess, larger.begin, larger.count)
+        st.hist = None  # release parent histogram
+
+        for child in (smaller, larger):
+            if child.hist is not None:
+                child.split = self._scan_leaf(
+                    child, self._node_feature_mask(base_mask, rng))
+            else:
+                child.split = None
+
+        leaves[leaf_id] = left
+        leaves[tree.num_leaves - 1] = right
+        assert tree.num_leaves - 1 == new_leaf
+
+    def _splittable(self, leaf: _LeafState, tree: Tree) -> bool:
+        cfg = self.config
+        if leaf.count < 2 * cfg.min_data_in_leaf:
+            return False
+        if leaf.sum_hess < 2 * cfg.min_sum_hessian_in_leaf:
+            return False
+        if cfg.max_depth > 0 and leaf.depth >= cfg.max_depth:
+            return False
+        return True
+
+    # ------------------------------------------------------------------
+    def leaf_rows(self, leaf_id: int) -> np.ndarray:
+        """Row indices of a leaf after training (for leaf renewal)."""
+        st = self.leaves[leaf_id]
+        window = jax.device_get(
+            jax.lax.dynamic_slice(self.indices_buf, (st.begin,),
+                                  (max(st.count, 1),)))
+        return window[: st.count]
+
+
+def _env(name, default):
+    import os
+    return os.environ.get(name, default)
+
+
+def _bits_set(words: np.ndarray):
+    out = []
+    for wi, w in enumerate(np.asarray(words, dtype=np.uint32)):
+        w = int(w)
+        for b in range(32):
+            if (w >> b) & 1:
+                out.append(wi * 32 + b)
+    return out
+
+
+def _make_bitset(values) -> np.ndarray:
+    if not values:
+        return np.zeros(1, dtype=np.uint32)
+    n_words = max(values) // 32 + 1
+    out = np.zeros(n_words, dtype=np.uint32)
+    for v in values:
+        out[v // 32] |= np.uint32(1 << (v % 32))
+    return out

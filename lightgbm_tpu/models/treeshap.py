@@ -1,0 +1,172 @@
+"""TreeSHAP feature contributions.
+
+Behavioral equivalent of the reference's per-tree SHAP recursion
+(reference: src/io/tree.cpp:669-713 TreeSHAP + PredictContrib). Irregular
+recursion with path bookkeeping — kept on host like the reference keeps it
+out of the GPU path.
+"""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import numpy as np
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, feature_index=-1, zero_fraction=0.0, one_fraction=0.0,
+                 pweight=0.0):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+    def copy(self):
+        return _PathElement(self.feature_index, self.zero_fraction,
+                            self.one_fraction, self.pweight)
+
+
+def _extend_path(path: List[_PathElement], unique_depth: int,
+                 zero_fraction: float, one_fraction: float,
+                 feature_index: int) -> None:
+    path[unique_depth] = _PathElement(
+        feature_index, zero_fraction, one_fraction,
+        1.0 if unique_depth == 0 else 0.0)
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += (one_fraction * path[i].pweight * (i + 1)
+                                / (unique_depth + 1))
+        path[i].pweight = (zero_fraction * path[i].pweight
+                           * (unique_depth - i) / (unique_depth + 1))
+
+
+def _unwind_path(path: List[_PathElement], unique_depth: int,
+                 path_index: int) -> None:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = (next_one_portion * (unique_depth + 1)
+                               / ((i + 1) * one_fraction))
+            next_one_portion = (tmp - path[i].pweight * zero_fraction
+                                * (unique_depth - i) / (unique_depth + 1))
+        else:
+            path[i].pweight = (path[i].pweight * (unique_depth + 1)
+                               / (zero_fraction * (unique_depth - i)))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
+                      path_index: int) -> float:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = (next_one_portion * (unique_depth + 1)
+                   / ((i + 1) * one_fraction))
+            total += tmp
+            next_one_portion = (path[i].pweight - tmp * zero_fraction
+                                * ((unique_depth - i) / (unique_depth + 1)))
+        else:
+            total += (path[i].pweight / zero_fraction
+                      / ((unique_depth - i) / (unique_depth + 1)))
+    return total
+
+
+def _tree_shap(tree, row: np.ndarray, phi: np.ndarray, node: int,
+               unique_depth: int, parent_path: List[_PathElement],
+               parent_zero_fraction: float, parent_one_fraction: float,
+               parent_feature_index: int) -> None:
+    path = [p.copy() for p in parent_path[:unique_depth]]
+    path += [_PathElement() for _ in range(tree.num_leaves + 2 - unique_depth)]
+    _extend_path(path, unique_depth, parent_zero_fraction,
+                 parent_one_fraction, parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += (w * (el.one_fraction - el.zero_fraction)
+                                      * tree.leaf_value[leaf])
+        return
+
+    hot, cold = _decide_children(tree, row, node)
+    w = float(tree.internal_count[node])
+    hot_count = _child_count(tree, hot)
+    cold_count = _child_count(tree, cold)
+    hot_zero = hot_count / w if w else 0.0
+    cold_zero = cold_count / w if w else 0.0
+    incoming_zero = 1.0
+    incoming_one = 1.0
+    feat = int(tree.split_feature[node])
+    path_index = 0
+    while path_index <= unique_depth:
+        if path[path_index].feature_index == feat:
+            break
+        path_index += 1
+    if path_index != unique_depth + 1:
+        incoming_zero = path[path_index].zero_fraction
+        incoming_one = path[path_index].one_fraction
+        _unwind_path(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap(tree, row, phi, hot, unique_depth + 1, path,
+               hot_zero * incoming_zero, incoming_one, feat)
+    _tree_shap(tree, row, phi, cold, unique_depth + 1, path,
+               cold_zero * incoming_zero, 0.0, feat)
+
+
+def _decide_children(tree, row, node):
+    nxt = tree._decision(float(row[tree.split_feature[node]]), node)
+    if nxt == tree.left_child[node]:
+        return tree.left_child[node], tree.right_child[node]
+    return tree.right_child[node], tree.left_child[node]
+
+
+def _child_count(tree, child):
+    if child < 0:
+        return float(tree.leaf_count[~child])
+    return float(tree.internal_count[child])
+
+
+def _expected_value(tree) -> float:
+    total = float(tree.leaf_count[: tree.num_leaves].sum())
+    if total <= 0:
+        return float(tree.leaf_value[0])
+    return float(np.sum(tree.leaf_value[: tree.num_leaves]
+                        * tree.leaf_count[: tree.num_leaves]) / total)
+
+
+def predict_contrib(booster, x, num_iteration=None) -> np.ndarray:
+    """(N, (F+1)*K) SHAP values; last column per class = expected value."""
+    x = np.asarray(x, dtype=np.float64)
+    if x.ndim == 1:
+        x = x.reshape(1, -1)
+    n, _ = x.shape
+    nf = booster.max_feature_idx + 1
+    k = booster.num_class
+    models = booster._used_models(num_iteration)
+    out = np.zeros((n, (nf + 1) * k))
+    for ti, tree in enumerate(models):
+        cls = ti % booster.num_tree_per_iteration
+        base = cls * (nf + 1)
+        if tree.num_leaves <= 1:
+            out[:, base + nf] += float(tree.leaf_value[0])
+            continue
+        expected = _expected_value(tree)
+        for i in range(n):
+            phi = np.zeros(nf + 1)
+            phi[nf] += expected
+            init_path = [_PathElement() for _ in range(tree.num_leaves + 2)]
+            _tree_shap(tree, x[i], phi, 0, 0, init_path, 1.0, 1.0, -1)
+            out[i, base:base + nf + 1] += phi
+    return out

@@ -1,0 +1,8 @@
+"""Objective functions (gradient/hessian producers).
+
+Full parity set with the reference factory (reference:
+src/objective/objective_function.cpp:15-50).
+"""
+from .objective import OBJECTIVE_NAMES, Objective, create_objective
+
+__all__ = ["Objective", "create_objective", "OBJECTIVE_NAMES"]

@@ -1,0 +1,726 @@
+"""All objective functions as jitted device math.
+
+Each objective mirrors the reference class of the same config name
+(reference: src/objective/{regression,binary,multiclass,xentropy,rank}_objective.hpp)
+— same gradients/hessians, boost-from-score, output transform and leaf-renewal
+semantics, restructured as whole-array jax ops instead of OMP loops.
+
+Scores/gradients for K classes use shape (K, N) (reference uses the same
+class-major flattening, multiclass_objective.hpp:88 idx = num_data*k + i).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+
+K_EPSILON = 1e-15
+
+
+def _to_f32(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+def _percentile(values: np.ndarray, weights: Optional[np.ndarray],
+                alpha: float) -> float:
+    """Weighted percentile, reference semantics (regression_objective.hpp:20-76
+    PercentileFun/WeightedPercentileFun)."""
+    n = len(values)
+    if n == 0:
+        return 0.0
+    if weights is None:
+        if n <= 1:
+            return float(values[0])
+        order = np.argsort(values, kind="stable")
+        float_pos = (1.0 - alpha) * n
+        pos = int(math.floor(float_pos))
+        if pos < 1:
+            return float(values[order[0]])
+        if pos >= n:
+            return float(values[order[n - 1]])
+        bias = float_pos - pos
+        v1 = float(values[order[pos - 1]])
+        v2 = float(values[order[pos]])
+        return v1 * (1.0 - bias) + v2 * bias
+    order = np.argsort(values, kind="stable")
+    w = weights[order]
+    v = values[order]
+    cum = np.cumsum(w) - 0.5 * w
+    threshold = alpha * np.sum(w)
+    idx = int(np.searchsorted(cum, threshold, side="left"))
+    idx = min(max(idx, 0), n - 1)
+    if idx > 0 and cum[idx] > threshold:
+        # interpolate like the reference's weighted percentile
+        c1, c2 = cum[idx - 1], cum[idx]
+        if c2 > c1:
+            t = (threshold - c1) / (c2 - c1)
+            return float(v[idx - 1] * (1 - t) + v[idx] * t)
+    return float(v[idx])
+
+
+class Objective:
+    """Base objective (reference: include/LightGBM/objective_function.h)."""
+
+    name = "none"
+
+    def __init__(self, config):
+        self.config = config
+        self.num_class = 1
+        self.label: Optional[np.ndarray] = None
+        self.weight = None
+
+    # -- lifecycle ------------------------------------------------------
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = metadata.label
+        self.weight = metadata.weight
+        self._label_dev = _to_f32(self.label) if self.label is not None else None
+        self._weight_dev = _to_f32(self.weight) if self.weight is not None else None
+
+    # -- core -----------------------------------------------------------
+    def get_gradients(self, score: jax.Array):
+        raise NotImplementedError
+
+    def boost_from_score(self, class_id: int) -> float:
+        return 0.0
+
+    def convert_output(self, scores: jax.Array) -> jax.Array:
+        return scores
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return False
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return self.num_class
+
+    @property
+    def is_renew_tree_output(self) -> bool:
+        return False
+
+    def renew_leaf_output(self, residuals: np.ndarray,
+                          weights: Optional[np.ndarray]) -> float:
+        raise NotImplementedError
+
+    def class_need_train(self, class_id: int) -> bool:
+        return True
+
+    def to_string(self) -> str:
+        return self.name
+
+    def _apply_weight(self, grad, hess):
+        if self._weight_dev is not None:
+            return grad * self._weight_dev, hess * self._weight_dev
+        return grad, hess
+
+
+# ----------------------------------------------------------------------
+class RegressionL2(Objective):
+    """reference: regression_objective.hpp:78 RegressionL2loss."""
+    name = "regression"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = bool(getattr(config, "reg_sqrt", False))
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.sqrt:
+            lbl = np.sign(self.label) * np.sqrt(np.abs(self.label))
+            self._label_dev = _to_f32(lbl)
+            self._trans_label = lbl
+        else:
+            self._trans_label = self.label
+
+    def get_gradients(self, score):
+        grad = score - self._label_dev
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weight is None
+
+    def boost_from_score(self, class_id):
+        if self.weight is not None:
+            return float(np.sum(self._trans_label * self.weight) / np.sum(self.weight))
+        return float(np.mean(self._trans_label))
+
+    def convert_output(self, scores):
+        if self.sqrt:
+            return jnp.sign(scores) * scores * scores
+        return scores
+
+
+class RegressionL1(RegressionL2):
+    """reference: regression_objective.hpp:189 RegressionL1loss."""
+    name = "regression_l1"
+
+    def get_gradients(self, score):
+        diff = score - self._label_dev
+        grad = jnp.sign(diff)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self, class_id):
+        return _percentile(np.asarray(self.label, dtype=np.float64),
+                           self.weight, 0.5)
+
+    @property
+    def is_renew_tree_output(self) -> bool:
+        return True
+
+    def renew_leaf_output(self, residuals, weights):
+        return _percentile(residuals, weights, 0.5)
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weight is None
+
+
+class Huber(RegressionL2):
+    """reference: regression_objective.hpp:275 RegressionHuberLoss."""
+    name = "huber"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+
+    def get_gradients(self, score):
+        diff = score - self._label_dev
+        grad = jnp.where(jnp.abs(diff) <= self.alpha, diff,
+                         jnp.sign(diff) * self.alpha)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weight is None
+
+
+class Fair(RegressionL2):
+    """reference: regression_objective.hpp:337 RegressionFairLoss."""
+    name = "fair"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.c = float(config.fair_c)
+
+    def get_gradients(self, score):
+        x = score - self._label_dev
+        ax = jnp.abs(x)
+        grad = self.c * x / (ax + self.c)
+        hess = self.c * self.c / ((ax + self.c) ** 2)
+        return self._apply_weight(grad, hess)
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return False
+
+
+class Poisson(RegressionL2):
+    """reference: regression_objective.hpp:384 RegressionPoissonLoss (log link)."""
+    name = "poisson"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.max_delta_step = float(config.poisson_max_delta_step)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if np.any(self.label < 0):
+            log.fatal("[poisson]: at least one target label is negative")
+
+    def get_gradients(self, score):
+        grad = jnp.exp(score) - self._label_dev
+        hess = jnp.exp(score + self.max_delta_step)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self, class_id):
+        mean = RegressionL2.boost_from_score(self, class_id)
+        return math.log(max(mean, 1e-20))
+
+    def convert_output(self, scores):
+        return jnp.exp(scores)
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return False
+
+
+class Quantile(RegressionL2):
+    """reference: regression_objective.hpp:464 RegressionQuantileloss."""
+    name = "quantile"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+
+    def get_gradients(self, score):
+        delta = score - self._label_dev
+        grad = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
+        hess = jnp.ones_like(score)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self, class_id):
+        return _percentile(np.asarray(self.label, dtype=np.float64),
+                           self.weight, self.alpha)
+
+    @property
+    def is_renew_tree_output(self) -> bool:
+        return True
+
+    def renew_leaf_output(self, residuals, weights):
+        return _percentile(residuals, weights, self.alpha)
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weight is None
+
+
+class MAPE(RegressionL1):
+    """reference: regression_objective.hpp:562 RegressionMAPELOSS."""
+    name = "mape"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label = np.asarray(self.label, dtype=np.float64)
+        w = 1.0 / np.maximum(1.0, np.abs(label))
+        if self.weight is not None:
+            w = w * self.weight
+        self._mape_w = w
+        self._mape_w_dev = _to_f32(w)
+
+    def get_gradients(self, score):
+        diff = score - self._label_dev
+        grad = jnp.sign(diff) * self._mape_w_dev
+        hess = self._mape_w_dev
+        return grad, hess
+
+    def boost_from_score(self, class_id):
+        return _percentile(np.asarray(self.label, dtype=np.float64),
+                           self._mape_w, 0.5)
+
+    def renew_leaf_output(self, residuals, weights):
+        # weights here are the MAPE weights gathered per-leaf by the caller
+        return _percentile(residuals, weights, 0.5)
+
+    @property
+    def leaf_renew_weight(self):
+        return self._mape_w
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return False
+
+
+class Gamma(Poisson):
+    """reference: regression_objective.hpp:661 RegressionGammaLoss."""
+    name = "gamma"
+
+    def get_gradients(self, score):
+        inv = jnp.exp(-score)
+        grad = 1.0 - self._label_dev * inv
+        hess = self._label_dev * inv
+        return self._apply_weight(grad, hess)
+
+
+class Tweedie(Poisson):
+    """reference: regression_objective.hpp:696 RegressionTweedieLoss."""
+    name = "tweedie"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.rho = float(config.tweedie_variance_power)
+
+    def get_gradients(self, score):
+        e1 = jnp.exp((1.0 - self.rho) * score)
+        e2 = jnp.exp((2.0 - self.rho) * score)
+        grad = -self._label_dev * e1 + e2
+        hess = (-self._label_dev * (1.0 - self.rho) * e1
+                + (2.0 - self.rho) * e2)
+        return self._apply_weight(grad, hess)
+
+
+# ----------------------------------------------------------------------
+class BinaryLogloss(Objective):
+    """reference: binary_objective.hpp:21 BinaryLogloss."""
+    name = "binary"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0:
+            log.fatal("Sigmoid parameter %f should be greater than zero",
+                      self.sigmoid)
+        self.is_unbalance = bool(config.is_unbalance)
+        self.scale_pos_weight = float(config.scale_pos_weight)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        is_pos = self.label > 0
+        cnt_pos = int(np.sum(is_pos))
+        cnt_neg = num_data - cnt_pos
+        self.need_train = cnt_pos > 0 and cnt_neg > 0
+        if not self.need_train:
+            log.warning("Contains only one class")
+        w_neg, w_pos = 1.0, 1.0
+        if self.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                w_neg = cnt_pos / cnt_neg
+            else:
+                w_pos = cnt_neg / cnt_pos
+        w_pos *= self.scale_pos_weight
+        self._signed_label = _to_f32(np.where(is_pos, 1.0, -1.0))
+        self._label_weight = _to_f32(np.where(is_pos, w_pos, w_neg))
+        self._pavg = (np.sum(self.weight[is_pos]) / np.sum(self.weight)
+                      if self.weight is not None
+                      else cnt_pos / max(1, num_data))
+
+    def get_gradients(self, score):
+        lbl = self._signed_label
+        response = -lbl * self.sigmoid / (1.0 + jnp.exp(lbl * self.sigmoid * score))
+        abs_r = jnp.abs(response)
+        grad = response * self._label_weight
+        hess = abs_r * (self.sigmoid - abs_r) * self._label_weight
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self, class_id):
+        pavg = min(max(self._pavg, K_EPSILON), 1.0 - K_EPSILON)
+        return math.log(pavg / (1.0 - pavg)) / self.sigmoid
+
+    def convert_output(self, scores):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * scores))
+
+    def class_need_train(self, class_id):
+        return self.need_train
+
+
+class CrossEntropy(Objective):
+    """reference: xentropy_objective.hpp:44 CrossEntropy."""
+    name = "cross_entropy"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if np.any((self.label < 0) | (self.label > 1)):
+            log.fatal("[%s]: label must be in [0, 1]", self.name)
+
+    def get_gradients(self, score):
+        z = 1.0 / (1.0 + jnp.exp(-score))
+        grad = z - self._label_dev
+        hess = z * (1.0 - z)
+        return self._apply_weight(grad, hess)
+
+    def boost_from_score(self, class_id):
+        if self.weight is not None:
+            pavg = float(np.sum(self.label * self.weight) / np.sum(self.weight))
+        else:
+            pavg = float(np.mean(self.label))
+        pavg = min(max(pavg, K_EPSILON), 1.0 - K_EPSILON)
+        return math.log(pavg / (1.0 - pavg))
+
+    def convert_output(self, scores):
+        return 1.0 / (1.0 + jnp.exp(-scores))
+
+
+class CrossEntropyLambda(Objective):
+    """reference: xentropy_objective.hpp:148 CrossEntropyLambda."""
+    name = "cross_entropy_lambda"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if np.any((self.label < 0) | (self.label > 1)):
+            log.fatal("[%s]: label must be in [0, 1]", self.name)
+
+    def get_gradients(self, score):
+        if self._weight_dev is None:
+            z = 1.0 / (1.0 + jnp.exp(-score))
+            return z - self._label_dev, z * (1.0 - z)
+        w = self._weight_dev
+        y = self._label_dev
+        epf = jnp.exp(score)
+        hhat = jnp.log1p(epf)
+        z = 1.0 - jnp.exp(-w * hhat)
+        enf = 1.0 / epf
+        grad = (1.0 - y / z) * w / (1.0 + enf)
+        c = 1.0 / (1.0 - z)
+        d = 1.0 + epf
+        a = w * epf / (d * d)
+        d2 = c - 1.0
+        b = (c / (d2 * d2)) * (1.0 + w * epf - c)
+        hess = a * (1.0 + y * b)
+        return grad, hess
+
+    def boost_from_score(self, class_id):
+        if self.weight is not None:
+            havg = float(np.sum(self.label * self.weight) / np.sum(self.weight))
+        else:
+            havg = float(np.mean(self.label))
+        return math.log(max(math.exp(havg) - 1.0, K_EPSILON))
+
+    def convert_output(self, scores):
+        return jnp.log1p(jnp.exp(scores))
+
+
+# ----------------------------------------------------------------------
+class MulticlassSoftmax(Objective):
+    """reference: multiclass_objective.hpp:24 MulticlassSoftmax."""
+    name = "multiclass"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label_int = self.label.astype(np.int32)
+        if np.any((label_int < 0) | (label_int >= self.num_class)):
+            log.fatal("Label must be in [0, %d) for multiclass", self.num_class)
+        self._label_int = _to_f32(label_int)
+        counts = np.bincount(label_int, minlength=self.num_class,
+                             weights=self.weight)
+        total = counts.sum()
+        self._class_probs = counts / max(total, 1e-10)
+
+    def get_gradients(self, score):
+        # score: (K, N)
+        p = jax.nn.softmax(score, axis=0)
+        onehot = (jnp.arange(self.num_class, dtype=jnp.float32)[:, None]
+                  == self._label_int[None, :])
+        grad = p - onehot
+        hess = 2.0 * p * (1.0 - p)
+        if self._weight_dev is not None:
+            grad = grad * self._weight_dev[None, :]
+            hess = hess * self._weight_dev[None, :]
+        return grad, hess
+
+    def boost_from_score(self, class_id):
+        return math.log(max(K_EPSILON, self._class_probs[class_id]))
+
+    def convert_output(self, scores):
+        return jax.nn.softmax(scores, axis=0)
+
+    def class_need_train(self, class_id):
+        p = self._class_probs[class_id]
+        return K_EPSILON < abs(p) < 1.0 - K_EPSILON
+
+
+class MulticlassOVA(Objective):
+    """reference: multiclass_objective.hpp:180 MulticlassOVA."""
+    name = "multiclassova"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        self.sigmoid = float(config.sigmoid)
+        self._binary = [BinaryLogloss(config) for _ in range(self.num_class)]
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label_int = self.label.astype(np.int32)
+        self._onehot = (np.arange(self.num_class)[:, None]
+                        == label_int[None, :]).astype(np.float32)
+
+        class _Meta:
+            pass
+
+        for k, b in enumerate(self._binary):
+            m = _Meta()
+            m.label = self._onehot[k]
+            m.weight = self.weight
+            b.init(m, num_data)
+
+    def get_gradients(self, score):
+        grads, hesses = [], []
+        for k, b in enumerate(self._binary):
+            g, h = b.get_gradients(score[k])
+            grads.append(g)
+            hesses.append(h)
+        return jnp.stack(grads), jnp.stack(hesses)
+
+    def boost_from_score(self, class_id):
+        return self._binary[class_id].boost_from_score(0)
+
+    def convert_output(self, scores):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * scores))
+
+
+# ----------------------------------------------------------------------
+class LambdarankNDCG(Objective):
+    """LambdaRank with NDCG weighting (reference: rank_objective.hpp:23).
+
+    TPU-native formulation: queries are padded into (Q, L) segment tensors;
+    the per-query pairwise lambda accumulation (rank_objective.hpp:83-190)
+    becomes masked (L, L) outer products batched over query chunks. The
+    sigmoid table is replaced by the exact sigmoid (accuracy >= table).
+    """
+    name = "lambdarank"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        self.norm = bool(config.lambdamart_norm)
+        self.optimize_pos_at = int(config.max_position)
+        self.label_gain = np.asarray(config.label_gain, dtype=np.float64)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        qb = metadata.query_boundaries
+        if qb is None:
+            log.fatal("Lambdarank tasks require query information")
+        self.query_boundaries = np.asarray(qb, dtype=np.int64)
+        counts = np.diff(self.query_boundaries)
+        self.num_queries = len(counts)
+        lmax = int(counts.max())
+        # pad to a lane-friendly length
+        self.pad_len = max(8, 1 << (lmax - 1).bit_length())
+        q, L = self.num_queries, self.pad_len
+        idx = np.zeros((q, L), dtype=np.int32)
+        mask = np.zeros((q, L), dtype=bool)
+        for i in range(q):
+            c = counts[i]
+            idx[i, :c] = np.arange(self.query_boundaries[i],
+                                   self.query_boundaries[i + 1])
+            mask[i, :c] = True
+        self._idx = jnp.asarray(idx)
+        self._mask = jnp.asarray(mask)
+        labels = np.where(mask, self.label[idx.clip(0, num_data - 1)], 0.0)
+        # max DCG at top-k per query (reference DCGCalculator::CalMaxDCGAtK)
+        inv_max_dcg = np.zeros(q)
+        gains = self.label_gain[labels.astype(np.int32)]
+        discounts = 1.0 / np.log2(np.arange(L) + 2.0)
+        for i in range(q):
+            srt = np.sort(gains[i][mask[i]])[::-1]
+            k = min(self.optimize_pos_at, len(srt))
+            m = float(np.sum(srt[:k] * discounts[:k]))
+            inv_max_dcg[i] = 1.0 / m if m > 0 else 0.0
+        self._inv_max_dcg = jnp.asarray(inv_max_dcg, dtype=jnp.float32)
+        self._gains = jnp.asarray(gains, dtype=jnp.float32)
+        self._labels_pad = jnp.asarray(labels, dtype=jnp.float32)
+        self._discount = jnp.asarray(discounts, dtype=jnp.float32)
+        self._grad_fn = jax.jit(self._gradients_impl)
+
+    def _gradients_impl(self, score):
+        q, L = self._idx.shape
+        s = score[self._idx] * self._mask  # (Q, L)
+        s = jnp.where(self._mask, s, -jnp.inf)
+        order = jnp.argsort(-s, axis=1)  # rank -> doc position within query
+        s_srt = jnp.take_along_axis(s, order, axis=1)
+        lbl_srt = jnp.take_along_axis(self._labels_pad, order, axis=1)
+        gain_srt = jnp.take_along_axis(self._gains, order, axis=1)
+        valid_srt = jnp.take_along_axis(self._mask, order, axis=1)
+        disc = self._discount[None, :] * valid_srt  # (Q, L) discount by rank
+
+        best = s_srt[:, 0]
+        nvalid = jnp.sum(valid_srt, axis=1).astype(jnp.int32)
+        worst = jnp.take_along_axis(
+            s_srt, jnp.maximum(nvalid - 1, 0)[:, None], axis=1)[:, 0]
+
+        # pair tensors over rank positions (i=high, j=low)
+        delta_s = s_srt[:, :, None] - s_srt[:, None, :]
+        pair_ok = (valid_srt[:, :, None] & valid_srt[:, None, :]
+                   & (lbl_srt[:, :, None] > lbl_srt[:, None, :]))
+        dcg_gap = gain_srt[:, :, None] - gain_srt[:, None, :]
+        paired_disc = jnp.abs(disc[:, :, None] - disc[:, None, :])
+        delta_ndcg = dcg_gap * paired_disc * self._inv_max_dcg[:, None, None]
+        if self.norm:
+            norm_ok = (best != worst)[:, None, None]
+            delta_ndcg = jnp.where(
+                norm_ok, delta_ndcg / (0.01 + jnp.abs(delta_s)), delta_ndcg)
+        p = 1.0 / (1.0 + jnp.exp(self.sigmoid * delta_s))  # GetSigmoid(delta)
+        p_lambda = -self.sigmoid * delta_ndcg * p
+        p_hess = self.sigmoid * self.sigmoid * delta_ndcg * p * (1.0 - p)
+        p_lambda = jnp.where(pair_ok, p_lambda, 0.0)
+        p_hess = jnp.where(pair_ok, p_hess, 0.0)
+
+        lam_srt = jnp.sum(p_lambda, axis=2) - jnp.sum(p_lambda, axis=1)
+        hes_srt = jnp.sum(p_hess, axis=2) + jnp.sum(p_hess, axis=1)
+        if self.norm:
+            sum_lambdas = -2.0 * jnp.sum(p_lambda, axis=(1, 2))
+            factor = jnp.where(
+                sum_lambdas > 0,
+                jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, 1e-20),
+                1.0)
+            lam_srt = lam_srt * factor[:, None]
+            hes_srt = hes_srt * factor[:, None]
+
+        # unsort back to doc positions, then scatter to flat rows
+        inv_order = jnp.argsort(order, axis=1)
+        lam = jnp.take_along_axis(lam_srt, inv_order, axis=1)
+        hes = jnp.take_along_axis(hes_srt, inv_order, axis=1)
+        grad = jnp.zeros_like(score).at[self._idx.reshape(-1)].add(
+            jnp.where(self._mask, lam, 0.0).reshape(-1))
+        hess = jnp.zeros_like(score).at[self._idx.reshape(-1)].add(
+            jnp.where(self._mask, hes, 0.0).reshape(-1))
+        if self._weight_dev is not None:
+            grad = grad * self._weight_dev
+            hess = hess * self._weight_dev
+        return grad, hess
+
+    def get_gradients(self, score):
+        return self._grad_fn(score)
+
+
+# ----------------------------------------------------------------------
+class NoneObjective(Objective):
+    """objective=none: gradients supplied externally (custom fobj)."""
+    name = "custom"
+
+    def get_gradients(self, score):
+        log.fatal("objective=none requires externally-supplied gradients")
+
+
+_CLASSES = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": Huber,
+    "fair": Fair,
+    "poisson": Poisson,
+    "quantile": Quantile,
+    "mape": MAPE,
+    "gamma": Gamma,
+    "tweedie": Tweedie,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+    "lambdarank": LambdarankNDCG,
+}
+
+OBJECTIVE_NAMES = sorted(_CLASSES)
+
+
+def create_objective(name: str, config) -> Optional[Objective]:
+    """Factory (reference: objective_function.cpp:15-50); None for custom."""
+    name = str(name).lower()
+    if name in ("none", "null", "custom", "na"):
+        return None
+    cls = _CLASSES.get(name)
+    if cls is None:
+        log.fatal("Unknown objective type name: %s", name)
+    obj = cls(config)
+    return obj
+
+
+def parse_objective_from_model(text: str, config) -> Optional[Objective]:
+    """Recreate an objective from its model-file string, e.g.
+    'binary sigmoid:1' or 'multiclass num_class:3'."""
+    parts = text.strip().split()
+    if not parts:
+        return None
+    name = parts[0]
+    for tok in parts[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            if k == "num_class":
+                config.num_class = int(v)
+            elif k == "sigmoid":
+                config.sigmoid = float(v)
+    return create_objective(name, config)

@@ -1,0 +1,104 @@
+"""Device histogram construction.
+
+Role of the reference's hottest loops — Bin::ConstructHistogram
+(reference: src/io/dense_bin.hpp:71-195, 4-way unrolled scalar scatter) and
+the OpenCL kernels (src/treelearner/ocl/histogram256.cl, local-memory float
+atomics). TPUs have no fast scatter-atomics, so the TPU-native formulation is
+a one-hot contraction on the MXU: for a row chunk C,
+
+    hist[f*B+b, k] += sum_n onehot[n, f*B+b] * gh[n, k]
+
+i.e. a (FB, C) x (C, 3) matmul per chunk, accumulated over chunks with
+lax.scan. The (gradient, hessian, count) triple rides the tiny K=3 axis;
+padding rows carry gh = 0 so buckets can be padded freely.
+
+A fused Pallas kernel (ops/pallas/histogram_kernel.py) implements the same
+contract without materializing the one-hot in HBM.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .pallas import histogram_kernel as _pallas_hist
+
+
+def _hist_chunk(binned_chunk: jax.Array, gh_chunk: jax.Array, num_bins: int) -> jax.Array:
+    """One-hot contraction for one chunk.
+
+    binned_chunk: (C, F) int8/int16 bin codes
+    gh_chunk:     (C, 3) f32 (grad, hess, valid-count)
+    returns       (F, B, 3) f32 partial histogram
+    """
+    c, f = binned_chunk.shape
+    iota = jnp.arange(num_bins, dtype=jnp.int32)
+    onehot = (binned_chunk.astype(jnp.int32)[:, :, None] == iota[None, None, :])
+    onehot2d = onehot.reshape(c, f * num_bins).astype(jnp.float32)
+    # (FB, C) @ (C, 3) on the MXU
+    hist = jax.lax.dot_general(
+        onehot2d, gh_chunk,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return hist.reshape(f, num_bins, 3)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk_size", "use_pallas"))
+def build_histogram(binned_rows: jax.Array, gh: jax.Array, num_bins: int,
+                    chunk_size: int = 2048, use_pallas: bool = False) -> jax.Array:
+    """Full histogram for a padded row window.
+
+    binned_rows: (P, F) gathered bin codes for the leaf's rows (pad rows
+                 arbitrary — their gh must be zero).
+    gh:          (P, 3) f32 (grad, hess, valid) — valid is 0.0 on pad rows.
+    Returns (F, B, 3) f32: per (feature, bin): [sum_grad, sum_hess, count].
+    """
+    if use_pallas:
+        return _pallas_hist.build_histogram_pallas(binned_rows, gh, num_bins)
+    p, f = binned_rows.shape
+    if p <= chunk_size:
+        return _hist_chunk(binned_rows, gh, num_bins)
+    n_chunks = (p + chunk_size - 1) // chunk_size
+    pad = n_chunks * chunk_size - p
+    if pad:
+        binned_rows = jnp.pad(binned_rows, ((0, pad), (0, 0)))
+        gh = jnp.pad(gh, ((0, pad), (0, 0)))
+    binned_rows = binned_rows.reshape(n_chunks, chunk_size, f)
+    gh = gh.reshape(n_chunks, chunk_size, 3)
+
+    def body(acc, chunk):
+        b, g = chunk
+        return acc + _hist_chunk(b, g, num_bins), None
+
+    init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
+    hist, _ = jax.lax.scan(body, init, (binned_rows, gh))
+    return hist
+
+
+@jax.jit
+def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
+    """Sibling histogram by subtraction (reference:
+    src/treelearner/feature_histogram.hpp:75-81 FeatureHistogram::Subtract)."""
+    return parent - child
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "bucket"))
+def gather_and_build(binned: jax.Array, indices_buf: jax.Array, grad: jax.Array,
+                     hess: jax.Array, begin: jax.Array, count: jax.Array,
+                     num_bins: int, bucket: int) -> jax.Array:
+    """Gather a leaf's rows from the partition buffer and build its histogram.
+
+    binned:      (N, F) full binned matrix
+    indices_buf: (N + max_bucket,) int32 partition permutation (padded tail)
+    begin/count: scalars (leaf slice in the partition buffer)
+    bucket:      static padded window size >= count
+    """
+    window = jax.lax.dynamic_slice(indices_buf, (begin,), (bucket,))
+    valid = (jnp.arange(bucket, dtype=jnp.int32) < count)
+    rows = jnp.take(binned, window, axis=0)
+    g = jnp.take(grad, window) * valid
+    h = jnp.take(hess, window) * valid
+    gh = jnp.stack([g, h, valid.astype(jnp.float32)], axis=1)
+    return build_histogram(rows, gh, num_bins)

@@ -1,0 +1,78 @@
+"""Pallas TPU histogram kernel.
+
+TPU-native replacement for the reference's OpenCL histogram kernels
+(reference: src/treelearner/ocl/histogram256.cl — per-workgroup local-memory
+float atomics). TPUs have no scatter-atomics; instead each grid step builds a
+one-hot matrix for a (row-chunk x feature-tile) block in VMEM and contracts it
+with (grad, hess, count) on the MXU, accumulating into the output block that
+stays resident in VMEM across the row-chunk grid axis.
+
+Layout notes:
+  * gh comes in transposed (3, P) so the matmul is (3, C) @ (C, Ft*B) —
+    full 128-lane utilization on the output's last axis.
+  * output is (3, F, B); the public wrapper transposes to the framework's
+    (F, B, 3) contract (tiny array, negligible).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _hist_kernel(codes_ref, gh_ref, out_ref, *, num_bins: int):
+    p_idx = pl.program_id(1)
+    codes = codes_ref[...].astype(jnp.int32)          # (C, Ft)
+    c, ft = codes.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, (c, ft, num_bins), 2)
+    onehot = (codes[:, :, None] == iota).astype(jnp.float32)
+    oh2 = onehot.reshape(c, ft * num_bins)
+    gh = gh_ref[...]                                   # (3, C) f32
+    acc = jax.lax.dot_general(
+        gh, oh2, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                  # (3, Ft*B)
+    acc3 = acc.reshape(3, ft, num_bins)
+
+    @pl.when(p_idx == 0)
+    def _init():
+        out_ref[...] = acc3
+
+    @pl.when(p_idx > 0)
+    def _acc():
+        out_ref[...] += acc3
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk_rows", "feat_tile"))
+def build_histogram_pallas(binned_rows: jax.Array, gh: jax.Array, num_bins: int,
+                           chunk_rows: int = 512, feat_tile: int = 8) -> jax.Array:
+    """(P, F) codes + (P, 3) gh -> (F, B, 3) f32 histogram."""
+    p, f = binned_rows.shape
+    # pad rows to chunk multiple (pad gh rows are zero so they add nothing)
+    pad_p = (-p) % chunk_rows
+    pad_f = (-f) % feat_tile
+    if pad_p or pad_f:
+        binned_rows = jnp.pad(binned_rows, ((0, pad_p), (0, pad_f)))
+    if pad_p:
+        gh = jnp.pad(gh, ((0, pad_p), (0, 0)))
+    pp, ff = p + pad_p, f + pad_f
+    gh_t = gh.T                                        # (3, P)
+
+    grid = (ff // feat_tile, pp // chunk_rows)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, num_bins=num_bins),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((chunk_rows, feat_tile), lambda fi, pi: (pi, fi)),
+            pl.BlockSpec((3, chunk_rows), lambda fi, pi: (0, pi)),
+        ],
+        out_specs=pl.BlockSpec((3, feat_tile, num_bins), lambda fi, pi: (0, fi, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, ff, num_bins), jnp.float32),
+    )(binned_rows, gh_t)
+    hist = jnp.transpose(out, (1, 2, 0))               # (F, B, 3)
+    if pad_f:
+        hist = hist[:f]
+    return hist

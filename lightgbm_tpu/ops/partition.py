@@ -1,0 +1,100 @@
+"""Device data partition: leaf -> row-index ranges.
+
+Equivalent of the reference DataPartition (reference:
+src/treelearner/data_partition.hpp:20-205): a permutation buffer grouped by
+leaf plus per-leaf (begin, count). The reference re-partitions one leaf's
+slice with per-thread buffers; here it is a stable sort by a 2-bit key on a
+fixed-size padded window, so every split step is one jitted program.
+
+The window [begin, begin+bucket) may overrun into the next leaf's range; pad
+positions (>= count) get the highest key, and a *stable* sort therefore
+returns them in original order at the window tail — the overrun region is
+rewritten byte-identical, so neighbours are untouched.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+def decide_left(bins: jax.Array, threshold, default_left, missing_type,
+                default_bin, num_bins_f, max_bin_idx=None) -> jax.Array:
+    """Binned split decision (reference: include/LightGBM/tree.h:243
+    NumericalDecisionInner): missing bin goes to the default side, otherwise
+    left iff bin <= threshold."""
+    is_missing = jnp.where(
+        missing_type == MISSING_ZERO, bins == default_bin,
+        jnp.where(missing_type == MISSING_NAN, bins == num_bins_f - 1, False))
+    return jnp.where(is_missing, default_left, bins <= threshold)
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def partition_step(indices_buf: jax.Array, binned: jax.Array,
+                   begin: jax.Array, count: jax.Array,
+                   feature: jax.Array, threshold: jax.Array,
+                   default_left: jax.Array, missing_type: jax.Array,
+                   default_bin: jax.Array, num_bins_f: jax.Array,
+                   *, bucket: int):
+    """Split one leaf's index window into (left | right).
+
+    indices_buf: (N + max_bucket,) int32 permutation buffer
+    binned:      (N, F) bin codes
+    Returns (new_indices_buf, left_count).
+    """
+    window = jax.lax.dynamic_slice(indices_buf, (begin,), (bucket,))
+    valid = jnp.arange(bucket, dtype=jnp.int32) < count
+    fbins = binned[window, feature].astype(jnp.int32)
+    go_left = decide_left(fbins, threshold, default_left, missing_type,
+                          default_bin, num_bins_f)
+    # key: 0 = left, 1 = right, 2 = padding/overrun (stays in place)
+    key = jnp.where(valid, jnp.where(go_left, 0, 1), 2).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    new_window = window[order]
+    left_count = jnp.sum((key == 0).astype(jnp.int32))
+    new_buf = jax.lax.dynamic_update_slice(indices_buf, new_window, (begin,))
+    return new_buf, left_count
+
+
+@functools.partial(jax.jit, static_argnames=("bucket",))
+def partition_step_categorical(indices_buf: jax.Array, binned: jax.Array,
+                               begin: jax.Array, count: jax.Array,
+                               feature: jax.Array, bitset: jax.Array,
+                               *, bucket: int):
+    """Categorical split: left iff the row's bin is in the bitset
+    (reference: CategoricalDecisionInner + Common::FindInBitset)."""
+    window = jax.lax.dynamic_slice(indices_buf, (begin,), (bucket,))
+    valid = jnp.arange(bucket, dtype=jnp.int32) < count
+    fbins = binned[window, feature].astype(jnp.int32)
+    word = bitset[jnp.clip(fbins // 32, 0, bitset.shape[0] - 1)]
+    go_left = ((word >> (fbins % 32)) & 1) == 1
+    go_left = go_left & (fbins // 32 < bitset.shape[0])
+    key = jnp.where(valid, jnp.where(go_left, 0, 1), 2).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    new_window = window[order]
+    left_count = jnp.sum((key == 0).astype(jnp.int32))
+    new_buf = jax.lax.dynamic_update_slice(indices_buf, new_window, (begin,))
+    return new_buf, left_count
+
+
+@jax.jit
+def init_partition(indices: jax.Array, buf_size: int | None = None):
+    """Root partition from a (possibly bagged) index set."""
+    return indices
+
+
+def make_indices_buffer(n_total: int, max_bucket: int,
+                        bag_indices=None) -> jax.Array:
+    """Allocate the padded permutation buffer."""
+    import numpy as np
+    buf = np.zeros(n_total + max_bucket, dtype=np.int32)
+    if bag_indices is None:
+        buf[:n_total] = np.arange(n_total, dtype=np.int32)
+    else:
+        buf[: len(bag_indices)] = bag_indices
+    return jnp.asarray(buf)
