@@ -1,0 +1,47 @@
+"""lightgbm_tpu: TPU-native gradient boosting framework.
+
+A from-scratch reimplementation of the LightGBM (v2.3.1) feature surface,
+designed TPU-first: binned data as device arrays, histogram construction on
+the MXU, split search as vectorized bin scans, distribution via
+jax.sharding meshes + XLA collectives. Drop-in Python API:
+
+    import lightgbm_tpu as lgb
+    bst = lgb.train(params, lgb.Dataset(X, label=y))
+"""
+import os as _os
+
+# Persistent XLA compilation cache: tree training launches a family of
+# jitted programs per (bucket-size, config); caching makes reruns warm.
+if not _os.environ.get("LGBM_TPU_NO_COMP_CACHE"):
+    try:
+        import jax as _jax
+        _cache_dir = _os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            _os.path.join(_os.path.expanduser("~"), ".cache", "lightgbm_tpu_xla"))
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:  # pragma: no cover
+        pass
+
+from .basic import Booster, Dataset
+from .callback import (EarlyStopException, early_stopping, print_evaluation,
+                       record_evaluation, reset_parameter)
+from .engine import CVBooster, cv, train
+from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor)
+from .utils.log import LightGBMError
+
+try:
+    from .plotting import (plot_importance, plot_metric, plot_split_value_histogram,
+                           plot_tree, create_tree_digraph)
+except ImportError:  # matplotlib/graphviz absent
+    pass
+
+__version__ = "2.3.1.tpu1"
+
+__all__ = [
+    "Dataset", "Booster", "CVBooster",
+    "train", "cv",
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+    "early_stopping", "print_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException", "LightGBMError",
+]

@@ -1,0 +1,423 @@
+"""User-facing Dataset and Booster.
+
+Signature-compatible with the reference Python package
+(reference: python-package/lightgbm/basic.py:711 Dataset, :1658 Booster) so
+existing LightGBM user code ports by changing the import. There is no ctypes
+boundary — the "C API" role is played by the in-process engine
+(models/gbdt.py); a C-ABI shim lives in capi/ for external bindings.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .config import Config, parse_config_str
+from .io.dataset import Dataset as _InnerDataset
+from .models.gbdt import GBDT, create_boosting
+from .utils import log
+from .utils.log import LightGBMError
+
+__all__ = ["Dataset", "Booster", "LightGBMError"]
+
+
+def _load_data_from_file(path: str):
+    """Parse CSV/TSV/LibSVM with auto-detection
+    (reference: src/io/parser.cpp CreateParser)."""
+    from .io.parser import parse_file
+    return parse_file(path)
+
+
+class Dataset:
+    """Lazily-constructed training data (reference: basic.py:711)."""
+
+    def __init__(self, data, label=None, reference=None, weight=None,
+                 group=None, init_score=None, silent=False,
+                 feature_name="auto", categorical_feature="auto",
+                 params=None, free_raw_data=True):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = copy.deepcopy(params) or {}
+        self.free_raw_data = free_raw_data
+        self._inner: Optional[_InnerDataset] = None
+        self._label_from_file = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._inner is not None:
+            return self
+        data = self.data
+        label = self.label
+        feature_names = None
+        if isinstance(data, str):
+            x, y, qb = _load_data_from_file(data)
+            data = x
+            if label is None and y is not None:
+                label = y
+            if self.group is None and qb is not None:
+                self.group = np.diff(qb)
+        if hasattr(data, "columns"):  # pandas
+            feature_names = [str(c) for c in data.columns]
+        if isinstance(self.feature_name, (list, tuple)):
+            feature_names = list(self.feature_name)
+        cats = None
+        if isinstance(self.categorical_feature, (list, tuple)):
+            cats = list(self.categorical_feature)
+        cfg = Config(self.params)
+        ref_inner = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_inner = self.reference._inner
+        # side files (reference: Metadata loads <data>.weight/.query)
+        if isinstance(self.data, str):
+            import os
+            wpath = self.data + ".weight"
+            qpath = self.data + ".query"
+            if self.weight is None and os.path.exists(wpath):
+                self.weight = np.loadtxt(wpath)
+            if self.group is None and os.path.exists(qpath):
+                self.group = np.loadtxt(qpath).astype(np.int64)
+        self._inner = _InnerDataset(
+            data, config=cfg, label=label, weight=self.weight,
+            group=self.group, init_score=self.init_score,
+            feature_names=feature_names, categorical_feature=cats,
+            reference=ref_inner)
+        if self.free_raw_data and not isinstance(self.data, str):
+            self.data = None
+        return self
+
+    def _update_params(self, params: Dict[str, Any]) -> None:
+        if self._inner is not None:
+            return  # constructed; params frozen like the reference
+        self.params.update(params or {})
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, silent=False, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, silent=silent,
+                       params=params or self.params)
+
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._inner is not None:
+            self._inner.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._inner is not None:
+            self._inner.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.set_init_score(init_score)
+        return self
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        if field_name == "label":
+            return self.set_label(data)
+        if field_name == "weight":
+            return self.set_weight(data)
+        if field_name == "group":
+            return self.set_group(data)
+        if field_name == "init_score":
+            return self.set_init_score(data)
+        raise LightGBMError(f"Unknown field {field_name}")
+
+    def get_field(self, field_name: str):
+        self.construct()
+        md = self._inner.metadata
+        if field_name == "label":
+            return md.label
+        if field_name == "weight":
+            return md.weight
+        if field_name == "group":
+            return (np.diff(md.query_boundaries)
+                    if md.query_boundaries is not None else None)
+        if field_name == "init_score":
+            return md.init_score
+        raise LightGBMError(f"Unknown field {field_name}")
+
+    def get_label(self):
+        return self.get_field("label")
+
+    def get_weight(self):
+        return self.get_field("weight")
+
+    def get_group(self):
+        return self.get_field("group")
+
+    def get_init_score(self):
+        return self.get_field("init_score")
+
+    def num_data(self) -> int:
+        self.construct()
+        return self._inner.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._inner.num_total_features
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._inner.feature_names)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row subset (reference basic.py Dataset.subset)."""
+        self.construct()
+        used_indices = np.asarray(used_indices)
+        sub = Dataset.__new__(Dataset)
+        sub.params = params or self.params
+        sub.free_raw_data = True
+        sub.data = None
+        sub.reference = self
+        sub.feature_name = self.feature_name
+        sub.categorical_feature = self.categorical_feature
+        inner = copy.copy(self._inner)
+        inner.binned = self._inner.binned[used_indices]
+        inner.num_data = len(used_indices)
+        from .io.dataset import Metadata
+        md = Metadata(inner.num_data)
+        src = self._inner.metadata
+        if src.label is not None:
+            md.label = src.label[used_indices]
+        if src.weight is not None:
+            md.weight = src.weight[used_indices]
+        if src.init_score is not None:
+            md.init_score = src.init_score[used_indices]
+        inner.metadata = md
+        inner._device_cache = {}
+        sub._inner = inner
+        sub.label = md.label
+        sub.weight = md.weight
+        sub.group = None
+        sub.init_score = md.init_score
+        return sub
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()
+        self._inner.save_binary(filename)
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        self.feature_name = feature_name
+        return self
+
+
+_NO_DEFAULT = object()
+
+
+class Booster:
+    """Training/prediction handle (reference: basic.py:1658)."""
+
+    def __init__(self, params=None, train_set: Optional[Dataset] = None,
+                 model_file=None, model_str=None, silent=False):
+        self.params = copy.deepcopy(params) or {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_data_name = "training"
+        self.name_valid_sets: List[str] = []
+        self._gbdt: Optional[GBDT] = None
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be Dataset instance")
+            train_set._update_params(self.params)
+            train_set.construct()
+            cfg = train_set._inner.config
+            cfg.update(self.params)
+            self._gbdt = create_boosting(cfg, train_set._inner)
+            self.train_set = train_set
+        elif model_file is not None:
+            self._gbdt = GBDT.load_model(model_file, Config(self.params))
+        elif model_str is not None:
+            self._gbdt = GBDT.load_model_from_string(model_str, Config(self.params))
+        else:
+            raise TypeError("need at least one of train_set, model_file, model_str")
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        self._gbdt.add_valid(data._inner, name)
+        self.name_valid_sets.append(name)
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if stopped early
+        (reference Booster.update -> LGBM_BoosterUpdateOneIter)."""
+        if fobj is not None:
+            grad, hess = fobj(self.__inner_predict_raw(), self.train_set)
+            return self.__boost(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def __boost(self, grad, hess) -> bool:
+        grad = np.asarray(grad, dtype=np.float32)
+        hess = np.asarray(hess, dtype=np.float32)
+        return self._gbdt.train_one_iter(grad, hess)
+
+    def __inner_predict_raw(self) -> np.ndarray:
+        scores = self._gbdt.score_updater.host_scores()
+        return scores[0] if self._gbdt.num_class == 1 else scores.reshape(-1)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees()
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None):
+        return self.__eval(self._train_data_name, feval)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for name in self.name_valid_sets:
+            out.extend(self.__eval(name, feval))
+        return out
+
+    def eval(self, data=None, name=None, feval=None):
+        return self.eval_train(feval) + self.eval_valid(feval)
+
+    def __eval(self, dataset_name, feval=None):
+        results = []
+        for dname, mname, val, hb in self._gbdt.eval_metrics():
+            if dname == "training":
+                dname = self._train_data_name
+            if dname == dataset_name:
+                results.append((dname, mname, val, hb))
+        if feval is not None:
+            if dataset_name == self._train_data_name:
+                ds, updater = self.train_set, self._gbdt.score_updater
+            else:
+                idx = self.name_valid_sets.index(dataset_name)
+                ds = self._gbdt.valid_sets[idx]
+                updater = self._gbdt.valid_updaters[idx]
+            preds = updater.host_scores()
+            preds = preds[0] if self._gbdt.num_class == 1 else preds.reshape(-1)
+            ret = feval(preds, ds)
+            rets = ret if isinstance(ret, list) else [ret]
+            for (n, v, hb) in rets:
+                results.append((dataset_name, n, v, hb))
+        return results
+
+    # ------------------------------------------------------------------
+    def predict(self, data, num_iteration=None, raw_score=False,
+                pred_leaf=False, pred_contrib=False, data_has_header=False,
+                is_reshape=True, start_iteration=0, **kwargs):
+        if isinstance(data, str):
+            x, _, _ = _load_data_from_file(data)
+        else:
+            x = data
+        if hasattr(x, "values"):
+            x = x.values
+        try:
+            import scipy.sparse as sp
+            if sp.issparse(x):
+                x = np.asarray(x.todense())
+        except ImportError:
+            pass
+        if num_iteration is None:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else None)
+        return self._gbdt.predict(
+            x, num_iteration=num_iteration, raw_score=raw_score,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+            start_iteration=start_iteration)
+
+    def refit(self, data, label, decay_rate=0.9, **kwargs):
+        """Refit leaf values on new data (reference Booster.refit)."""
+        from .engine import train as _train
+        new_params = dict(self.params)
+        new_params["refit_decay_rate"] = decay_rate
+        leaf_preds = self.predict(data, pred_leaf=True)
+        new_booster = Booster(new_params, Dataset(data, label))
+        new_booster._gbdt.models = [copy.deepcopy(t) for t in self._gbdt.models]
+        new_booster._gbdt.refit_leaves(leaf_preds, decay_rate)
+        return new_booster
+
+    # ------------------------------------------------------------------
+    def save_model(self, filename, num_iteration=None,
+                   start_iteration=0) -> "Booster":
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        self._gbdt.save_model(filename, num_iteration, start_iteration)
+        return self
+
+    def model_to_string(self, num_iteration=None, start_iteration=0) -> str:
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return self._gbdt.save_model_to_string(start_iteration, num_iteration)
+
+    def dump_model(self, num_iteration=None, start_iteration=0) -> dict:
+        return self._gbdt.dump_model(num_iteration, start_iteration)
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type="split",
+                           iteration=None) -> np.ndarray:
+        imp = self._gbdt.feature_importance(importance_type, iteration)
+        if importance_type == "split":
+            return imp.astype(np.int64)
+        return imp
+
+    def feature_name(self) -> List[str]:
+        return list(self._gbdt.feature_names)
+
+    def num_feature(self) -> int:
+        return self._gbdt.max_feature_idx + 1
+
+    def reset_parameter(self, params) -> "Booster":
+        self.params.update(params)
+        self._gbdt.config.update(params)
+        self._gbdt.shrinkage_rate = self._gbdt.config.learning_rate
+        return self
+
+    def set_network(self, machines, local_listen_port=12400,
+                    listen_time_out=120, num_machines=1) -> "Booster":
+        from .parallel import network
+        network.init_from_params(machines, local_listen_port, num_machines)
+        return self
+
+    def free_network(self) -> "Booster":
+        from .parallel import network
+        network.free()
+        return self
+
+    def shuffle_models(self, start_iteration=0, end_iteration=-1) -> "Booster":
+        import random
+        models = self._gbdt.models
+        end = len(models) if end_iteration < 0 else end_iteration
+        seg = models[start_iteration:end]
+        random.shuffle(seg)
+        models[start_iteration:end] = seg
+        return self

@@ -1,0 +1,301 @@
+"""Training and cross-validation entry points.
+
+Signature-compatible with the reference engine
+(reference: python-package/lightgbm/engine.py:18 train, :373 cv).
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .utils import log
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100, valid_sets=None, valid_names=None,
+          fobj=None, feval=None, init_model=None, feature_name="auto",
+          categorical_feature="auto", early_stopping_rounds=None,
+          evals_result=None, verbose_eval=True, learning_rates=None,
+          keep_training_booster=False, callbacks=None):
+    params = copy.deepcopy(params or {})
+    if fobj is not None:
+        params["objective"] = "none"
+    num_boost_round = int(params.pop("num_boost_round",
+                          params.pop("num_iterations", num_boost_round)))
+    if early_stopping_rounds is None:
+        early_stopping_rounds = params.pop("early_stopping_round",
+                                           params.pop("early_stopping_rounds", None))
+        if early_stopping_rounds is not None:
+            early_stopping_rounds = int(early_stopping_rounds)
+    first_metric_only = bool(params.get("first_metric_only", False))
+
+    if feature_name != "auto":
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto":
+        train_set.set_categorical_feature(categorical_feature)
+    train_set._update_params(params)
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        _load_init_model(booster, init_model)
+    valid_sets = valid_sets or []
+    if isinstance(valid_sets, Dataset):
+        valid_sets = [valid_sets]
+    reduced_valid_sets = []
+    name_valid_sets = []
+    for i, vset in enumerate(valid_sets):
+        if vset is train_set:
+            booster.set_train_data_name(
+                valid_names[i] if valid_names else "training")
+            continue
+        name = (valid_names[i] if valid_names and i < len(valid_names)
+                else f"valid_{i}")
+        vset.reference = train_set
+        booster.add_valid(vset, name)
+        reduced_valid_sets.append(vset)
+        name_valid_sets.append(name)
+
+    cbs = set(callbacks or [])
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        cbs.add(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(
+            early_stopping_rounds, first_metric_only,
+            verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        cbs.add(callback_mod.record_evaluation(evals_result))
+    cbs_before = {c for c in cbs if getattr(c, "before_iteration", False)}
+    cbs_after = cbs - cbs_before
+    cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
+    cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
+
+    init_iteration = booster.current_iteration()
+    for i in range(init_iteration, init_iteration + num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=init_iteration,
+                end_iteration=init_iteration + num_boost_round,
+                evaluation_result_list=None))
+        stop = booster.update(fobj=fobj)
+        evaluation_result_list = []
+        if reduced_valid_sets or booster._gbdt.train_metrics:
+            evaluation_result_list = booster.eval_train(feval) + booster.eval_valid(feval)
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=init_iteration,
+                    end_iteration=init_iteration + num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            evaluation_result_list = e.best_score
+            break
+        if stop:
+            break
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for item in evaluation_result_list:
+        booster.best_score[item[0]][item[1]] = item[2]
+    return booster
+
+
+def _load_init_model(booster: Booster, init_model) -> None:
+    from .models.gbdt import GBDT
+    import copy as _copy
+    if isinstance(init_model, str):
+        prev = GBDT.load_model(init_model)
+    elif isinstance(init_model, Booster):
+        prev = init_model._gbdt
+    else:
+        raise TypeError("init_model must be a path or Booster")
+    g = booster._gbdt
+    g.models = [_copy.deepcopy(t) for t in prev.models]
+    g.num_init_iteration = len(g.models) // max(g.num_tree_per_iteration, 1)
+    # continued training: replay existing model into scores
+    for k in range(g.num_tree_per_iteration):
+        for it in range(g.num_init_iteration):
+            tree = g.models[it * g.num_tree_per_iteration + k]
+            g.score_updater.add_tree(tree, k)
+            for vu in g.valid_updaters:
+                vu.add_tree(tree, k)
+
+
+class CVBooster:
+    """Container of per-fold boosters (reference engine.py _CVBooster)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: dict,
+                  seed: int, stratified: bool, shuffle: bool):
+    full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and hasattr(folds, "split"):
+            group = full_data.get_group()
+            group_info = (np.asarray(group, dtype=np.int64)
+                          if group is not None else None)
+            flatted_group = (np.repeat(np.arange(len(group_info)), group_info)
+                             if group_info is not None
+                             else np.zeros(num_data, dtype=np.int64))
+            folds = folds.split(X=np.zeros(num_data),
+                                y=full_data.get_label(), groups=flatted_group)
+    else:
+        rng = np.random.RandomState(seed)
+        group = full_data.get_group()
+        if group is not None:
+            # group-aware folds: whole queries to one fold
+            ngroups = len(group)
+            gidx = np.arange(ngroups)
+            if shuffle:
+                rng.shuffle(gidx)
+            gfold = np.array_split(gidx, nfold)
+            boundaries = np.concatenate([[0], np.cumsum(group)]).astype(np.int64)
+            folds = []
+            for f in range(nfold):
+                test_rows = np.concatenate(
+                    [np.arange(boundaries[g], boundaries[g + 1])
+                     for g in gfold[f]]) if len(gfold[f]) else np.array([], dtype=np.int64)
+                mask = np.ones(num_data, dtype=bool)
+                mask[test_rows] = False
+                folds.append((np.nonzero(mask)[0], test_rows))
+        elif stratified:
+            label = np.asarray(full_data.get_label())
+            folds = []
+            assign = np.zeros(num_data, dtype=np.int64)
+            for cls in np.unique(label):
+                rows = np.nonzero(label == cls)[0]
+                if shuffle:
+                    rng.shuffle(rows)
+                for f, chunk in enumerate(np.array_split(rows, nfold)):
+                    assign[chunk] = f
+            for f in range(nfold):
+                test_rows = np.nonzero(assign == f)[0]
+                train_rows = np.nonzero(assign != f)[0]
+                folds.append((train_rows, test_rows))
+        else:
+            idx = np.arange(num_data)
+            if shuffle:
+                rng.shuffle(idx)
+            chunks = np.array_split(idx, nfold)
+            folds = []
+            for f in range(nfold):
+                test_rows = chunks[f]
+                train_rows = np.concatenate(
+                    [chunks[g] for g in range(nfold) if g != f])
+                folds.append((train_rows, test_rows))
+    return folds
+
+
+def cv(params, train_set, num_boost_round=100, folds=None, nfold=5,
+       stratified=True, shuffle=True, metrics=None, fobj=None, feval=None,
+       init_model=None, feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
+       show_stdv=True, seed=0, callbacks=None, eval_train_metric=False,
+       return_cvbooster=False):
+    params = copy.deepcopy(params or {})
+    if fobj is not None:
+        params["objective"] = "none"
+    if metrics:
+        params["metric"] = metrics
+    num_boost_round = int(params.pop("num_boost_round",
+                          params.pop("num_iterations", num_boost_round)))
+    if early_stopping_rounds is None:
+        early_stopping_rounds = params.pop("early_stopping_round", None)
+
+    if params.get("objective") in ("lambdarank",) or train_set.group is not None:
+        stratified = False
+    train_set._update_params(params)
+    folds_iter = _make_n_folds(train_set, folds, nfold, params, seed,
+                               stratified, shuffle)
+
+    results = collections.defaultdict(list)
+    cvbooster = CVBooster()
+    fold_data = []
+    for train_rows, test_rows in folds_iter:
+        tset = train_set.subset(np.sort(train_rows))
+        vset = train_set.subset(np.sort(test_rows))
+        vset.reference = tset
+        if fpreproc is not None:
+            tset, vset, fold_params = fpreproc(tset, vset, copy.deepcopy(params))
+        else:
+            fold_params = params
+        booster = Booster(params=fold_params, train_set=tset)
+        booster.add_valid(vset, "valid")
+        cvbooster.append(booster)
+        fold_data.append((tset, vset))
+
+    cbs = set(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(
+            early_stopping_rounds,
+            bool(params.get("first_metric_only", False)), verbose=False))
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        cbs.add(callback_mod.print_evaluation(verbose_eval, show_stdv))
+    cbs_before = {c for c in cbs if getattr(c, "before_iteration", False)}
+    cbs_after = cbs - cbs_before
+    cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
+    cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
+
+    for i in range(num_boost_round):
+        for booster in cvbooster.boosters:
+            for cb in cbs_before:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=None))
+            booster.update(fobj=fobj)
+        merged = collections.defaultdict(list)
+        for booster in cvbooster.boosters:
+            one = (booster.eval_train(feval) if eval_train_metric else []) \
+                + booster.eval_valid(feval)
+            for (dname, mname, val, hb) in one:
+                merged[(dname, mname, hb)].append(val)
+        agg = []
+        for (dname, mname, hb), vals in merged.items():
+            agg.append((dname, mname, float(np.mean(vals)), hb,
+                        float(np.std(vals))))
+        for (dname, mname, mean, hb, std) in agg:
+            results[f"{dname} {mname}-mean" if eval_train_metric
+                    else f"{mname}-mean"].append(mean)
+            results[f"{dname} {mname}-stdv" if eval_train_metric
+                    else f"{mname}-stdv"].append(std)
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(
+                    model=cvbooster.boosters[0], params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=agg))
+        except callback_mod.EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for key in list(results.keys()):
+                results[key] = results[key][: cvbooster.best_iteration]
+            break
+    out = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

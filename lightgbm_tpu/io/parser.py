@@ -1,0 +1,93 @@
+"""Text data parsing: CSV / TSV / LibSVM with auto-detection.
+
+Equivalent of the reference parsers (reference: src/io/parser.cpp:194
+CreateParser, parser.hpp CSVParser/TSVParser/LibSVMParser). Numpy fast paths;
+the optional C++ accelerator (cpp/parser.cpp via ctypes) is used when built —
+see io/native.py.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+
+def _detect_format(line: str) -> str:
+    tokens = line.strip().split()
+    colon_cnt = sum(1 for t in tokens for c in t if c == ":")
+    if colon_cnt > 0 and all(":" in t for t in tokens[1:2]):
+        return "libsvm"
+    if "," in line:
+        return "csv"
+    if "\t" in line:
+        return "tsv"
+    return "space"
+
+
+def _is_number(tok: str) -> bool:
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return tok.lower() in ("nan", "na", "inf", "-inf")
+
+
+def parse_file(path: str, label_column: int = 0,
+               has_header: Optional[bool] = None):
+    """Returns (X, y, query_boundaries|None)."""
+    try:
+        from . import native
+        if native.available():
+            return native.parse_file(path, label_column)
+    except Exception:  # pragma: no cover - fall back to numpy path
+        pass
+    with open(path) as f:
+        first = f.readline()
+        while first.startswith("#") or not first.strip():
+            first = f.readline()
+    fmt = _detect_format(first)
+    if fmt == "libsvm":
+        return _parse_libsvm(path)
+    delim = {"csv": ",", "tsv": "\t", "space": None}[fmt]
+    # header auto-detect: non-numeric tokens in the first row
+    toks = first.strip().split(delim)
+    header = has_header if has_header is not None else not all(
+        _is_number(t) for t in toks if t)
+    data = np.genfromtxt(path, delimiter=delim,
+                         skip_header=1 if header else 0, dtype=np.float64)
+    if data.ndim == 1:
+        data = data.reshape(-1, 1)
+    if data.shape[1] == 1:
+        return data, None, None
+    y = data[:, label_column].copy()
+    x = np.delete(data, label_column, axis=1)
+    return x, y, None
+
+
+def _parse_libsvm(path: str):
+    labels = []
+    rows = []
+    max_feat = -1
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            toks = line.split()
+            labels.append(float(toks[0]))
+            feats = {}
+            for t in toks[1:]:
+                if ":" not in t:
+                    continue
+                k, v = t.split(":", 1)
+                idx = int(k)
+                feats[idx] = float(v)
+                max_feat = max(max_feat, idx)
+            rows.append(feats)
+    x = np.zeros((len(rows), max_feat + 1))
+    for i, feats in enumerate(rows):
+        for k, v in feats.items():
+            x[i, k] = v
+    return x, np.asarray(labels), None

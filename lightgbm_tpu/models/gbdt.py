@@ -35,6 +35,10 @@ K_EPSILON = 1e-15
 MODEL_VERSION = "v3"
 
 
+def _threshold_l1_np(s: float, l1: float) -> float:
+    return math.copysign(max(0.0, abs(s) - l1), s)
+
+
 class ScoreUpdater:
     """Per-dataset raw scores (reference: src/boosting/score_updater.hpp)."""
 
@@ -377,6 +381,32 @@ class GBDT:
                     if tree.split_gain[node] > 0:
                         out[tree.split_feature[node]] += tree.split_gain[node]
         return out
+
+    def refit_leaves(self, leaf_preds: np.ndarray, decay_rate: float) -> None:
+        """Refit leaf values on new data keeping structure (reference:
+        gbdt.cpp:298-321 RefitTree + FitByExistingTree): new_value =
+        decay * old + (1 - decay) * regularized mean-gradient estimate."""
+        grad, hess = self._compute_gradients()
+        g = np.asarray(jax.device_get(grad))
+        h = np.asarray(jax.device_get(hess))
+        cfg = self.config
+        for ti, tree in enumerate(self.models):
+            k = ti % self.num_tree_per_iteration
+            leaves = leaf_preds[:, ti]
+            for leaf in range(tree.num_leaves):
+                rows = np.nonzero(leaves == leaf)[0]
+                if len(rows) == 0:
+                    continue
+                sg = float(g[k][rows].sum())
+                sh = float(h[k][rows].sum())
+                out = -_threshold_l1_np(sg, cfg.lambda_l1) / (sh + cfg.lambda_l2)
+                if cfg.max_delta_step > 0:
+                    out = float(np.clip(out, -cfg.max_delta_step,
+                                        cfg.max_delta_step))
+                old = float(tree.leaf_value[leaf])
+                tree.set_leaf_output(
+                    leaf, decay_rate * old
+                    + (1.0 - decay_rate) * out * self.shrinkage_rate)
 
     # -- model serialization -------------------------------------------
     def save_model_to_string(self, start_iteration: int = 0,

@@ -161,8 +161,8 @@ class SerialTreeLearner:
         rec = {
             "gain": float(vals.gain),
             "feature": int(vals.feature),
-            "threshold": int(vals.threshold),
-            "default_left": bool(vals.default_left),
+            "threshold": 0 if categorical else int(vals.threshold),
+            "default_left": False if categorical else bool(vals.default_left),
             "left_sum_grad": float(vals.left_sum_grad),
             "left_sum_hess": float(vals.left_sum_hess),
             "left_count": int(round(float(vals.left_count))),

@@ -293,7 +293,26 @@ class Tree:
             t.cat_boundaries = list(ints("cat_boundaries", t.num_cat + 1))
             ncat_words = t.cat_boundaries[-1]
             t.cat_threshold = [int(x) for x in ints("cat_threshold", ncat_words)]
+            # inner thresholds unavailable after load; raw-value traversal only
+            t.cat_boundaries_inner = list(t.cat_boundaries)
+            t.cat_threshold_inner = list(t.cat_threshold)
+        t.recompute_depths()
         return t
+
+    def recompute_depths(self) -> None:
+        """Rebuild leaf_depth from the children arrays (reference
+        Tree::RecomputeMaxDepth)."""
+        if self.num_leaves <= 1:
+            self.leaf_depth[0] = 0
+            return
+        stack = [(0, 0)]
+        while stack:
+            node, d = stack.pop()
+            for child in (self.left_child[node], self.right_child[node]):
+                if child >= 0:
+                    stack.append((child, d + 1))
+                else:
+                    self.leaf_depth[~child] = d + 1
 
     def _node_to_json(self, node: int, feature_names=None) -> dict:
         if node >= 0:

@@ -36,11 +36,14 @@ def _hist_chunk(binned_chunk: jax.Array, gh_chunk: jax.Array, num_bins: int) -> 
     iota = jnp.arange(num_bins, dtype=jnp.int32)
     onehot = (binned_chunk.astype(jnp.int32)[:, :, None] == iota[None, None, :])
     onehot2d = onehot.reshape(c, f * num_bins).astype(jnp.float32)
-    # (FB, C) @ (C, 3) on the MXU
+    # (FB, C) @ (C, 3) on the MXU. HIGHEST keeps true-f32 products — the
+    # TPU default would round gh to bf16 (one-hot is bf16-exact, gradients
+    # are not); the reference's GPU path is full fp32 too.
     hist = jax.lax.dot_general(
         onehot2d, gh_chunk,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
     return hist.reshape(f, num_bins, 3)
 

@@ -34,6 +34,7 @@ def _hist_kernel(codes_ref, gh_ref, out_ref, *, num_bins: int):
     acc = jax.lax.dot_general(
         gh, oh2, dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )                                                  # (3, Ft*B)
     acc3 = acc.reshape(3, ft, num_bins)
 

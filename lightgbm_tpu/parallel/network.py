@@ -1,0 +1,80 @@
+"""Distributed process-group bootstrap.
+
+Replaces the reference's socket/MPI transport stack
+(reference: src/network/linkers_socket.cpp full-mesh TCP handshake,
+network.cpp Bruck/recursive-halving collectives). On TPU the transport IS the
+platform: `jax.distributed.initialize` joins the multi-host ICI/DCN domain
+and all collectives are XLA ops emitted inside jitted programs
+(see parallel/*.py) — there is no userspace collective code to run.
+
+This module keeps the reference's *bootstrap* API surface
+(`machines=host:port,...`, Booster.set_network) mapped onto
+jax.distributed, so CLI/Python driver code ports unchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import log
+
+_initialized = False
+_num_machines = 1
+_rank = 0
+
+
+def init_from_params(machines: str, local_listen_port: int = 12400,
+                     num_machines: int = 1) -> None:
+    """machines='ip1:port1,ip2:port2,...' -> jax.distributed.initialize.
+
+    Rank = index of our address in the machine list, coordinator = entry 0
+    (the reference derives rank the same way, linkers_socket.cpp:80)."""
+    global _initialized, _num_machines, _rank
+    if isinstance(machines, (list, tuple)):
+        machines = ",".join(machines)
+    entries = [m.strip() for m in str(machines).split(",") if m.strip()]
+    if len(entries) <= 1:
+        _num_machines = 1
+        return
+    import socket
+    my_names = {socket.gethostname(), "localhost", "127.0.0.1"}
+    try:
+        my_names.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    rank = None
+    for i, e in enumerate(entries):
+        host = e.split(":")[0]
+        if host in my_names:
+            rank = i
+            break
+    if rank is None:
+        log.fatal("Could not find local machine in machine list: %s", machines)
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=entries[0],
+        num_processes=len(entries), process_id=rank)
+    _initialized = True
+    _num_machines = len(entries)
+    _rank = rank
+    log.info("jax.distributed initialized: rank %d of %d", rank, len(entries))
+
+
+def num_machines() -> int:
+    return _num_machines
+
+
+def rank() -> int:
+    return _rank
+
+
+def free() -> None:
+    global _initialized, _num_machines, _rank
+    if _initialized:
+        import jax
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # pragma: no cover
+            pass
+    _initialized = False
+    _num_machines = 1
+    _rank = 0

@@ -1,0 +1,60 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Distributed learners are validated the way SURVEY.md §4 prescribes: the CPU
+backend with xla_force_host_platform_device_count gives N devices without N
+chips; the driver's dryrun separately compile-checks the multi-chip path.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# tests are small; persistent cache churn is not worth it
+os.environ.setdefault("LGBM_TPU_NO_COMP_CACHE", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+def make_binary(n=2000, f=10, seed=7):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, f)
+    logit = x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+    y = (logit + r.randn(n) * 0.5 > 0).astype(np.float64)
+    return x, y
+
+
+def make_regression(n=2000, f=10, seed=7):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, f)
+    y = x[:, 0] * 2.0 + np.sin(x[:, 1]) + 0.1 * r.randn(n)
+    return x, y
+
+
+def make_multiclass(n=2000, f=10, k=4, seed=7):
+    r = np.random.RandomState(seed)
+    centers = r.randn(k, f) * 2.5
+    y = r.randint(0, k, n)
+    x = centers[y] + r.randn(n, f)
+    return x, y.astype(np.float64)
+
+
+def make_ranking(nq=60, docs_per_q=20, f=8, seed=7):
+    r = np.random.RandomState(seed)
+    n = nq * docs_per_q
+    x = r.randn(n, f)
+    rel = np.clip((x[:, 0] + r.randn(n) * 0.5) * 1.2 + 1.5, 0, 4)
+    y = np.floor(rel).astype(np.float64)
+    group = np.full(nq, docs_per_q)
+    return x, y, group

@@ -1,0 +1,118 @@
+"""BinMapper semantics tests (reference behavior: src/io/bin.cpp)."""
+import math
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import (BIN_CATEGORICAL, MISSING_NAN,
+                                     MISSING_NONE, MISSING_ZERO, BinMapper,
+                                     greedy_find_bin,
+                                     find_bin_with_zero_as_one_bin)
+
+
+def test_greedy_few_distinct():
+    dv = np.array([1.0, 2.0, 3.0])
+    cnt = np.array([10, 10, 10])
+    bounds = greedy_find_bin(dv, cnt, max_bin=10, total_cnt=30, min_data_in_bin=1)
+    assert bounds[-1] == math.inf
+    assert len(bounds) == 3
+    assert bounds[0] > 1.5 and bounds[0] < 2.0 + 1e-9
+
+
+def test_greedy_respects_min_data_in_bin():
+    dv = np.array([1.0, 2.0, 3.0, 4.0])
+    cnt = np.array([1, 1, 1, 100])
+    bounds = greedy_find_bin(dv, cnt, max_bin=10, total_cnt=103, min_data_in_bin=3)
+    # first boundary only after accumulating >= 3 samples
+    assert len(bounds) == 2
+
+
+def test_zero_bin_dedicated():
+    dv = np.array([-2.0, -1.0, 0.0, 1.0, 2.0])
+    cnt = np.array([5, 5, 50, 5, 5])
+    bounds = find_bin_with_zero_as_one_bin(dv, cnt, 10, 70, 1)
+    # zero must sit alone between -kZero and +kZero bounds
+    assert any(b == -1e-35 for b in bounds)
+    assert any(b == 1e-35 for b in bounds)
+
+
+def test_mapper_basic_numerical():
+    m = BinMapper()
+    vals = np.concatenate([np.linspace(-5, 5, 1000)])
+    m.find_bin(vals, total_sample_cnt=1000, max_bin=32, min_data_in_bin=3,
+               min_split_data=2)
+    assert m.num_bin <= 32
+    assert m.missing_type == MISSING_NONE
+    # order preserved: larger value -> larger-or-equal bin
+    bins = m.values_to_bins(vals)
+    assert np.all(np.diff(bins) >= 0)
+    # scalar and vector paths agree
+    for v in (-5.0, -0.1, 0.0, 0.1, 4.9):
+        assert m.value_to_bin(v) == m.values_to_bins(np.array([v]))[0]
+
+
+def test_mapper_nan_missing():
+    m = BinMapper()
+    vals = np.concatenate([np.linspace(1, 10, 500), [np.nan] * 50])
+    m.find_bin(vals, total_sample_cnt=550, max_bin=16, min_data_in_bin=1,
+               min_split_data=1)
+    assert m.missing_type == MISSING_NAN
+    assert m.value_to_bin(float("nan")) == m.num_bin - 1
+    bins = m.values_to_bins(np.array([np.nan, 5.0]))
+    assert bins[0] == m.num_bin - 1
+    assert bins[1] < m.num_bin - 1
+
+
+def test_mapper_zero_as_missing():
+    m = BinMapper()
+    vals = np.linspace(1, 10, 500)
+    m.find_bin(vals, total_sample_cnt=1000, max_bin=16, min_data_in_bin=1,
+               min_split_data=1, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+
+
+def test_mapper_trivial():
+    m = BinMapper()
+    m.find_bin(np.array([]), total_sample_cnt=100, max_bin=16,
+               min_data_in_bin=1, min_split_data=1)
+    assert m.is_trivial
+
+
+def test_mapper_categorical():
+    m = BinMapper()
+    r = np.random.RandomState(0)
+    vals = r.choice([1, 2, 3, 4, 5], size=1000,
+                    p=[0.4, 0.3, 0.15, 0.1, 0.05]).astype(np.float64)
+    m.find_bin(vals, total_sample_cnt=1000, max_bin=10, min_data_in_bin=1,
+               min_split_data=1, bin_type=BIN_CATEGORICAL)
+    assert m.bin_type == BIN_CATEGORICAL
+    # most frequent category gets bin 0 (unless it's category 0)
+    assert m.bin_2_categorical[0] == 1
+    assert m.value_to_bin(1.0) == 0
+    # unseen category goes to last bin
+    assert m.value_to_bin(99.0) == m.num_bin - 1
+
+
+def test_mapper_value_to_bin_boundaries():
+    m = BinMapper()
+    vals = np.array([1.0] * 10 + [2.0] * 10 + [3.0] * 10)
+    m.find_bin(vals, total_sample_cnt=30, max_bin=30, min_data_in_bin=1,
+               min_split_data=1)
+    b1 = m.value_to_bin(1.0)
+    b2 = m.value_to_bin(2.0)
+    b3 = m.value_to_bin(3.0)
+    assert b1 < b2 < b3
+    # midpoint boundary: value at the midpoint goes to the LOWER bin
+    assert m.value_to_bin(1.5) == b1
+
+
+def test_roundtrip_serialization():
+    m = BinMapper()
+    vals = np.concatenate([np.linspace(-3, 3, 300), [np.nan] * 10])
+    m.find_bin(vals, total_sample_cnt=310, max_bin=16, min_data_in_bin=1,
+               min_split_data=1)
+    m2 = BinMapper.from_dict(m.to_dict())
+    assert m2.num_bin == m.num_bin
+    assert m2.bin_upper_bound[:-1] == m.bin_upper_bound[:-1]
+    test_vals = np.array([-2.5, 0.0, 1.7, np.nan])
+    assert np.array_equal(m.values_to_bins(test_vals), m2.values_to_bins(test_vals))

@@ -1,0 +1,337 @@
+"""End-to-end training tests, modeled on the reference's test strategy
+(reference: tests/python_package_test/test_engine.py — metric-threshold
+assertions per objective + structural checks)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from conftest import make_binary, make_multiclass, make_ranking, make_regression
+
+
+def _logloss(y, p):
+    p = np.clip(p, 1e-15, 1 - 1e-15)
+    return float(np.mean(-(y * np.log(p) + (1 - y) * np.log(1 - p))))
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0
+    npos, nneg = pos.sum(), (~pos).sum()
+    return float((ranks[pos].sum() - npos * (npos + 1) / 2) / (npos * nneg))
+
+
+def test_binary():
+    x, y = make_binary()
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 31, "learning_rate": 0.1, "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=50, verbose_eval=False)
+    pred = bst.predict(x)
+    assert _logloss(y, pred) < 0.25
+    assert _auc(y, pred) > 0.95
+
+
+def test_regression():
+    x, y = make_regression()
+    params = {"objective": "regression", "metric": "l2", "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=60, verbose_eval=False)
+    pred = bst.predict(x)
+    mse = float(np.mean((y - pred) ** 2))
+    assert mse < 0.4
+
+
+def test_regression_l1_and_huber():
+    x, y = make_regression()
+    for obj in ("regression_l1", "huber", "fair", "quantile"):
+        params = {"objective": obj, "verbosity": -1}
+        ds = lgb.Dataset(x, y, free_raw_data=False)
+        bst = lgb.train(params, ds, num_boost_round=40, verbose_eval=False)
+        pred = bst.predict(x)
+        mae = float(np.mean(np.abs(y - pred)))
+        assert mae < 1.2, (obj, mae)
+
+
+def test_poisson_gamma_tweedie():
+    r = np.random.RandomState(5)
+    n, f = 1500, 6
+    x = r.randn(n, f)
+    mu = np.exp(0.4 * x[:, 0] + 0.2 * x[:, 1])
+    y = r.poisson(mu).astype(np.float64)
+    for obj in ("poisson", "tweedie"):
+        ds = lgb.Dataset(x, y, free_raw_data=False)
+        bst = lgb.train({"objective": obj, "verbosity": -1}, ds,
+                        num_boost_round=40, verbose_eval=False)
+        pred = bst.predict(x)
+        assert pred.min() >= 0
+        assert np.corrcoef(pred, mu)[0, 1] > 0.7
+    ygam = np.maximum(y, 0.1)
+    ds = lgb.Dataset(x, ygam, free_raw_data=False)
+    bst = lgb.train({"objective": "gamma", "verbosity": -1}, ds,
+                    num_boost_round=40, verbose_eval=False)
+    assert bst.predict(x).min() > 0
+
+
+def test_multiclass():
+    x, y = make_multiclass()
+    params = {"objective": "multiclass", "num_class": 4,
+              "metric": "multi_logloss", "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    pred = bst.predict(x)
+    assert pred.shape == (len(y), 4)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-4)
+    acc = float(np.mean(np.argmax(pred, axis=1) == y))
+    assert acc > 0.85
+
+
+def test_multiclassova():
+    x, y = make_multiclass()
+    params = {"objective": "multiclassova", "num_class": 4, "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=25, verbose_eval=False)
+    pred = bst.predict(x)
+    acc = float(np.mean(np.argmax(pred, axis=1) == y))
+    assert acc > 0.8
+
+
+def test_cross_entropy():
+    x, y = make_binary()
+    yq = np.where(y > 0, 0.9, 0.1)  # probabilistic labels
+    for obj in ("cross_entropy", "cross_entropy_lambda"):
+        ds = lgb.Dataset(x, yq, free_raw_data=False)
+        bst = lgb.train({"objective": obj, "verbosity": -1}, ds,
+                        num_boost_round=30, verbose_eval=False)
+        pred = bst.predict(x)
+        assert _auc(y, pred) > 0.9
+
+
+def test_lambdarank():
+    x, y, group = make_ranking()
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "eval_at": [3, 5], "verbosity": -1}
+    ds = lgb.Dataset(x, y, group=group, free_raw_data=False)
+    vds = lgb.Dataset(x, y, group=group, reference=ds, free_raw_data=False)
+    evals = {}
+    bst = lgb.train(params, ds, num_boost_round=30, valid_sets=[vds],
+                    valid_names=["val"], evals_result=evals,
+                    verbose_eval=False)
+    ndcg = evals["val"]["ndcg@5"]
+    assert ndcg[-1] > 0.70
+    assert ndcg[-1] >= ndcg[0] - 1e-6
+
+
+def test_missing_value_handle():
+    r = np.random.RandomState(1)
+    n = 1000
+    x = r.randn(n, 3)
+    y = (x[:, 0] > 0).astype(np.float64)
+    x[r.rand(n) < 0.3, 0] = np.nan  # 30% missing in the informative feature
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=30, verbose_eval=False)
+    pred = bst.predict(x)
+    assert _auc(y, pred) > 0.85
+    # NaN rows at predict time are handled
+    x2 = x.copy()
+    x2[:, 0] = np.nan
+    pred2 = bst.predict(x2)
+    assert np.all(np.isfinite(pred2))
+
+
+def test_missing_value_zero_as_missing():
+    r = np.random.RandomState(2)
+    n = 1000
+    x = np.zeros((n, 2))
+    mask = r.rand(n) < 0.5
+    x[mask, 0] = r.randn(mask.sum()) + 3
+    y = mask.astype(np.float64)
+    ds = lgb.Dataset(x, y, params={"zero_as_missing": True},
+                     free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "zero_as_missing": True,
+                     "verbosity": -1}, ds, num_boost_round=20,
+                    verbose_eval=False)
+    assert _auc(y, bst.predict(x)) > 0.95
+
+
+def test_categorical_feature():
+    r = np.random.RandomState(3)
+    n = 2000
+    cat = r.randint(0, 8, n).astype(np.float64)
+    noise = r.randn(n, 2)
+    x = np.column_stack([cat, noise])
+    effect = np.array([2.0, -1.0, 0.5, 3.0, -2.0, 0.0, 1.0, -0.5])
+    y = effect[cat.astype(int)] + 0.1 * r.randn(n)
+    ds = lgb.Dataset(x, y, categorical_feature=[0], free_raw_data=False)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "min_data_in_leaf": 20}, ds,
+                    num_boost_round=40, verbose_eval=False)
+    pred = bst.predict(x)
+    assert float(np.mean((y - pred) ** 2)) < 0.2
+
+
+def test_early_stopping():
+    x, y = make_binary(3000)
+    xt, yt = x[:2000], y[:2000]
+    xv, yv = x[2000:], y[2000:]
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbosity": -1, "num_leaves": 63}
+    ds = lgb.Dataset(xt, yt, free_raw_data=False)
+    vds = lgb.Dataset(xv, yv, reference=ds, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=200, valid_sets=[vds],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration > 0
+    assert bst.current_iteration() <= 200
+
+
+def test_continued_training():
+    x, y = make_binary()
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst1 = lgb.train(params, ds, num_boost_round=10, verbose_eval=False)
+    model_str = bst1.model_to_string()
+    ll1 = _logloss(y, bst1.predict(x))
+    ds2 = lgb.Dataset(x, y, free_raw_data=False)
+    bst2 = lgb.train(params, ds2, num_boost_round=10,
+                     init_model=lgb.Booster(model_str=model_str),
+                     verbose_eval=False)
+    assert bst2.num_trees() == 20
+    ll2 = _logloss(y, bst2.predict(x))
+    assert ll2 < ll1
+
+
+def test_bagging_and_feature_fraction():
+    x, y = make_binary()
+    params = {"objective": "binary", "bagging_fraction": 0.6,
+              "bagging_freq": 1, "feature_fraction": 0.7,
+              "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    assert _auc(y, bst.predict(x)) > 0.9
+
+
+def test_dart():
+    x, y = make_binary()
+    params = {"objective": "binary", "boosting": "dart", "drop_rate": 0.3,
+              "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    assert _auc(y, bst.predict(x)) > 0.9
+
+
+def test_goss():
+    x, y = make_binary()
+    params = {"objective": "binary", "boosting": "goss", "top_rate": 0.3,
+              "other_rate": 0.2, "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    assert _auc(y, bst.predict(x)) > 0.9
+
+
+def test_rf():
+    x, y = make_binary()
+    params = {"objective": "binary", "boosting": "rf",
+              "bagging_fraction": 0.7, "bagging_freq": 1,
+              "feature_fraction": 0.8, "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=20, verbose_eval=False)
+    assert _auc(y, bst.predict(x)) > 0.85
+
+
+def test_monotone_constraints():
+    r = np.random.RandomState(6)
+    n = 2000
+    x = r.rand(n, 2)
+    y = 3 * x[:, 0] + r.randn(n) * 0.1
+    params = {"objective": "regression", "monotone_constraints": [1, 0],
+              "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=30, verbose_eval=False)
+    grid = np.linspace(0.05, 0.95, 30)
+    for fixed in (0.2, 0.8):
+        test_x = np.column_stack([grid, np.full(30, fixed)])
+        pred = bst.predict(test_x)
+        assert np.all(np.diff(pred) >= -1e-6)
+
+
+def test_max_depth():
+    x, y = make_binary()
+    params = {"objective": "binary", "max_depth": 3, "num_leaves": 63,
+              "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train(params, ds, num_boost_round=10, verbose_eval=False)
+    for tree in bst._gbdt.models:
+        assert tree.depth() <= 3
+
+
+def test_custom_objective_fobj():
+    x, y = make_binary()
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+
+    def fobj(preds, train_data):
+        labels = train_data.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - labels, p * (1 - p)
+
+    bst = lgb.train({"verbosity": -1, "metric": "none"}, ds, num_boost_round=30,
+                    fobj=fobj, verbose_eval=False)
+    pred_raw = bst.predict(x, raw_score=True)
+    assert _auc(y, pred_raw) > 0.9
+
+
+def test_cv():
+    x, y = make_binary()
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    res = lgb.cv({"objective": "binary", "metric": "binary_logloss",
+                  "verbosity": -1}, ds, num_boost_round=10, nfold=3,
+                 verbose_eval=False)
+    assert "binary_logloss-mean" in res
+    assert len(res["binary_logloss-mean"]) == 10
+    assert res["binary_logloss-mean"][-1] < res["binary_logloss-mean"][0]
+
+
+def test_weights():
+    x, y = make_binary()
+    w = np.where(y > 0, 2.0, 1.0)
+    ds = lgb.Dataset(x, y, weight=w, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=20, verbose_eval=False)
+    assert _auc(y, bst.predict(x)) > 0.9
+
+
+def test_feature_importance():
+    x, y = make_binary()
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=10, verbose_eval=False)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.sum() > 0
+    assert imp_gain.sum() > 0
+    # informative features dominate
+    assert imp_split[:4].sum() > imp_split[4:].sum()
+
+
+def test_constant_features():
+    x, y = make_binary(500)
+    x = np.hstack([x, np.ones((500, 2))])  # two constant columns
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=10, verbose_eval=False)
+    imp = bst.feature_importance()
+    assert imp[-1] == 0 and imp[-2] == 0
+
+
+def test_refit():
+    x, y = make_binary()
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=10, verbose_eval=False)
+    x2, y2 = make_binary(seed=99)
+    new_bst = bst.refit(x2, y2)
+    assert new_bst.num_trees() == bst.num_trees()
+    assert _auc(y2, new_bst.predict(x2)) > 0.8

@@ -1,0 +1,112 @@
+"""Model serialization tests (text format parity: reference
+gbdt_model_text.cpp / tree.cpp ToString)."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from conftest import make_binary, make_multiclass
+
+
+def test_save_load_roundtrip(tmp_path):
+    x, y = make_binary()
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=10, verbose_eval=False)
+    pred1 = bst.predict(x)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    pred2 = bst2.predict(x)
+    np.testing.assert_allclose(pred1, pred2, rtol=1e-5)
+
+
+def test_model_string_roundtrip():
+    x, y = make_binary()
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=5, verbose_eval=False)
+    s = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(x), bst2.predict(x), rtol=1e-5)
+
+
+def test_model_format_fields():
+    x, y = make_binary()
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=3, verbose_eval=False)
+    s = bst.model_to_string()
+    # v2.3.1 text-format header fields
+    assert s.startswith("tree\n")
+    for field in ("version=v3", "num_class=1", "num_tree_per_iteration=1",
+                  "max_feature_idx=", "objective=binary",
+                  "feature_names=", "feature_infos=", "tree_sizes=",
+                  "Tree=0", "end of trees", "feature importances:",
+                  "parameters:", "end of parameters"):
+        assert field in s, field
+    # per-tree fields
+    assert "num_leaves=" in s
+    assert "split_feature=" in s
+    assert "decision_type=" in s
+    assert "leaf_value=" in s
+    assert "shrinkage=" in s
+
+
+def test_multiclass_model_roundtrip(tmp_path):
+    x, y = make_multiclass()
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "multiclass", "num_class": 4,
+                     "verbosity": -1}, ds, num_boost_round=5,
+                    verbose_eval=False)
+    path = str(tmp_path / "mc.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(bst.predict(x), bst2.predict(x), rtol=1e-5)
+
+
+def test_dump_model_json():
+    x, y = make_binary()
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=3, verbose_eval=False)
+    d = bst.dump_model()
+    assert d["name"] == "tree"
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 3
+    t0 = d["tree_info"][0]
+    assert "tree_structure" in t0
+    node = t0["tree_structure"]
+    assert "split_feature" in node
+    assert "left_child" in node
+    import json
+    json.dumps(d)  # must be json-serializable
+
+
+def test_pred_leaf_and_contrib():
+    x, y = make_binary(500)
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=4, verbose_eval=False)
+    leaves = bst.predict(x[:50], pred_leaf=True)
+    assert leaves.shape == (50, 4)
+    assert leaves.min() >= 0
+    contrib = bst.predict(x[:10], pred_contrib=True)
+    assert contrib.shape == (10, x.shape[1] + 1)
+    # SHAP sums to raw prediction
+    raw = bst.predict(x[:10], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
+
+
+def test_dataset_save_binary(tmp_path):
+    x, y = make_binary(500)
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    ds.construct()
+    path = str(tmp_path / "data.bin.npz")
+    ds.save_binary(path)
+    from lightgbm_tpu.io.dataset import Dataset as InnerDataset
+    ds2 = InnerDataset.load_binary(path)
+    np.testing.assert_array_equal(ds2.binned, ds._inner.binned)
+    np.testing.assert_array_equal(ds2.metadata.label, y)

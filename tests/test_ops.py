@@ -1,0 +1,189 @@
+"""Device op tests: histogram, split scan, partition — against numpy oracles
+(the host-oracle pattern from the reference's GPU_DEBUG_COMPARE,
+gpu_tree_learner.cpp:996-1019)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops import histogram as hist_ops
+from lightgbm_tpu.ops import partition as part_ops
+from lightgbm_tpu.ops import split as split_ops
+
+
+def _ref_histogram(binned, g, h, valid, num_bins):
+    n, f = binned.shape
+    out = np.zeros((f, num_bins, 3))
+    for i in range(n):
+        if not valid[i]:
+            continue
+        for j in range(f):
+            b = binned[i, j]
+            out[j, b, 0] += g[i]
+            out[j, b, 1] += h[i]
+            out[j, b, 2] += 1
+    return out
+
+
+def test_histogram_matches_oracle():
+    r = np.random.RandomState(0)
+    n, f, b = 500, 5, 16
+    binned = r.randint(0, b, size=(n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = r.rand(n).astype(np.float32)
+    valid = np.ones(n, dtype=bool)
+    valid[450:] = False
+    gh = np.stack([g * valid, h * valid, valid.astype(np.float32)], axis=1)
+    got = np.asarray(hist_ops.build_histogram(
+        jnp.asarray(binned), jnp.asarray(gh), num_bins=b))
+    want = _ref_histogram(binned, g, h, valid, b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_histogram_chunked_matches():
+    r = np.random.RandomState(1)
+    n, f, b = 5000, 3, 8
+    binned = r.randint(0, b, size=(n, f)).astype(np.uint8)
+    gh = r.randn(n, 3).astype(np.float32)
+    gh[:, 2] = 1.0
+    a = np.asarray(hist_ops.build_histogram(
+        jnp.asarray(binned), jnp.asarray(gh), num_bins=b, chunk_size=512))
+    c = np.asarray(hist_ops.build_histogram(
+        jnp.asarray(binned), jnp.asarray(gh), num_bins=b, chunk_size=8192))
+    np.testing.assert_allclose(a, c, rtol=1e-4, atol=1e-3)
+
+
+def test_subtraction():
+    r = np.random.RandomState(2)
+    parent = r.randn(4, 8, 3).astype(np.float32)
+    child = r.randn(4, 8, 3).astype(np.float32)
+    got = np.asarray(hist_ops.subtract_histogram(
+        jnp.asarray(parent), jnp.asarray(child)))
+    np.testing.assert_allclose(got, parent - child, rtol=1e-6)
+
+
+def _ref_best_split(hist, sum_g, sum_h, n, num_bins_f, l2, min_data, min_hess):
+    """Brute-force simple split finder (no missing, no l1) for oracles."""
+    best = (-1e30, -1, -1)
+    for f in range(hist.shape[0]):
+        for t in range(num_bins_f[f] - 1):
+            gl = hist[f, : t + 1, 0].sum()
+            hl = hist[f, : t + 1, 1].sum()
+            cl = hist[f, : t + 1, 2].sum()
+            gr, hr, cr = sum_g - gl, sum_h - hl, n - cl
+            if cl < min_data or cr < min_data or hl < min_hess or hr < min_hess:
+                continue
+            gain = gl * gl / (hl + l2) + gr * gr / (hr + l2)
+            if gain > best[0]:
+                best = (gain, f, t)
+    return best
+
+
+def test_split_scan_matches_bruteforce():
+    r = np.random.RandomState(3)
+    f, b = 6, 16
+    hist = np.abs(r.randn(f, b, 3)).astype(np.float32)
+    hist[:, :, 0] = r.randn(f, b)
+    # force identical totals per feature (all features see all rows)
+    totals = hist[0].sum(axis=0)
+    for j in range(1, f):
+        hist[j] *= totals / np.maximum(hist[j].sum(axis=0), 1e-9)
+    sum_g, sum_h, n = totals
+    nbins = np.full(f, b, dtype=np.int32)
+    res = split_ops.find_best_split(
+        jnp.asarray(hist), jnp.float32(sum_g), jnp.float32(sum_h),
+        jnp.float32(n), jnp.asarray(nbins), jnp.zeros(f, jnp.int32),
+        jnp.zeros(f, jnp.int32), jnp.ones(f, bool), jnp.zeros(f, jnp.int32),
+        jnp.float32(-np.inf), jnp.float32(np.inf),
+        num_bins=b, l1=0.0, l2=1.0, max_delta_step=0.0,
+        min_data_in_leaf=1, min_sum_hessian=1e-3, min_gain_to_split=0.0)
+    want_gain, want_f, want_t = _ref_best_split(
+        hist.astype(np.float64), sum_g, sum_h, n, nbins, 1.0, 1, 1e-3)
+    parent_gain = sum_g ** 2 / (sum_h + 1.0)
+    got_gain = float(res.gain) + parent_gain  # res.gain is relative
+    assert int(res.feature) == want_f
+    assert int(res.threshold) == want_t
+    np.testing.assert_allclose(got_gain, want_gain, rtol=1e-3)
+
+
+def test_split_scan_min_data_constraint():
+    f, b = 1, 4
+    hist = np.zeros((f, b, 3), dtype=np.float32)
+    hist[0, 0] = [5.0, 2.0, 2.0]   # tiny left bin
+    hist[0, 1] = [-5.0, 50.0, 100.0]
+    hist[0, 2] = [3.0, 50.0, 100.0]
+    totals = hist[0].sum(axis=0)
+    res = split_ops.find_best_split(
+        jnp.asarray(hist), jnp.float32(totals[0]), jnp.float32(totals[1]),
+        jnp.float32(totals[2]), jnp.asarray([b], jnp.int32),
+        jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.int32),
+        jnp.ones(1, bool), jnp.zeros(1, jnp.int32),
+        jnp.float32(-np.inf), jnp.float32(np.inf),
+        num_bins=b, l1=0.0, l2=0.0, max_delta_step=0.0,
+        min_data_in_leaf=50, min_sum_hessian=1e-3, min_gain_to_split=0.0)
+    # only threshold t=1 leaves >= 50 rows on both sides
+    assert int(res.threshold) == 1
+
+
+def test_split_missing_nan_direction():
+    """NaN bin mass must flow to the default side chosen by the sweep."""
+    f, b = 1, 5
+    hist = np.zeros((f, b, 3), dtype=np.float32)
+    # bins 0..2 regular, bin 4 = NaN bin (num_bin=5 incl nan); bin 3 unused
+    hist[0, 0] = [10.0, 10.0, 10.0]
+    hist[0, 1] = [-10.0, 10.0, 10.0]
+    hist[0, 2] = [8.0, 10.0, 10.0]
+    hist[0, 4] = [20.0, 5.0, 5.0]   # NaN rows with positive grads
+    totals = hist[0].sum(axis=0)
+    res = split_ops.find_best_split(
+        jnp.asarray(hist), jnp.float32(totals[0]), jnp.float32(totals[1]),
+        jnp.float32(totals[2]), jnp.asarray([b], jnp.int32),
+        jnp.asarray([2], jnp.int32),  # MissingType::NaN
+        jnp.zeros(1, jnp.int32), jnp.ones(1, bool), jnp.zeros(1, jnp.int32),
+        jnp.float32(-np.inf), jnp.float32(np.inf),
+        num_bins=b, l1=0.0, l2=0.0, max_delta_step=0.0,
+        min_data_in_leaf=1, min_sum_hessian=0.0, min_gain_to_split=0.0)
+    # verify left+right sums partition the parent exactly
+    np.testing.assert_allclose(
+        float(res.left_sum_grad + res.right_sum_grad), totals[0], rtol=1e-5)
+    np.testing.assert_allclose(
+        float(res.left_count + res.right_count), totals[2], rtol=1e-6)
+
+
+def test_partition_stable_and_counts():
+    r = np.random.RandomState(4)
+    n, f = 300, 3
+    binned = r.randint(0, 8, size=(n, f)).astype(np.uint8)
+    buf = part_ops.make_indices_buffer(n, 512)
+    new_buf, left_cnt = part_ops.partition_step(
+        buf, jnp.asarray(binned), jnp.int32(0), jnp.int32(n),
+        jnp.int32(1), jnp.int32(3), jnp.bool_(False), jnp.int32(0),
+        jnp.int32(0), jnp.int32(8), bucket=512)
+    new_buf = np.asarray(new_buf)
+    left_cnt = int(left_cnt)
+    want_left = np.nonzero(binned[:, 1] <= 3)[0]
+    assert left_cnt == len(want_left)
+    # stability: left side keeps original relative order
+    np.testing.assert_array_equal(np.sort(new_buf[:left_cnt]), want_left)
+    got_left = new_buf[:left_cnt]
+    assert np.all(np.diff(got_left) > 0)  # stable partition of sorted input
+    # all rows still present exactly once
+    np.testing.assert_array_equal(np.sort(new_buf[:n]), np.arange(n))
+
+
+def test_partition_preserves_overrun_region():
+    n = 100
+    binned = np.zeros((n, 1), dtype=np.uint8)
+    binned[:50, 0] = 1
+    buf = part_ops.make_indices_buffer(n, 256)
+    # partition only the first 60 rows with a window that overruns into rows 60+
+    new_buf, left_cnt = part_ops.partition_step(
+        buf, jnp.asarray(binned), jnp.int32(0), jnp.int32(60),
+        jnp.int32(0), jnp.int32(0), jnp.bool_(False), jnp.int32(0),
+        jnp.int32(0), jnp.int32(2), bucket=256)
+    new_buf = np.asarray(new_buf)
+    # rows 60..99 untouched
+    np.testing.assert_array_equal(new_buf[60:100], np.arange(60, 100))
+    # rows 50..59 have bin 0 -> left; rows 0..49 bin 1 -> right
+    assert int(left_cnt) == 10
+    np.testing.assert_array_equal(new_buf[:10], np.arange(50, 60))

@@ -1,0 +1,108 @@
+"""sklearn-wrapper conformance (reference: test_sklearn.py patterns)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from conftest import make_binary, make_multiclass, make_ranking, make_regression
+
+
+def test_regressor():
+    x, y = make_regression()
+    m = lgb.LGBMRegressor(n_estimators=30, verbosity=-1)
+    m.fit(x, y, verbose=False)
+    pred = m.predict(x)
+    assert float(np.mean((y - pred) ** 2)) < 0.5
+    assert m.n_features_ == x.shape[1]
+    assert len(m.feature_importances_) == x.shape[1]
+
+
+def test_classifier_binary():
+    x, y = make_binary()
+    m = lgb.LGBMClassifier(n_estimators=30, verbosity=-1)
+    m.fit(x, y, verbose=False)
+    pred = m.predict(x)
+    assert set(np.unique(pred)) <= set(np.unique(y))
+    proba = m.predict_proba(x)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    acc = float(np.mean(pred == y))
+    assert acc > 0.9
+    assert m.n_classes_ == 2
+
+
+def test_classifier_multiclass():
+    x, y = make_multiclass()
+    m = lgb.LGBMClassifier(n_estimators=20, verbosity=-1)
+    m.fit(x, y, verbose=False)
+    proba = m.predict_proba(x)
+    assert proba.shape == (len(y), 4)
+    acc = float(np.mean(m.predict(x) == y))
+    assert acc > 0.85
+
+
+def test_classifier_string_labels():
+    x, y = make_binary()
+    ys = np.where(y > 0, "yes", "no")
+    m = lgb.LGBMClassifier(n_estimators=15, verbosity=-1)
+    m.fit(x, ys, verbose=False)
+    pred = m.predict(x)
+    assert set(np.unique(pred)) <= {"yes", "no"}
+    assert float(np.mean(pred == ys)) > 0.9
+
+
+def test_ranker():
+    x, y, group = make_ranking()
+    m = lgb.LGBMRanker(n_estimators=20, verbosity=-1)
+    m.fit(x, y, group=group, verbose=False)
+    pred = m.predict(x)
+    assert pred.shape == (len(y),)
+    assert np.corrcoef(pred, y)[0, 1] > 0.3
+
+
+def test_early_stopping_sklearn():
+    x, y = make_binary(3000)
+    m = lgb.LGBMClassifier(n_estimators=200, verbosity=-1)
+    m.fit(x[:2000], y[:2000], eval_set=[(x[2000:], y[2000:])],
+          early_stopping_rounds=5, verbose=False)
+    assert m.best_iteration_ > 0
+
+
+def test_eval_results_recorded():
+    x, y = make_binary()
+    m = lgb.LGBMClassifier(n_estimators=10, verbosity=-1)
+    m.fit(x[:1500], y[:1500], eval_set=[(x[1500:], y[1500:])],
+          verbose=False)
+    assert "valid_0" in m.evals_result_
+    assert "binary_logloss" in m.evals_result_["valid_0"]
+    assert len(m.evals_result_["valid_0"]["binary_logloss"]) == 10
+
+
+def test_get_set_params():
+    m = lgb.LGBMClassifier(num_leaves=63, learning_rate=0.05)
+    params = m.get_params()
+    assert params["num_leaves"] == 63
+    m.set_params(num_leaves=15)
+    assert m.get_params()["num_leaves"] == 15
+
+
+def test_class_weight_balanced():
+    x, y = make_binary()
+    keep = np.concatenate([np.nonzero(y > 0)[0][:200], np.nonzero(y <= 0)[0]])
+    xs, ys = x[keep], y[keep]
+    m = lgb.LGBMClassifier(n_estimators=15, class_weight="balanced",
+                           verbosity=-1)
+    m.fit(xs, ys, verbose=False)
+    assert float(np.mean(m.predict(xs) == ys)) > 0.8
+
+
+def test_custom_eval_metric():
+    x, y = make_binary()
+
+    def brier(y_true, y_pred):
+        return "brier", float(np.mean((y_pred - y_true) ** 2)), False
+
+    m = lgb.LGBMClassifier(n_estimators=10, verbosity=-1)
+    m.fit(x[:1500], y[:1500], eval_set=[(x[1500:], y[1500:])],
+          eval_metric=brier, verbose=False)
+    assert "brier" in m.evals_result_["valid_0"]
