@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Benchmark: Higgs-style binary classification training throughput.
+
+Mirrors the reference's headline benchmark setup (docs/Experiments.rst:103:
+Higgs 10.5M x 28, 255 leaves, 500 iters, 238.5 s on 2x E5-2670v3 =>
+22.0M row-trees/sec). We train the same shape of problem (28 features,
+255 leaves, 63 bins like the GPU experiments) on a size that fits the bench
+budget and report throughput in row-trees/sec vs that baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+N_FEATURES = 28
+N_ITERS = int(os.environ.get("BENCH_ITERS", 50))
+WARMUP_ITERS = int(os.environ.get("BENCH_WARMUP", 5))
+BASELINE_ROWTREES_PER_SEC = 10_500_000 * 500 / 238.505  # reference Higgs CPU
+
+
+def make_higgs_like(n, f, seed=17):
+    """Synthetic stand-in with Higgs-like statistics: mixed informative /
+    noise features, moderately separable classes."""
+    r = np.random.RandomState(seed)
+    x = r.randn(n, f).astype(np.float32)
+    w = r.randn(f) * (r.rand(f) > 0.4)
+    logit = x @ w * 0.3 + 0.2 * x[:, 0] * x[:, 1] - 0.1 * x[:, 2] ** 2
+    y = (logit + r.randn(n) * 1.5 > 0).astype(np.float64)
+    return x, y
+
+
+def main():
+    t_setup = time.time()
+    import lightgbm_tpu as lgb
+
+    x, y = make_higgs_like(N_ROWS, N_FEATURES)
+    params = {
+        "objective": "binary",
+        "num_leaves": 255,
+        "learning_rate": 0.1,
+        "max_bin": 63,
+        "metric": "none",
+        "verbosity": -1,
+        "min_data_in_leaf": 20,
+    }
+    ds = lgb.Dataset(x, y)
+    ds.construct()
+    sys.stderr.write(f"setup {time.time()-t_setup:.1f}s\n")
+
+    booster = lgb.Booster(params=params, train_set=ds)
+    t_warm = time.time()
+    for _ in range(WARMUP_ITERS):
+        booster.update()
+    sys.stderr.write(
+        f"warmup ({WARMUP_ITERS} iters, incl. compile) {time.time()-t_warm:.1f}s\n")
+
+    t0 = time.time()
+    for _ in range(N_ITERS):
+        booster.update()
+    elapsed = time.time() - t0
+    iters_per_sec = N_ITERS / elapsed
+    rowtrees_per_sec = N_ROWS * iters_per_sec
+
+    # sanity: the model must actually learn
+    s = booster.predict(x[:100_000], raw_score=True)
+    yy = y[:100_000]
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = yy > 0
+    auc = float((ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
+                / max(pos.sum() * (~pos).sum(), 1))
+    sys.stderr.write(f"train AUC (100k sample): {auc:.4f}\n")
+    assert auc > 0.60, "model failed to learn"
+
+    print(json.dumps({
+        "metric": "higgs_like_train_throughput",
+        "value": round(rowtrees_per_sec, 1),
+        "unit": "row-trees/sec",
+        "vs_baseline": round(rowtrees_per_sec / BASELINE_ROWTREES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

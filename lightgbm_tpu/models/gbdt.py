@@ -109,7 +109,11 @@ class GBDT:
         else:
             self.num_class = max(1, cfg.num_class)
         self.num_tree_per_iteration = self.num_class
-        self.learner = SerialTreeLearner(cfg, train_set)
+        if cfg.tree_learner == "serial":
+            self.learner = SerialTreeLearner(cfg, train_set)
+        else:
+            from ..parallel.learners import create_tree_learner
+            self.learner = create_tree_learner(cfg, train_set)
         self.score_updater = ScoreUpdater(train_set, self.num_class)
         self.num_data = train_set.num_data
         self.train_metrics = create_metrics(cfg.metric, cfg, cfg.objective)

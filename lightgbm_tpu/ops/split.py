@@ -85,11 +85,7 @@ def _split_gains(gl, hl, gr, hr, l1, l2, mds, min_c, max_c, mono):
     return jnp.where(violate, 0.0, gain)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_bins", "l1", "l2", "max_delta_step",
-                     "min_data_in_leaf", "min_sum_hessian", "min_gain_to_split"))
-def find_best_split(
+def per_feature_best(
     hist: jax.Array,            # (F, B, 3) f32 [sum_grad, sum_hess, count]
     sum_grad: jax.Array,        # scalar: leaf total gradient
     sum_hess: jax.Array,        # scalar: leaf total hessian
@@ -105,7 +101,11 @@ def find_best_split(
     num_bins: int,
     l1: float, l2: float, max_delta_step: float,
     min_data_in_leaf: int, min_sum_hessian: float, min_gain_to_split: float,
-) -> SplitResult:
+):
+    """Per-feature best (gain, threshold, default_left) plus the prefix
+    tensors needed to materialize a winner. This is the unit the parallel
+    learners reduce over (reference: the per-feature OMP loop in
+    FindBestSplitsFromHistograms, serial_tree_learner.cpp:524-605)."""
     f, b, _ = hist.shape
     tgrid = jnp.arange(b, dtype=jnp.int32)[None, :]          # thresholds (1, B)
     nbins = feature_num_bins[:, None]                        # (F, 1)
@@ -187,12 +187,22 @@ def find_best_split(
     use_m1 = best_f_m1 >= best_f_p1
     per_feature_gain = jnp.where(use_m1, best_f_m1, best_f_p1)
     per_feature_t = jnp.where(use_m1, best_t_m1, best_t_p1)
+    # relative gains (reference: output->gain -= min_gain_shift)
+    per_feature_rel = jnp.where(per_feature_gain > NEG_INF / 2,
+                                per_feature_gain - min_gain_shift, NEG_INF)
+    prefix = (gl1, hl1, cl1, gr_m1, hr_m1, cr_m1)
+    return per_feature_rel, per_feature_t, use_m1, prefix
 
-    feat = jnp.argmax(per_feature_gain).astype(jnp.int32)
-    gain = per_feature_gain[feat]
+
+def materialize_split(feat, per_feature_rel, per_feature_t, use_m1, prefix,
+                      sum_grad, sum_hess, num_data,
+                      min_constraint, max_constraint,
+                      *, l1, l2, max_delta_step) -> SplitResult:
+    """Build the full SplitResult for one chosen feature."""
+    gl1, hl1, cl1, gr_m1, hr_m1, cr_m1 = prefix
+    gain = per_feature_rel[feat]
     thr = per_feature_t[feat]
     dleft = use_m1[feat]
-
     lg = jnp.where(dleft, sum_grad - gr_m1[feat, thr], gl1[feat, thr])
     lh = jnp.where(dleft, sum_hess - hr_m1[feat, thr], hl1[feat, thr])
     lc = jnp.where(dleft, num_data - cr_m1[feat, thr], cl1[feat, thr])
@@ -203,11 +213,35 @@ def find_best_split(
                                   min_constraint, max_constraint)
     ro = _leaf_output_constrained(rg, rh, l1, l2, max_delta_step,
                                   min_constraint, max_constraint)
-    # reported gain is relative to keeping the leaf whole (reference
-    # FindBestThresholdNumerical: output->gain -= min_gain_shift)
-    rel_gain = jnp.where(gain > NEG_INF / 2, gain - min_gain_shift, NEG_INF)
-    return SplitResult(rel_gain, feat, thr, dleft,
+    return SplitResult(gain, feat.astype(jnp.int32), thr, dleft,
                        lg, lh, lc, rg, rh, rc, lo, ro)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "l1", "l2", "max_delta_step",
+                     "min_data_in_leaf", "min_sum_hessian", "min_gain_to_split"))
+def find_best_split(
+    hist: jax.Array, sum_grad: jax.Array, sum_hess: jax.Array,
+    num_data: jax.Array, feature_num_bins: jax.Array,
+    feature_missing: jax.Array, feature_default_bins: jax.Array,
+    feature_mask: jax.Array, monotone: jax.Array,
+    min_constraint: jax.Array, max_constraint: jax.Array,
+    *, num_bins: int, l1: float, l2: float, max_delta_step: float,
+    min_data_in_leaf: int, min_sum_hessian: float, min_gain_to_split: float,
+) -> SplitResult:
+    per_feature_rel, per_feature_t, use_m1, prefix = per_feature_best(
+        hist, sum_grad, sum_hess, num_data, feature_num_bins,
+        feature_missing, feature_default_bins, feature_mask, monotone,
+        min_constraint, max_constraint,
+        num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split)
+    feat = jnp.argmax(per_feature_rel).astype(jnp.int32)
+    return materialize_split(
+        feat, per_feature_rel, per_feature_t, use_m1, prefix,
+        sum_grad, sum_hess, num_data, min_constraint, max_constraint,
+        l1=l1, l2=l2, max_delta_step=max_delta_step)
 
 
 def calculate_leaf_output(sum_grad, sum_hess, l1, l2, max_delta_step):

@@ -1,0 +1,50 @@
+"""Device mesh construction for distributed training.
+
+The reference builds a TCP/MPI machine mesh (reference:
+src/network/linkers_socket.cpp Construct full-mesh handshake); here the mesh
+is a jax.sharding.Mesh over local + remote devices — ICI within a slice, DCN
+across hosts — and every collective is an XLA op.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import log
+
+
+def make_mesh(num_devices: Optional[int] = None, axis_name: str = "data",
+              devices=None) -> Mesh:
+    """1-D mesh over the first num_devices devices (default: all)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def make_mesh_2d(data: int, feature: int, devices=None) -> Mesh:
+    """2-D (data, feature) mesh — the grid the reference's parallel modes
+    decompose over (rows x features)."""
+    devs = list(devices if devices is not None else jax.devices())
+    log.check(len(devs) >= data * feature, "not enough devices for mesh")
+    arr = np.array(devs[: data * feature]).reshape(data, feature)
+    return Mesh(arr, ("data", "feature"))
+
+
+def shard_rows(mesh: Mesh, arr, axis_name: str = "data"):
+    return jax.device_put(arr, NamedSharding(mesh, P(axis_name) if arr.ndim == 1
+                                             else P(axis_name, None)))
+
+
+def shard_features(mesh: Mesh, arr, axis_name: str = "feature"):
+    if arr.ndim == 1:
+        return jax.device_put(arr, NamedSharding(mesh, P(axis_name)))
+    return jax.device_put(arr, NamedSharding(mesh, P(None, axis_name)))
+
+
+def replicate(mesh: Mesh, arr):
+    return jax.device_put(arr, NamedSharding(mesh, P()))

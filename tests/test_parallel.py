@@ -1,0 +1,95 @@
+"""Distributed learner tests on the virtual 8-device CPU mesh.
+
+Mirrors what the reference leaves untested (SURVEY.md §4: no automated
+distributed tests) and does better: every parallel mode must agree with the
+serial learner on the same data (the parallel modes are exact algorithms,
+not approximations — except voting, which is validated by quality)."""
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset as InnerDataset
+from lightgbm_tpu.models.gbdt import create_boosting
+
+from conftest import make_binary
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0
+    return float((ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
+                 / (pos.sum() * (~pos).sum()))
+
+
+def _train(x, y, tree_learner, rounds=8, **extra):
+    params = {"objective": "binary", "tree_learner": tree_learner,
+              "verbosity": -1, "num_leaves": 15, "min_data_in_leaf": 5}
+    params.update(extra)
+    cfg = Config(params)
+    ds = InnerDataset(x, config=cfg, label=y)
+    b = create_boosting(cfg, ds)
+    for _ in range(rounds):
+        b.train_one_iter()
+    return b
+
+
+def test_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def test_data_parallel_matches_serial():
+    x, y = make_binary(1600, 8)
+    bs = _train(x, y, "serial")
+    bd = _train(x, y, "data")
+    ps = bs.predict(x, raw_score=True)
+    pd = bd.predict(x, raw_score=True)
+    # same algorithm, different reduction order -> near-identical trees
+    np.testing.assert_allclose(ps, pd, rtol=2e-2, atol=2e-2)
+    # structural agreement on the first tree's root split
+    t_s, t_d = bs.models[0], bd.models[0]
+    assert t_s.split_feature[0] == t_d.split_feature[0]
+    assert t_s.threshold_in_bin[0] == t_d.threshold_in_bin[0]
+
+
+def test_feature_parallel_matches_serial():
+    x, y = make_binary(1200, 10)
+    bs = _train(x, y, "serial")
+    bf = _train(x, y, "feature")
+    ps = bs.predict(x, raw_score=True)
+    pf = bf.predict(x, raw_score=True)
+    np.testing.assert_allclose(ps, pf, rtol=2e-2, atol=2e-2)
+    t_s, t_f = bs.models[0], bf.models[0]
+    assert t_s.split_feature[0] == t_f.split_feature[0]
+
+
+def test_voting_parallel_quality():
+    x, y = make_binary(2000, 12)
+    bv = _train(x, y, "voting", rounds=15, top_k=4)
+    auc = _auc(y, bv.predict(x, raw_score=True))
+    assert auc > 0.9
+
+
+def test_data_parallel_with_bagging():
+    x, y = make_binary(1500, 8)
+    bd = _train(x, y, "data", rounds=10, bagging_fraction=0.7, bagging_freq=1)
+    assert _auc(y, bd.predict(x, raw_score=True)) > 0.9
+
+
+def test_data_parallel_leaf_counts_exact():
+    """Global leaf counts across shards must sum to the bagged row count."""
+    x, y = make_binary(1000, 6)
+    params = {"objective": "binary", "tree_learner": "data",
+              "verbosity": -1, "num_leaves": 8}
+    cfg = Config(params)
+    ds = InnerDataset(x, config=cfg, label=y)
+    b = create_boosting(cfg, ds)
+    b.train_one_iter()
+    learner = b.learner
+    total = sum(int(c.sum()) for leaf, c in learner._leaf_count.items()
+                if leaf in learner.leaves)
+    assert total == 1000
