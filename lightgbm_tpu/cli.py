@@ -1,0 +1,171 @@
+"""Command-line application: train / predict / convert_model / refit.
+
+Equivalent of the reference CLI (reference: src/main.cpp,
+src/application/application.cpp:30-261). Usage matches the reference:
+
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+    lightgbm-tpu task=train data=binary.train objective=binary ...
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Dict
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config, parse_config_str
+from .utils import log
+
+
+def parse_cli_args(argv) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            log.warning("Unknown argument: %s", arg)
+            continue
+        k, v = arg.split("=", 1)
+        params[k.strip()] = v.strip()
+    # config file first, CLI args override (reference: main.cpp + config.cpp)
+    if "config" in params:
+        path = params.pop("config")
+        with open(path) as f:
+            file_params = {}
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if line and "=" in line:
+                    k, v = line.split("=", 1)
+                    file_params[k.strip()] = v.strip()
+        file_params.update(params)
+        params = file_params
+    return params
+
+
+def run(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    params = parse_cli_args(argv)
+    cfg = Config(params)
+    if cfg.task in ("train", "refit"):
+        _train(params, cfg)
+    elif cfg.task in ("predict",):
+        _predict(params, cfg)
+    elif cfg.task == "convert_model":
+        _convert_model(params, cfg)
+    else:
+        log.fatal("Unknown task: %s", cfg.task)
+    return 0
+
+
+def _init_network(cfg: Config) -> None:
+    if cfg.num_machines > 1:
+        from .parallel import network
+        machines = cfg.machines
+        if not machines and cfg.machine_list_filename:
+            with open(cfg.machine_list_filename) as f:
+                machines = ",".join(
+                    line.strip().replace(" ", ":") for line in f
+                    if line.strip())
+        network.init_from_params(machines, cfg.local_listen_port,
+                                 cfg.num_machines)
+
+
+def _train(params: Dict[str, str], cfg: Config) -> None:
+    _init_network(cfg)
+    if not cfg.data:
+        log.fatal("No training data: set data=<file>")
+    t0 = time.time()
+    train_set = Dataset(cfg.data, params=params)
+    train_set.construct()
+    log.info("Finished loading data in %.3f seconds", time.time() - t0)
+    booster = Booster(params=params, train_set=train_set)
+    for i, vpath in enumerate(cfg.valid or []):
+        vset = train_set.create_valid(vpath)
+        booster.add_valid(vset, f"valid_{i + 1}" if i else "valid_1")
+    if cfg.task == "refit":
+        if not cfg.input_model:
+            log.fatal("task=refit requires input_model")
+        prev = Booster(model_file=cfg.input_model)
+        x, y, _ = _load_matrix(cfg.data)
+        refitted = prev.refit(x, y)
+        refitted.save_model(cfg.output_model)
+        log.info("Refit model saved to %s", cfg.output_model)
+        return
+    if cfg.input_model:
+        from .engine import _load_init_model
+        _load_init_model(booster, cfg.input_model)
+    num_iters = cfg.num_iterations
+    metric_freq = max(1, cfg.metric_freq)
+    snapshot_freq = cfg.snapshot_freq
+    t0 = time.time()
+    for it in range(booster.current_iteration(), num_iters):
+        t_it = time.time()
+        stop = booster.update()
+        log.info("%.6f seconds elapsed, finished iteration %d",
+                 time.time() - t_it, it + 1)
+        if (it + 1) % metric_freq == 0:
+            for dname, mname, val, _ in booster.eval():
+                log.info("Iteration:%d, %s %s : %g", it + 1, dname, mname, val)
+        if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+            booster.save_model(f"{cfg.output_model}.snapshot_iter_{it + 1}")
+        if stop:
+            break
+    log.info("Finished training in %.3f seconds", time.time() - t0)
+    booster.save_model(cfg.output_model)
+    log.info("Model saved to %s", cfg.output_model)
+
+
+def _load_matrix(path: str):
+    from .io.parser import parse_file
+    return parse_file(path)
+
+
+def _predict(params: Dict[str, str], cfg: Config) -> None:
+    if not cfg.input_model:
+        log.fatal("task=predict requires input_model")
+    if not cfg.data:
+        log.fatal("No prediction data: set data=<file>")
+    booster = Booster(model_file=cfg.input_model)
+    x, _, _ = _load_matrix(cfg.data)
+    t0 = time.time()
+    preds = booster.predict(
+        x, raw_score=cfg.predict_raw_score,
+        pred_leaf=cfg.predict_leaf_index,
+        pred_contrib=cfg.predict_contrib,
+        num_iteration=cfg.num_iteration_predict
+        if cfg.num_iteration_predict > 0 else None)
+    log.info("Finished prediction in %.3f seconds", time.time() - t0)
+    out = cfg.output_result or "LightGBM_predict_result.txt"
+    preds = np.atleast_2d(np.asarray(preds))
+    if preds.shape[0] == 1 and preds.size > preds.shape[1]:
+        preds = preds.T
+    if preds.ndim == 1:
+        preds = preds.reshape(-1, 1)
+    if preds.shape[0] != x.shape[0]:
+        preds = preds.reshape(x.shape[0], -1)
+    with open(out, "w") as f:
+        for row in preds:
+            f.write("\t".join(f"{v:g}" for v in np.atleast_1d(row)) + "\n")
+    log.info("Prediction results saved to %s", out)
+
+
+def _convert_model(params: Dict[str, str], cfg: Config) -> None:
+    """Model -> C++ if-else source (reference: gbdt_model_text.cpp:128
+    ModelToIfElse)."""
+    if not cfg.input_model:
+        log.fatal("task=convert_model requires input_model")
+    booster = Booster(model_file=cfg.input_model)
+    out = cfg.convert_model or "gbdt_prediction.cpp"
+    from .io.codegen import model_to_ifelse
+    with open(out, "w") as f:
+        f.write(model_to_ifelse(booster._gbdt))
+    log.info("Converted model saved to %s", out)
+
+
+def main():
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

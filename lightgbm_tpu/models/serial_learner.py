@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.binning import BIN_CATEGORICAL
 from ..io.dataset import Dataset
+from ..ops import fused as fused_ops
 from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
 from ..ops import split as split_ops
@@ -75,7 +76,8 @@ class SerialTreeLearner:
         self._has_categorical = any(
             dataset.bin_mappers[f].bin_type == BIN_CATEGORICAL
             for f in dataset.used_features)
-        self._use_pallas = bool(int(_env("LGBM_TPU_PALLAS_HIST", "1")))
+        default_pallas = "1" if jax.default_backend() == "tpu" else "0"
+        self._use_pallas = bool(int(_env("LGBM_TPU_PALLAS_HIST", default_pallas)))
         self._mono_enabled = bool(np.any(np.asarray(self.f_monotone) != 0))
 
     # ------------------------------------------------------------------
@@ -183,29 +185,36 @@ class SerialTreeLearner:
     def train(self, grad: jax.Array, hess: jax.Array,
               bag_indices: Optional[np.ndarray] = None,
               iter_seed: int = 0) -> Tree:
+        """Grow one tree. Per split: ONE fused device program (partition +
+        left-child histogram + sibling subtraction + both child scans) and
+        ONE small host fetch — see ops/fused.py."""
         cfg = self.config
         ds = self.dataset
         n = ds.num_data
-        if bag_indices is not None:
-            bag_cnt = len(bag_indices)
-        else:
-            bag_cnt = n
+        bag_cnt = n if bag_indices is None else len(bag_indices)
         indices_buf = part_ops.make_indices_buffer(n, self.max_bucket, bag_indices)
         rng = np.random.RandomState(
             (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
         base_mask = self._feature_mask(rng)
+        self._numerical_mask_np = base_mask  # node-level resample below
 
         tree = Tree(cfg.num_leaves)
-        root_hist = self._build_hist(indices_buf, grad, hess, 0, bag_cnt)
-        totals = jax.device_get(root_hist[0].sum(axis=0))
+        root_hist, totals_dev, root_res = fused_ops.fused_root_step(
+            indices_buf, self.binned, grad, hess, jnp.int32(bag_cnt),
+            self._fused_meta(base_mask, rng),
+            bucket=_bucket(bag_cnt, self.max_bucket),
+            use_pallas=self._use_pallas, **self._scan_args())
+        totals = jax.device_get(totals_dev)
         root = _LeafState(0, bag_cnt, float(totals[0]), float(totals[1]), 0)
         root.hist = root_hist
-        root.split = self._scan_leaf(root, self._node_feature_mask(base_mask, rng))
+        root.split = self._fetch_split(jax.device_get(root_res))
+        if self._has_categorical:
+            self._merge_categorical(root, base_mask, rng)
         leaves: Dict[int, _LeafState] = {0: root}
 
         for _split_idx in range(cfg.num_leaves - 1):
             # pick the splittable leaf with max gain (leaf-wise growth)
-            best_leaf, best_gain = -1, 1e-10  # kEpsilon threshold: gain must be > 0
+            best_leaf, best_gain = -1, 1e-10
             for li, st in leaves.items():
                 if st.split is not None and st.split["gain"] > best_gain:
                     best_leaf, best_gain = li, st.split["gain"]
@@ -215,45 +224,78 @@ class SerialTreeLearner:
                         "No further splits with positive gain, best gain: %f",
                         best_gain)
                 break
-            st = leaves[best_leaf]
-            sp = st.split
-            self._apply_split(tree, leaves, best_leaf, sp, indices_buf,
-                              grad, hess, base_mask, rng)
-            indices_buf = self._last_indices_buf
+            indices_buf = self._apply_split(
+                tree, leaves, best_leaf, indices_buf, grad, hess,
+                base_mask, rng)
 
         self.indices_buf = indices_buf
         self.leaves = leaves
         return tree
 
+    def _fused_meta(self, base_mask, rng):
+        mask = self._node_feature_mask(base_mask, rng) & (self.f_categorical == 0)
+        return (self.f_numbins, self.f_missing, self.f_default, mask,
+                self.f_monotone)
+
+    def _merge_categorical(self, st: "_LeafState", base_mask, rng) -> None:
+        """Categorical split search runs as a separate (rarer) program and
+        merges with the numerical winner on host."""
+        feature_mask = jnp.asarray(base_mask) & (self.f_categorical == 1)
+        cres = split_ops.find_best_split_categorical(
+            st.hist, jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
+            jnp.float32(st.count), self.f_numbins, self.f_missing,
+            feature_mask, jnp.float32(st.min_c), jnp.float32(st.max_c),
+            **self._cat_scan_args())
+        crec = self._fetch_split(jax.device_get(cres), categorical=True)
+        if st.split is None or crec["gain"] > st.split["gain"]:
+            st.split = crec
+
     def _apply_split(self, tree: Tree, leaves: Dict[int, _LeafState],
-                     leaf_id: int, sp: dict, indices_buf,
-                     grad, hess, base_mask, rng) -> None:
+                     leaf_id: int, indices_buf, grad, hess,
+                     base_mask, rng):
         ds = self.dataset
-        cfg = self.config
         st = leaves[leaf_id]
+        sp = st.split
         inner_f = sp["feature"]
         real_f = ds.inner_to_real(inner_f)
         mapper = ds.bin_mappers[real_f]
         bucket = _bucket(st.count, self.max_bucket)
 
-        if not sp["categorical"]:
-            new_buf, left_cnt_dev = part_ops.partition_step(
-                indices_buf, self.binned, jnp.int32(st.begin),
-                jnp.int32(st.count), jnp.int32(inner_f),
-                jnp.int32(sp["threshold"]), jnp.bool_(sp["default_left"]),
-                jnp.int32(mapper.missing_type), jnp.int32(mapper.default_bin),
-                jnp.int32(mapper.num_bin), bucket=bucket)
-        else:
-            bitset_words = jnp.asarray(
-                sp["cat_bitset_inner"].view(np.int32))
-            new_buf, left_cnt_dev = part_ops.partition_step_categorical(
-                indices_buf, self.binned, jnp.int32(st.begin),
-                jnp.int32(st.count), jnp.int32(inner_f), bitset_words,
-                bucket=bucket)
-        self._last_indices_buf = new_buf
-        left_cnt = int(jax.device_get(left_cnt_dev))
-        # partition and scan counts can differ by padding rounding only if
-        # something is wrong — guard it
+        # children constraints; monotone propagation (basic mode,
+        # reference serial_tree_learner.cpp:771-852)
+        lmin, lmax, rmin, rmax = st.min_c, st.max_c, st.min_c, st.max_c
+        mono = int(np.asarray(self.f_monotone)[inner_f]) if self._mono_enabled else 0
+        if mono != 0:
+            mid = (sp["left_output"] + sp["right_output"]) / 2.0
+            if mono > 0:
+                lmax, rmin = min(lmax, mid), max(rmin, mid)
+            else:
+                lmin, rmax = max(lmin, mid), min(rmax, mid)
+
+        bits = np.zeros(8, dtype=np.uint32)
+        if sp["categorical"]:
+            src = sp["cat_bitset_inner"][:8]
+            bits[: len(src)] = src
+        iparams = np.zeros(15, dtype=np.int32)
+        iparams[:9] = [st.begin, st.count, inner_f, sp["threshold"],
+                       int(sp["default_left"]), mapper.missing_type,
+                       mapper.default_bin, mapper.num_bin,
+                       int(sp["categorical"])]
+        fparams = np.asarray(
+            [sp["left_sum_grad"], sp["left_sum_hess"], sp["left_count"],
+             sp["right_sum_grad"], sp["right_sum_hess"], sp["right_count"],
+             lmin, lmax, rmin, rmax], dtype=np.float32)
+        out = fused_ops.fused_split_step(
+            indices_buf, self.binned, grad, hess,
+            jnp.asarray(iparams), jnp.asarray(bits.view(np.int32)),
+            jnp.asarray(fparams), st.hist,
+            self._fused_meta(base_mask, rng),
+            bucket=bucket, use_pallas=self._use_pallas, **self._scan_args())
+
+        # ONE host fetch per split: left_count + the two winner tuples
+        left_cnt, left_rec_raw, right_rec_raw = jax.device_get(
+            (out.left_count, out.left_res, out.right_res))
+        left_cnt = int(left_cnt)
         if left_cnt != sp["left_count"]:
             log.debug("partition/scan count mismatch: %d vs %d",
                       left_cnt, sp["left_count"])
@@ -268,7 +310,6 @@ class SerialTreeLearner:
                 sp["gain"], mapper.missing_type, sp["default_left"])
         else:
             inner_bits = sp["cat_bitset_inner"]
-            # real-category bitset: map inner bins -> category values
             cats = [mapper.bin_2_categorical[b]
                     for b in _bits_set(inner_bits)
                     if b < len(mapper.bin_2_categorical)]
@@ -280,47 +321,32 @@ class SerialTreeLearner:
                 sp["right_count"], sp["left_sum_hess"], sp["right_sum_hess"],
                 sp["gain"], mapper.missing_type)
 
-        # children states; monotone constraint propagation (basic mode,
-        # reference serial_tree_learner.cpp:771-852)
-        lmin, lmax, rmin, rmax = st.min_c, st.max_c, st.min_c, st.max_c
-        mono = int(np.asarray(self.f_monotone)[inner_f]) if self._mono_enabled else 0
-        if mono != 0:
-            mid = (sp["left_output"] + sp["right_output"]) / 2.0
-            if mono > 0:
-                lmax = min(lmax, mid)
-                rmin = max(rmin, mid)
-            else:
-                lmin = max(lmin, mid)
-                rmax = min(rmax, mid)
         left = _LeafState(st.begin, sp["left_count"], sp["left_sum_grad"],
                           sp["left_sum_hess"], st.depth + 1, lmin, lmax)
         right = _LeafState(st.begin + sp["left_count"], sp["right_count"],
                            sp["right_sum_grad"], sp["right_sum_hess"],
                            st.depth + 1, rmin, rmax)
-
-        # histogram subtraction: build smaller fresh, larger = parent - smaller
-        smaller, larger = (left, right) if left.count <= right.count else (right, left)
-        if self._splittable(smaller, tree):
-            smaller.hist = self._build_hist(
-                self._last_indices_buf, grad, hess, smaller.begin, smaller.count)
-        if self._splittable(larger, tree):
-            if smaller.hist is not None:
-                larger.hist = hist_ops.subtract_histogram(st.hist, smaller.hist)
-            else:
-                larger.hist = self._build_hist(
-                    self._last_indices_buf, grad, hess, larger.begin, larger.count)
+        left.hist = out.left_hist
+        right.hist = out.right_hist
+        left.split = (self._fetch_split(left_rec_raw)
+                      if self._splittable(left, tree) else None)
+        right.split = (self._fetch_split(right_rec_raw)
+                       if self._splittable(right, tree) else None)
+        if self._has_categorical:
+            if left.split is not None:
+                self._merge_categorical(left, base_mask, rng)
+            if right.split is not None:
+                self._merge_categorical(right, base_mask, rng)
         st.hist = None  # release parent histogram
-
-        for child in (smaller, larger):
-            if child.hist is not None:
-                child.split = self._scan_leaf(
-                    child, self._node_feature_mask(base_mask, rng))
-            else:
-                child.split = None
+        if left.split is None:
+            left.hist = None
+        if right.split is None:
+            right.hist = None
 
         leaves[leaf_id] = left
         leaves[tree.num_leaves - 1] = right
         assert tree.num_leaves - 1 == new_leaf
+        return out.indices_buf
 
     def _splittable(self, leaf: _LeafState, tree: Tree) -> bool:
         cfg = self.config

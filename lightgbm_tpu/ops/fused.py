@@ -1,0 +1,151 @@
+"""Fused per-split device program.
+
+One split of leaf-wise growth = partition + child histogram + sibling
+subtraction + two split scans. The reference runs these as separate host
+phases (serial_tree_learner.cpp:400-605); a GPU pays a kernel launch per
+phase, and a tunneled TPU pays a host round-trip. Fusing them into a single
+jitted program leaves exactly ONE dispatch and ONE small host fetch
+(left_count + two winner tuples) per split — the histograms stay on device
+for the children's future splits.
+
+The left child's histogram is built fresh from the parent window (rows not
+going left contribute zero weight); the right child's comes from parent
+subtraction (reference FeatureHistogram::Subtract). Numerical and
+categorical partition decisions are both evaluated and selected by a scalar
+flag — no control flow divergence under jit.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from . import split as split_ops
+from .histogram import build_histogram
+from .partition import decide_left
+
+
+class FusedStepOut(NamedTuple):
+    indices_buf: jax.Array
+    left_count: jax.Array
+    left_hist: jax.Array
+    right_hist: jax.Array
+    left_res: split_ops.SplitResult
+    right_res: split_ops.SplitResult
+
+
+def _scan(hist, sg, sh, cnt, meta, min_c, max_c, scan_kwargs):
+    (f_numbins, f_missing, f_default, feature_mask, monotone) = meta
+    return split_ops.find_best_split.__wrapped__(
+        hist, sg, sh, cnt, f_numbins, f_missing, f_default, feature_mask,
+        monotone, min_c, max_c, **scan_kwargs)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("bucket", "num_bins", "l1", "l2", "max_delta_step",
+                     "min_data_in_leaf", "min_sum_hessian",
+                     "min_gain_to_split", "use_pallas"),
+    donate_argnames=("indices_buf",))
+def fused_split_step(
+    indices_buf: jax.Array,      # (N + max_bucket,) partition permutation
+    binned: jax.Array,           # (N, F)
+    grad: jax.Array, hess: jax.Array,
+    iparams: jax.Array,          # (15,) int32: [begin, count, feature,
+                                 #  threshold, default_left, missing_type,
+                                 #  default_bin, numbins_f(split feature),
+                                 #  is_categorical, bitset words 0..5]
+    cat_bitset: jax.Array,       # (8,) int32 bitset words
+    fparams: jax.Array,          # (10,) f32: [lsum_g, lsum_h, lcnt,
+                                 #  rsum_g, rsum_h, rcnt, lmin, lmax,
+                                 #  rmin, rmax]
+    parent_hist: jax.Array,                       # (F, B, 3)
+    feature_meta,                 # tuple of (F,) arrays + mask
+    *,
+    bucket: int, num_bins: int,
+    l1: float, l2: float, max_delta_step: float,
+    min_data_in_leaf: int, min_sum_hessian: float, min_gain_to_split: float,
+    use_pallas: bool = False,
+) -> FusedStepOut:
+    begin, count, feature, threshold = (iparams[0], iparams[1], iparams[2],
+                                        iparams[3])
+    default_left = iparams[4] > 0
+    missing_type = iparams[5]
+    default_bin = iparams[6]
+    numbins_f = iparams[7]
+    is_categorical = iparams[8] > 0
+    left_sums = fparams[0:3]
+    right_sums = fparams[3:6]
+    lmin, lmax, rmin, rmax = fparams[6], fparams[7], fparams[8], fparams[9]
+    window = jax.lax.dynamic_slice(indices_buf, (begin,), (bucket,))
+    pos = jnp.arange(bucket, dtype=jnp.int32)
+    valid = pos < count
+    rows = jnp.take(binned, window, axis=0)           # (bucket, F)
+
+    fbins = jnp.take_along_axis(
+        rows, jnp.full((bucket, 1), feature, jnp.int32), axis=1)[:, 0]
+    fbins = fbins.astype(jnp.int32)
+    num_left = decide_left(fbins, threshold, default_left, missing_type,
+                           default_bin, numbins_f)
+    word = cat_bitset[jnp.clip(fbins // 32, 0, cat_bitset.shape[0] - 1)]
+    cat_left = (((word >> (fbins % 32)) & 1) == 1) & (fbins // 32 < cat_bitset.shape[0])
+    go_left = jnp.where(is_categorical, cat_left, num_left)
+
+    key = jnp.where(valid, jnp.where(go_left, 0, 1), 2).astype(jnp.int32)
+    order = jnp.argsort(key, stable=True)
+    new_window = window[order]
+    left_count = jnp.sum((key == 0).astype(jnp.int32))
+    new_buf = jax.lax.dynamic_update_slice(indices_buf, new_window, (begin,))
+
+    # left-child histogram from the (already gathered) parent rows
+    w = (valid & go_left)
+    g = jnp.take(grad, window) * w
+    h = jnp.take(hess, window) * w
+    gh = jnp.stack([g, h, w.astype(jnp.float32)], axis=1)
+    left_hist = build_histogram(rows, gh, num_bins, use_pallas=use_pallas)
+    right_hist = parent_hist - left_hist
+
+    scan_kwargs = dict(
+        num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split)
+    left_res = _scan(left_hist, left_sums[0], left_sums[1], left_sums[2],
+                     feature_meta, lmin, lmax, scan_kwargs)
+    right_res = _scan(right_hist, right_sums[0], right_sums[1], right_sums[2],
+                      feature_meta, rmin, rmax, scan_kwargs)
+    return FusedStepOut(new_buf, left_count, left_hist, right_hist,
+                        left_res, right_res)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("bucket", "num_bins", "l1", "l2", "max_delta_step",
+                     "min_data_in_leaf", "min_sum_hessian",
+                     "min_gain_to_split", "use_pallas"))
+def fused_root_step(
+    indices_buf: jax.Array, binned: jax.Array,
+    grad: jax.Array, hess: jax.Array, count: jax.Array,
+    feature_meta,
+    *, bucket: int, num_bins: int,
+    l1: float, l2: float, max_delta_step: float,
+    min_data_in_leaf: int, min_sum_hessian: float, min_gain_to_split: float,
+    use_pallas: bool = False,
+):
+    """Root histogram + scan; returns (hist, totals(3,), SplitResult)."""
+    window = jax.lax.dynamic_slice(indices_buf, (0,), (bucket,))
+    valid = jnp.arange(bucket, dtype=jnp.int32) < count
+    rows = jnp.take(binned, window, axis=0)
+    g = jnp.take(grad, window) * valid
+    h = jnp.take(hess, window) * valid
+    gh = jnp.stack([g, h, valid.astype(jnp.float32)], axis=1)
+    hist = build_histogram(rows, gh, num_bins, use_pallas=use_pallas)
+    totals = hist[0].sum(axis=0)
+    scan_kwargs = dict(
+        num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split)
+    res = _scan(hist, totals[0], totals[1], totals[2], feature_meta,
+                jnp.float32(-jnp.inf), jnp.float32(jnp.inf), scan_kwargs)
+    return hist, totals, res

@@ -1,0 +1,118 @@
+"""CLI application + text parser tests (reference: tests/cpp_test conf
+smoke runs + parser auto-detection)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io import parser as pyparser
+
+from conftest import make_binary
+
+
+@pytest.fixture
+def train_file(tmp_path):
+    x, y = make_binary(800, 6)
+    data = np.column_stack([y, x])
+    path = tmp_path / "binary.train"
+    np.savetxt(path, data, delimiter="\t", fmt="%.6g")
+    return str(path), x, y
+
+
+def test_parser_csv(tmp_path):
+    r = np.random.RandomState(0)
+    data = np.column_stack([r.randint(0, 2, 50).astype(float), r.randn(50, 3)])
+    p = tmp_path / "d.csv"
+    np.savetxt(p, data, delimiter=",", fmt="%.5g")
+    x, y, _ = pyparser.parse_file(str(p))
+    assert x.shape == (50, 3)
+    np.testing.assert_allclose(y, data[:, 0])
+
+
+def test_parser_header(tmp_path):
+    p = tmp_path / "h.csv"
+    with open(p, "w") as f:
+        f.write("label,f1,f2\n")
+        for i in range(20):
+            f.write(f"{i % 2},{i * 0.5},{-i}\n")
+    x, y, _ = pyparser.parse_file(str(p))
+    assert x.shape == (20, 2)
+    assert y[1] == 1
+
+
+def test_parser_libsvm(tmp_path):
+    p = tmp_path / "d.svm"
+    with open(p, "w") as f:
+        f.write("1 0:0.5 2:1.5\n0 1:2.0\n1 0:1.0 1:1.0 2:1.0\n")
+    x, y, _ = pyparser.parse_file(str(p))
+    assert x.shape == (3, 3)
+    np.testing.assert_allclose(y, [1, 0, 1])
+    np.testing.assert_allclose(x[0], [0.5, 0, 1.5])
+
+
+def test_cli_train_and_predict(train_file, tmp_path):
+    path, x, y = train_file
+    model_path = str(tmp_path / "model.txt")
+    from lightgbm_tpu.cli import run
+    rc = run([f"data={path}", "objective=binary", "num_iterations=5",
+              f"output_model={model_path}", "verbosity=-1",
+              "num_leaves=15"])
+    assert rc == 0
+    assert os.path.exists(model_path)
+    out_path = str(tmp_path / "preds.txt")
+    rc = run(["task=predict", f"data={path}", f"input_model={model_path}",
+              f"output_result={out_path}", "verbosity=-1"])
+    assert rc == 0
+    preds = np.loadtxt(out_path)
+    assert len(preds) == len(y)
+    assert 0 <= preds.min() and preds.max() <= 1
+
+
+def test_cli_config_file(train_file, tmp_path):
+    path, x, y = train_file
+    conf = tmp_path / "train.conf"
+    model_path = str(tmp_path / "m.txt")
+    with open(conf, "w") as f:
+        f.write(f"task = train\nobjective = binary\ndata = {path}\n"
+                f"num_iterations = 3\noutput_model = {model_path}\n"
+                "num_leaves = 7\nverbosity = -1\n")
+    from lightgbm_tpu.cli import run
+    rc = run([f"config={conf}"])
+    assert rc == 0
+    assert os.path.exists(model_path)
+
+
+def test_cli_convert_model(train_file, tmp_path):
+    path, x, y = train_file
+    model_path = str(tmp_path / "model.txt")
+    from lightgbm_tpu.cli import run
+    run([f"data={path}", "objective=binary", "num_iterations=3",
+         f"output_model={model_path}", "verbosity=-1", "num_leaves=7"])
+    cpp_path = str(tmp_path / "model.cpp")
+    rc = run(["task=convert_model", f"input_model={model_path}",
+              f"convert_model={cpp_path}", "verbosity=-1"])
+    assert rc == 0
+    src = open(cpp_path).read()
+    assert "PredictTree0" in src and "void Predict" in src
+    # the generated C++ must actually compile
+    obj = str(tmp_path / "model.o")
+    r = subprocess.run(["g++", "-c", "-o", obj, cpp_path],
+                       capture_output=True)
+    assert r.returncode == 0, r.stderr.decode()[:500]
+
+
+def test_side_files_weight_query(tmp_path):
+    x, y = make_binary(200, 4)
+    data = np.column_stack([y, x])
+    path = tmp_path / "rank.train"
+    np.savetxt(path, data, delimiter="\t", fmt="%.6g")
+    np.savetxt(str(path) + ".weight", np.ones(200) * 2.0, fmt="%g")
+    np.savetxt(str(path) + ".query", np.full(20, 10), fmt="%d")
+    ds = lgb.Dataset(str(path))
+    ds.construct()
+    assert ds.get_weight() is not None
+    assert len(ds.get_group()) == 20
