@@ -1,0 +1,473 @@
+// C ABI for lightgbm_tpu: LGBM_*-compatible entry points.
+//
+// Native equivalent of the reference's stable C API (reference:
+// src/c_api.cpp, include/LightGBM/c_api.h:40-1018) which all language
+// bindings (Python ctypes, R, SWIG/Java) sit on. Here the engine is the
+// in-process Python/JAX runtime, so the C layer embeds CPython: each C call
+// acquires the GIL, marshals raw buffers to numpy without copies where
+// possible, and dispatches to lightgbm_tpu.capi_impl. Works both as a
+// standalone embedded interpreter (e.g. called from R/Java) and when loaded
+// inside an existing Python process (ctypes), where it reuses the live
+// interpreter.
+//
+// Build: make -C capi  (links against libpython via python3-config)
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <cstdarg>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define LGBM_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+std::mutex g_init_mutex;
+thread_local std::string g_last_error;
+bool g_we_initialized = false;
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+class Gil {
+ public:
+  Gil() {
+    {
+      std::lock_guard<std::mutex> lk(g_init_mutex);
+      if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+        g_we_initialized = true;
+      }
+    }
+    state_ = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// fetch lightgbm_tpu.capi_impl.<name>
+PyObject* ImplFn(const char* name) {
+  PyObject* mod = PyImport_ImportModule("lightgbm_tpu.capi_impl");
+  if (!mod) return nullptr;
+  PyObject* fn = PyObject_GetAttrString(mod, name);
+  Py_DECREF(mod);
+  return fn;
+}
+
+bool CheckPyErr() {
+  if (PyErr_Occurred()) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    PyObject* s = value ? PyObject_Str(value) : nullptr;
+    SetError(s && PyUnicode_Check(s) ? PyUnicode_AsUTF8(s) : "python error");
+    Py_XDECREF(s);
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+    return true;
+  }
+  return false;
+}
+
+// Call impl fn with args tuple; returns new ref or nullptr (error set).
+PyObject* Call(const char* name, PyObject* args) {
+  PyObject* fn = ImplFn(name);
+  if (!fn) {
+    CheckPyErr();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* ret = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  if (!ret) CheckPyErr();
+  return ret;
+}
+
+int CallVoidV(const char* name, const char* fmt, ...) {
+  Gil gil;
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  if (!args) {
+    CheckPyErr();
+    return -1;
+  }
+  PyObject* r = Call(name, args);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+PyObject* MemView(const void* data, Py_ssize_t nbytes) {
+  return PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(const_cast<void*>(data)), nbytes, PyBUF_READ);
+}
+
+PyObject* MemViewW(void* data, Py_ssize_t nbytes) {
+  return PyMemoryView_FromMemory(reinterpret_cast<char*>(data), nbytes,
+                                 PyBUF_WRITE);
+}
+
+}  // namespace
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+LGBM_API const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+// ---------------------------------------------------------------------------
+// Dataset
+// ---------------------------------------------------------------------------
+
+LGBM_API int LGBM_DatasetCreateFromFile(const char* filename,
+                                        const char* parameters,
+                                        const DatasetHandle reference,
+                                        DatasetHandle* out) {
+  Gil gil;
+  PyObject* r = Call("dataset_create_from_file",
+                     Py_BuildValue("(ssL)", filename, parameters,
+                                   (long long)(intptr_t)reference));
+  if (!r) return -1;
+  *out = reinterpret_cast<DatasetHandle>(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+// data_type: 0 = float32 (C_API_DTYPE_FLOAT32), 1 = float64
+LGBM_API int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                                       int32_t nrow, int32_t ncol,
+                                       int is_row_major,
+                                       const char* parameters,
+                                       const DatasetHandle reference,
+                                       DatasetHandle* out) {
+  Gil gil;
+  Py_ssize_t itemsize = data_type == 0 ? 4 : 8;
+  PyObject* mv = MemView(data, (Py_ssize_t)nrow * ncol * itemsize);
+  PyObject* r = Call("dataset_create_from_mat",
+                     Py_BuildValue("(NiiiisL)", mv, data_type, nrow, ncol,
+                                   is_row_major, parameters,
+                                   (long long)(intptr_t)reference));
+  if (!r) return -1;
+  *out = reinterpret_cast<DatasetHandle>(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetCreateFromCSR(
+    const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  Gil gil;
+  Py_ssize_t isz = indptr_type == 2 ? 4 : 8;  // C_API_DTYPE_INT32=2
+  Py_ssize_t dsz = data_type == 0 ? 4 : 8;
+  PyObject* args = Py_BuildValue(
+      "(NiNNiLLLsL)", MemView(indptr, nindptr * isz), indptr_type,
+      MemView(indices, nelem * 4), MemView(data, nelem * dsz), data_type,
+      (long long)nindptr, (long long)nelem, (long long)num_col, parameters,
+      (long long)(intptr_t)reference);
+  PyObject* r = Call("dataset_create_from_csr", args);
+  if (!r) return -1;
+  *out = reinterpret_cast<DatasetHandle>(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetFree(DatasetHandle handle) {
+  return CallVoidV("dataset_free", "(L)", (long long)(intptr_t)handle);
+}
+
+LGBM_API int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {
+  Gil gil;
+  PyObject* r = Call("dataset_get_num_data",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  *out = (int32_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out) {
+  Gil gil;
+  PyObject* r = Call("dataset_get_num_feature",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  *out = (int32_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// field_data type: 0=float32, 1=float64, 2=int32
+LGBM_API int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                                  const void* field_data, int num_element,
+                                  int type) {
+  Gil gil;
+  Py_ssize_t sz = (type == 2) ? 4 : (type == 0 ? 4 : 8);
+  PyObject* args = Py_BuildValue(
+      "(LsNii)", (long long)(intptr_t)handle, field_name,
+      MemView(field_data, (Py_ssize_t)num_element * sz), num_element, type);
+  PyObject* r = Call("dataset_set_field", args);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                                  int* out_len, const void** out_ptr,
+                                  int* out_type) {
+  Gil gil;
+  PyObject* r = Call("dataset_get_field",
+                     Py_BuildValue("(Ls)", (long long)(intptr_t)handle,
+                                   field_name));
+  if (!r) return -1;
+  // returns (ptr:int, len:int, type:int) — buffers owned by impl cache
+  PyObject* p0 = PyTuple_GetItem(r, 0);
+  PyObject* p1 = PyTuple_GetItem(r, 1);
+  PyObject* p2 = PyTuple_GetItem(r, 2);
+  *out_ptr = reinterpret_cast<const void*>(PyLong_AsLongLong(p0));
+  *out_len = (int)PyLong_AsLong(p1);
+  *out_type = (int)PyLong_AsLong(p2);
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Booster
+// ---------------------------------------------------------------------------
+
+LGBM_API int LGBM_BoosterCreate(const DatasetHandle train_data,
+                                const char* parameters, BoosterHandle* out) {
+  Gil gil;
+  PyObject* r = Call("booster_create",
+                     Py_BuildValue("(Ls)", (long long)(intptr_t)train_data,
+                                   parameters));
+  if (!r) return -1;
+  *out = reinterpret_cast<BoosterHandle>(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                             int* out_num_iterations,
+                                             BoosterHandle* out) {
+  Gil gil;
+  PyObject* r = Call("booster_create_from_modelfile",
+                     Py_BuildValue("(s)", filename));
+  if (!r) return -1;
+  *out = reinterpret_cast<BoosterHandle>(
+      PyLong_AsLongLong(PyTuple_GetItem(r, 0)));
+  *out_num_iterations = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                             int* out_num_iterations,
+                                             BoosterHandle* out) {
+  Gil gil;
+  PyObject* r = Call("booster_load_from_string",
+                     Py_BuildValue("(s)", model_str));
+  if (!r) return -1;
+  *out = reinterpret_cast<BoosterHandle>(
+      PyLong_AsLongLong(PyTuple_GetItem(r, 0)));
+  *out_num_iterations = (int)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterFree(BoosterHandle handle) {
+  return CallVoidV("booster_free", "(L)", (long long)(intptr_t)handle);
+}
+
+LGBM_API int LGBM_BoosterAddValidData(BoosterHandle handle,
+                                      const DatasetHandle valid_data) {
+  return CallVoidV("booster_add_valid", "(LL)",
+                   (long long)(intptr_t)handle,
+                   (long long)(intptr_t)valid_data);
+}
+
+LGBM_API int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
+                                       int* is_finished) {
+  Gil gil;
+  PyObject* r = Call("booster_update_one_iter",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  *is_finished = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                             const float* grad,
+                                             const float* hess,
+                                             int* is_finished) {
+  Gil gil;
+  PyObject* n = Call("booster_num_total_rows",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!n) return -1;
+  long long total = PyLong_AsLongLong(n);
+  Py_DECREF(n);
+  PyObject* args = Py_BuildValue(
+      "(LNN)", (long long)(intptr_t)handle,
+      MemView(grad, total * 4), MemView(hess, total * 4));
+  PyObject* r = Call("booster_update_one_iter_custom", args);
+  if (!r) return -1;
+  *is_finished = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  return CallVoidV("booster_rollback_one_iter", "(L)",
+                   (long long)(intptr_t)handle);
+}
+
+LGBM_API int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out) {
+  Gil gil;
+  PyObject* r = Call("booster_current_iteration",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out) {
+  Gil gil;
+  PyObject* r = Call("booster_num_classes",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out) {
+  Gil gil;
+  PyObject* r = Call("booster_eval_counts",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
+                                 int* out_len, double* out_results) {
+  Gil gil;
+  PyObject* r = Call("booster_get_eval",
+                     Py_BuildValue("(Li)", (long long)(intptr_t)handle,
+                                   data_idx));
+  if (!r) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out_results[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+  }
+  *out_len = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+// predict_type: 0=normal, 1=raw, 2=leaf index, 3=contrib (reference
+// C_API_PREDICT_* in c_api.h)
+LGBM_API int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                                       int data_type, int32_t nrow,
+                                       int32_t ncol, int is_row_major,
+                                       int predict_type, int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len, double* out_result) {
+  Gil gil;
+  Py_ssize_t itemsize = data_type == 0 ? 4 : 8;
+  PyObject* args = Py_BuildValue(
+      "(LNiiiiiis)", (long long)(intptr_t)handle,
+      MemView(data, (Py_ssize_t)nrow * ncol * itemsize), data_type, nrow,
+      ncol, is_row_major, predict_type, num_iteration, parameter);
+  PyObject* r = Call("booster_predict_for_mat", args);
+  if (!r) return -1;
+  // r = bytes of float64 results
+  char* buf;
+  Py_ssize_t nbytes;
+  if (PyBytes_AsStringAndSize(r, &buf, &nbytes) != 0) {
+    Py_DECREF(r);
+    CheckPyErr();
+    return -1;
+  }
+  std::memcpy(out_result, buf, nbytes);
+  *out_len = nbytes / 8;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                                   int num_iteration, const char* filename) {
+  return CallVoidV("booster_save_model", "(Liis)",
+                   (long long)(intptr_t)handle, start_iteration,
+                   num_iteration, filename);
+}
+
+LGBM_API int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                           int start_iteration,
+                                           int num_iteration,
+                                           int64_t buffer_len,
+                                           int64_t* out_len, char* out_str) {
+  Gil gil;
+  PyObject* r = Call("booster_save_model_to_string",
+                     Py_BuildValue("(Lii)", (long long)(intptr_t)handle,
+                                   start_iteration, num_iteration));
+  if (!r) return -1;
+  Py_ssize_t n;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &n);
+  *out_len = n + 1;
+  if (buffer_len >= n + 1) {
+    std::memcpy(out_str, s, n + 1);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                           int num_iteration,
+                                           int importance_type,
+                                           double* out_results) {
+  Gil gil;
+  PyObject* r = Call("booster_feature_importance",
+                     Py_BuildValue("(Lii)", (long long)(intptr_t)handle,
+                                   num_iteration, importance_type));
+  if (!r) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out_results[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out) {
+  Gil gil;
+  PyObject* r = Call("booster_num_feature",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Network
+// ---------------------------------------------------------------------------
+
+LGBM_API int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                              int listen_time_out, int num_machines) {
+  return CallVoidV("network_init", "(siii)", machines, local_listen_port,
+                   listen_time_out, num_machines);
+}
+
+LGBM_API int LGBM_NetworkFree() {
+  return CallVoidV("network_free", "()");
+}

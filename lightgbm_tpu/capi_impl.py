@@ -1,0 +1,240 @@
+"""Python side of the C ABI (capi/c_api.cpp).
+
+Handle tables + buffer marshalling for the LGBM_* entry points. The
+reference implements this layer in C++ (reference: src/c_api.cpp Booster
+wrapper class + dataset constructors); here the native shim embeds CPython
+and calls these functions with zero-copy memoryviews.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import parse_config_str
+
+_handles: Dict[int, object] = {}
+_handle_counter = itertools.count(1)
+_field_cache: Dict[tuple, np.ndarray] = {}
+
+C_DTYPE = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+
+def _new_handle(obj) -> int:
+    h = next(_handle_counter)
+    _handles[h] = obj
+    return h
+
+
+def _get(h: int):
+    return _handles[int(h)]
+
+
+# ---------------------------------------------------------------------------
+# Dataset
+# ---------------------------------------------------------------------------
+
+def dataset_create_from_file(filename: str, parameters: str, reference: int):
+    params = parse_config_str(parameters or "")
+    ref = _get(reference) if reference else None
+    ds = Dataset(filename, reference=ref, params=params)
+    ds.construct()
+    return _new_handle(ds)
+
+
+def dataset_create_from_mat(mv, data_type, nrow, ncol, is_row_major,
+                            parameters, reference):
+    arr = np.frombuffer(mv, dtype=C_DTYPE[data_type])
+    if is_row_major:
+        mat = arr.reshape(nrow, ncol)
+    else:
+        mat = arr.reshape(ncol, nrow).T
+    params = parse_config_str(parameters or "")
+    ref = _get(reference) if reference else None
+    ds = Dataset(np.asarray(mat, dtype=np.float64), reference=ref,
+                 params=params)
+    ds.construct()
+    return _new_handle(ds)
+
+
+def dataset_create_from_csr(indptr_mv, indptr_type, indices_mv, data_mv,
+                            data_type, nindptr, nelem, num_col, parameters,
+                            reference):
+    indptr = np.frombuffer(indptr_mv, dtype=C_DTYPE[indptr_type])[:nindptr]
+    indices = np.frombuffer(indices_mv, dtype=np.int32)[:nelem]
+    data = np.frombuffer(data_mv, dtype=C_DTYPE[data_type])[:nelem]
+    nrow = nindptr - 1
+    mat = np.zeros((nrow, num_col))
+    for i in range(nrow):
+        lo, hi = indptr[i], indptr[i + 1]
+        mat[i, indices[lo:hi]] = data[lo:hi]
+    params = parse_config_str(parameters or "")
+    ref = _get(reference) if reference else None
+    ds = Dataset(mat, reference=ref, params=params)
+    ds.construct()
+    return _new_handle(ds)
+
+
+def dataset_free(h):
+    _handles.pop(int(h), None)
+    for k in [k for k in _field_cache if k[0] == int(h)]:
+        del _field_cache[k]
+
+
+def dataset_get_num_data(h):
+    return _get(h).num_data()
+
+
+def dataset_get_num_feature(h):
+    return _get(h).num_feature()
+
+
+def dataset_set_field(h, field_name, mv, num_element, type_):
+    arr = np.frombuffer(mv, dtype=C_DTYPE[type_])[:num_element].copy()
+    ds = _get(h)
+    if field_name == "group":
+        ds.set_group(arr.astype(np.int64))
+    else:
+        ds.set_field(field_name, arr.astype(np.float64))
+    return 0
+
+
+def dataset_get_field(h, field_name):
+    ds = _get(h)
+    val = ds.get_field(field_name)
+    if val is None:
+        raise ValueError(f"field {field_name} not set")
+    if field_name == "group":
+        arr = np.ascontiguousarray(val, dtype=np.int32)
+        type_ = 2
+    else:
+        arr = np.ascontiguousarray(val, dtype=np.float32)
+        type_ = 0
+    _field_cache[(int(h), field_name)] = arr  # keep buffer alive
+    return (arr.ctypes.data, len(arr), type_)
+
+
+# ---------------------------------------------------------------------------
+# Booster
+# ---------------------------------------------------------------------------
+
+def booster_create(train_h, parameters):
+    params = parse_config_str(parameters or "")
+    bst = Booster(params=params, train_set=_get(train_h))
+    return _new_handle(bst)
+
+
+def booster_create_from_modelfile(filename):
+    bst = Booster(model_file=filename)
+    return (_new_handle(bst), bst.current_iteration())
+
+
+def booster_load_from_string(model_str):
+    bst = Booster(model_str=model_str)
+    return (_new_handle(bst), bst.current_iteration())
+
+
+def booster_free(h):
+    _handles.pop(int(h), None)
+
+
+def booster_add_valid(h, valid_h):
+    bst = _get(h)
+    bst.add_valid(_get(valid_h), f"valid_{len(bst.name_valid_sets)}")
+
+
+def booster_update_one_iter(h):
+    return 1 if _get(h).update() else 0
+
+
+def booster_num_total_rows(h):
+    bst = _get(h)
+    return bst._gbdt.num_data * bst._gbdt.num_tree_per_iteration
+
+
+def booster_update_one_iter_custom(h, grad_mv, hess_mv):
+    bst = _get(h)
+    grad = np.frombuffer(grad_mv, dtype=np.float32)
+    hess = np.frombuffer(hess_mv, dtype=np.float32)
+    return 1 if bst._gbdt.train_one_iter(grad, hess) else 0
+
+
+def booster_rollback_one_iter(h):
+    _get(h).rollback_one_iter()
+
+
+def booster_current_iteration(h):
+    return _get(h).current_iteration()
+
+
+def booster_num_classes(h):
+    return _get(h)._gbdt.num_class
+
+
+def booster_num_feature(h):
+    return _get(h).num_feature()
+
+
+def booster_eval_counts(h):
+    bst = _get(h)
+    return sum(len(m.names) for m in bst._gbdt.train_metrics)
+
+
+def booster_get_eval(h, data_idx):
+    """data_idx 0 = train, i>0 = valid i-1 (reference c_api semantics)."""
+    bst = _get(h)
+    if data_idx == 0:
+        results = bst.eval_train()
+    else:
+        name = bst.name_valid_sets[data_idx - 1]
+        results = [r for r in bst.eval_valid() if r[0] == name]
+    return [float(r[2]) for r in results]
+
+
+def booster_predict_for_mat(h, mv, data_type, nrow, ncol, is_row_major,
+                            predict_type, num_iteration, parameter):
+    bst = _get(h)
+    arr = np.frombuffer(mv, dtype=C_DTYPE[data_type])
+    mat = arr.reshape(nrow, ncol) if is_row_major else arr.reshape(ncol, nrow).T
+    kwargs = {}
+    if predict_type == 1:
+        kwargs["raw_score"] = True
+    elif predict_type == 2:
+        kwargs["pred_leaf"] = True
+    elif predict_type == 3:
+        kwargs["pred_contrib"] = True
+    preds = bst.predict(np.asarray(mat, dtype=np.float64),
+                        num_iteration=num_iteration if num_iteration > 0 else None,
+                        **kwargs)
+    return np.ascontiguousarray(preds, dtype=np.float64).tobytes()
+
+
+def booster_save_model(h, start_iteration, num_iteration, filename):
+    _get(h)._gbdt.save_model(filename, num_iteration, start_iteration)
+
+
+def booster_save_model_to_string(h, start_iteration, num_iteration):
+    return _get(h)._gbdt.save_model_to_string(start_iteration, num_iteration)
+
+
+def booster_feature_importance(h, num_iteration, importance_type):
+    itype = "split" if importance_type == 0 else "gain"
+    imp = _get(h)._gbdt.feature_importance(
+        itype, num_iteration if num_iteration > 0 else None)
+    return [float(v) for v in imp]
+
+
+# ---------------------------------------------------------------------------
+# Network
+# ---------------------------------------------------------------------------
+
+def network_init(machines, local_listen_port, listen_time_out, num_machines):
+    from .parallel import network
+    network.init_from_params(machines, local_listen_port, num_machines)
+
+
+def network_free():
+    from .parallel import network
+    network.free()

@@ -1,0 +1,135 @@
+"""C ABI smoke test via raw ctypes — exercises lib_lightgbm_tpu.so exactly
+the way external bindings would (reference: tests/c_api_test/test_.py)."""
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from conftest import make_binary
+
+LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "capi", "lib_lightgbm_tpu.so")
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not os.path.exists(LIB_PATH):
+        r = subprocess.run(["make", "-C", os.path.dirname(LIB_PATH)],
+                           capture_output=True)
+        if r.returncode != 0:
+            pytest.skip("C API lib build failed")
+    lib = ctypes.CDLL(LIB_PATH)
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.LGBM_GetLastError().decode()
+
+
+def test_c_api_train_predict_save(lib, tmp_path):
+    x, y = make_binary(600, 8)
+    xf = np.ascontiguousarray(x, dtype=np.float64)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        xf.ctypes.data_as(ctypes.c_void_p), 1, 600, 8, 1,
+        b"max_bin=63", None, ctypes.byref(ds)))
+    yl = np.ascontiguousarray(y, dtype=np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yl.ctypes.data_as(ctypes.c_void_p), 600, 0))
+    n = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+    assert n.value == 600
+    _check(lib, lib.LGBM_DatasetGetNumFeature(ds, ctypes.byref(n)))
+    assert n.value == 8
+
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=15 verbosity=-1 metric=binary_logloss",
+        ctypes.byref(bst)))
+    finished = ctypes.c_int()
+    for _ in range(10):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(finished)))
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 10
+
+    # eval on train
+    out_len = ctypes.c_int()
+    results = (ctypes.c_double * 8)()
+    _check(lib, lib.LGBM_BoosterGetEval(bst, 0, ctypes.byref(out_len), results))
+    assert out_len.value >= 1
+    assert results[0] < 0.6  # logloss learned something
+
+    # predict
+    pred = np.zeros(600, dtype=np.float64)
+    plen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, xf.ctypes.data_as(ctypes.c_void_p), 1, 600, 8, 1,
+        0, 0, b"", ctypes.byref(plen),
+        pred.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert plen.value == 600
+    acc = np.mean((pred > 0.5) == (y > 0))
+    assert acc > 0.85
+
+    # save/load roundtrip
+    model_path = str(tmp_path / "capi_model.txt").encode()
+    _check(lib, lib.LGBM_BoosterSaveModel(bst, 0, -1, model_path))
+    bst2 = ctypes.c_void_p()
+    niter = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterCreateFromModelfile(
+        model_path, ctypes.byref(niter), ctypes.byref(bst2)))
+    assert niter.value == 10
+    pred2 = np.zeros(600, dtype=np.float64)
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst2, xf.ctypes.data_as(ctypes.c_void_p), 1, 600, 8, 1,
+        0, 0, b"", ctypes.byref(plen),
+        pred2.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(pred, pred2, rtol=1e-5)
+
+    # feature importance
+    imp = (ctypes.c_double * 8)()
+    _check(lib, lib.LGBM_BoosterFeatureImportance(bst, 0, 0, imp))
+    assert sum(imp) > 0
+
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_BoosterFree(bst2))
+    _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_api_custom_objective(lib):
+    x, y = make_binary(400, 6)
+    xf = np.ascontiguousarray(x, dtype=np.float64)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        xf.ctypes.data_as(ctypes.c_void_p), 1, 400, 6, 1, b"",
+        None, ctypes.byref(ds)))
+    yl = np.ascontiguousarray(y, dtype=np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yl.ctypes.data_as(ctypes.c_void_p), 400, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=none verbosity=-1 num_leaves=7", ctypes.byref(bst)))
+    finished = ctypes.c_int()
+    score = np.zeros(400, dtype=np.float64)
+    for _ in range(5):
+        p = 1.0 / (1.0 + np.exp(-score))
+        grad = (p - y).astype(np.float32)
+        hess = (p * (1 - p)).astype(np.float32)
+        _check(lib, lib.LGBM_BoosterUpdateOneIterCustom(
+            bst, grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            hess.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.byref(finished)))
+        pred = np.zeros(400, dtype=np.float64)
+        plen = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, xf.ctypes.data_as(ctypes.c_void_p), 1, 400, 6, 1,
+            1, 0, b"", ctypes.byref(plen),
+            pred.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        score = pred
+    acc = np.mean(((1 / (1 + np.exp(-score))) > 0.5) == (y > 0))
+    assert acc > 0.8
+    _check(lib, lib.LGBM_BoosterFree(bst))
+    _check(lib, lib.LGBM_DatasetFree(ds))
