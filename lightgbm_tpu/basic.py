@@ -334,7 +334,9 @@ class Booster:
     # ------------------------------------------------------------------
     def predict(self, data, num_iteration=None, raw_score=False,
                 pred_leaf=False, pred_contrib=False, data_has_header=False,
-                is_reshape=True, start_iteration=0, **kwargs):
+                is_reshape=True, start_iteration=0, pred_early_stop=False,
+                pred_early_stop_freq=10, pred_early_stop_margin=10.0,
+                **kwargs):
         if isinstance(data, str):
             x, _, _ = _load_data_from_file(data)
         else:
@@ -353,7 +355,9 @@ class Booster:
         return self._gbdt.predict(
             x, num_iteration=num_iteration, raw_score=raw_score,
             pred_leaf=pred_leaf, pred_contrib=pred_contrib,
-            start_iteration=start_iteration)
+            start_iteration=start_iteration, pred_early_stop=pred_early_stop,
+            pred_early_stop_freq=pred_early_stop_freq,
+            pred_early_stop_margin=pred_early_stop_margin)
 
     def refit(self, data, label, decay_rate=0.9, **kwargs):
         """Refit leaf values on new data (reference Booster.refit)."""

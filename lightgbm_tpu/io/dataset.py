@@ -151,6 +151,15 @@ class Dataset:
         else:
             sample_rows = np.arange(n)
         max_bin_by_feature = cfg.max_bin_by_feature
+        # forced bin bounds (reference: dataset_loader + bin.cpp
+        # FindBinWithPredefinedBin; JSON: [{"feature": i, "bin_upper_bound": [...]}])
+        forced_bounds: Dict[int, list] = {}
+        if cfg.forcedbins_filename:
+            import json
+            with open(cfg.forcedbins_filename) as fh:
+                for entry in json.load(fh):
+                    forced_bounds[int(entry["feature"])] = [
+                        float(v) for v in entry["bin_upper_bound"]]
         ignore = set()
         for c in cfg.ignore_column or []:
             if isinstance(c, str) and c.startswith("name:"):
@@ -182,7 +191,8 @@ class Dataset:
                 min_split_data=cfg.min_data_in_leaf,
                 bin_type=BIN_CATEGORICAL if f in cat_idx else BIN_NUMERICAL,
                 use_missing=cfg.use_missing,
-                zero_as_missing=cfg.zero_as_missing)
+                zero_as_missing=cfg.zero_as_missing,
+                forced_bounds=forced_bounds.get(f))
             mappers.append(m)
         return mappers
 

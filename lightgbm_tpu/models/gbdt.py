@@ -331,8 +331,45 @@ class GBDT:
             out /= max(1, len(models) // self.num_tree_per_iteration)
         return out
 
+    def predict_raw_early_stop(self, x: np.ndarray, num_iteration=None,
+                               freq: int = 10, margin: float = 10.0,
+                               start_iteration: int = 0) -> np.ndarray:
+        """Raw scores with prediction early stopping (reference:
+        src/boosting/prediction_early_stop.cpp): every `freq` trees, rows
+        whose decision margin exceeds `margin` stop accumulating — binary
+        margin = 2|score|, multiclass = top1 - top2."""
+        x = np.asarray(x, dtype=np.float32)
+        if x.ndim == 1:
+            x = x.reshape(1, -1)
+        models = self._used_models(num_iteration, start_iteration)
+        k = self.num_tree_per_iteration
+        n = x.shape[0]
+        scores = np.zeros((n, self.num_class))
+        active = np.arange(n)
+        step = max(1, freq) * k
+        for start in range(0, len(models), step):
+            if len(active) == 0:
+                break
+            chunk = models[start:start + step]
+            arrays = predict_ops.trees_to_arrays(chunk)
+            tree_class = jnp.asarray(
+                (np.arange(len(chunk), dtype=np.int32) + start) % k)
+            out = predict_ops.predict_raw_ensemble(
+                jnp.asarray(x[active]), arrays, tree_class,
+                max_depth=arrays.max_depth, num_class=self.num_class)
+            scores[active] += np.asarray(jax.device_get(out))
+            if self.num_class == 1:
+                m = 2.0 * np.abs(scores[active, 0])
+            else:
+                srt = np.sort(scores[active], axis=1)
+                m = srt[:, -1] - srt[:, -2]
+            active = active[m <= margin]
+        return scores
+
     def predict(self, x, num_iteration=None, raw_score=False,
-                pred_leaf=False, pred_contrib=False, start_iteration=0):
+                pred_leaf=False, pred_contrib=False, start_iteration=0,
+                pred_early_stop=False, pred_early_stop_freq=10,
+                pred_early_stop_margin=10.0):
         if pred_leaf:
             models = self._used_models(num_iteration, start_iteration)
             arrays = predict_ops.trees_to_arrays(models)
@@ -344,7 +381,12 @@ class GBDT:
             return np.asarray(jax.device_get(leaves))
         if pred_contrib:
             return self.predict_contrib(x, num_iteration)
-        raw = self.predict_raw(x, num_iteration, start_iteration)
+        if pred_early_stop:
+            raw = self.predict_raw_early_stop(
+                x, num_iteration, pred_early_stop_freq,
+                pred_early_stop_margin, start_iteration)
+        else:
+            raw = self.predict_raw(x, num_iteration, start_iteration)
         if raw_score:
             return raw[:, 0] if self.num_class == 1 else raw
         if self.objective is not None:

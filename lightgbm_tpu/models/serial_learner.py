@@ -79,6 +79,40 @@ class SerialTreeLearner:
         default_pallas = "1" if jax.default_backend() == "tpu" else "0"
         self._use_pallas = bool(int(_env("LGBM_TPU_PALLAS_HIST", default_pallas)))
         self._mono_enabled = bool(np.any(np.asarray(self.f_monotone) != 0))
+        # feature_contri gain multipliers (reference FeatureMetainfo penalty)
+        contri = config.feature_contri or []
+        if contri:
+            pen = np.array(
+                [contri[f] if f < len(contri) else 1.0
+                 for f in dataset.used_features], dtype=np.float32)
+            self._feature_penalty = jnp.asarray(pen)
+        else:
+            self._feature_penalty = None
+        # CEGB (reference cost_effective_gradient_boosting.hpp): coupled
+        # penalties are charged once per feature across the whole model;
+        # lazy per-row costs are approximated per-leaf by count.
+        self._cegb_enabled = (config.cegb_tradeoff > 0 and (
+            config.cegb_penalty_split > 0
+            or bool(config.cegb_penalty_feature_coupled)
+            or bool(config.cegb_penalty_feature_lazy)))
+        if self._cegb_enabled:
+            nf = self.num_features
+            coupled = config.cegb_penalty_feature_coupled or []
+            lazy = config.cegb_penalty_feature_lazy or []
+            self._cegb_coupled = np.array(
+                [coupled[f] if f < len(coupled) else 0.0
+                 for f in dataset.used_features])
+            self._cegb_lazy = np.array(
+                [lazy[f] if f < len(lazy) else 0.0
+                 for f in dataset.used_features])
+            self._cegb_feature_used = np.zeros(nf, dtype=bool)
+        # forced splits: BFS JSON replayed at the top of every tree
+        # (reference: serial_tree_learner.cpp:607-769 ForceSplits)
+        self._forced_splits = None
+        if config.forcedsplits_filename:
+            import json
+            with open(config.forcedsplits_filename) as fh:
+                self._forced_splits = json.load(fh)
 
     # ------------------------------------------------------------------
     def _scan_args(self):
@@ -199,9 +233,11 @@ class SerialTreeLearner:
         self._numerical_mask_np = base_mask  # node-level resample below
 
         tree = Tree(cfg.num_leaves)
+        root_cost = self._cegb_cost(bag_cnt)
         root_hist, totals_dev, root_res = fused_ops.fused_root_step(
             indices_buf, self.binned, grad, hess, jnp.int32(bag_cnt),
             self._fused_meta(base_mask, rng),
+            None if root_cost is None else jnp.asarray(root_cost),
             bucket=_bucket(bag_cnt, self.max_bucket),
             use_pallas=self._use_pallas, **self._scan_args())
         totals = jax.device_get(totals_dev)
@@ -211,6 +247,10 @@ class SerialTreeLearner:
         if self._has_categorical:
             self._merge_categorical(root, base_mask, rng)
         leaves: Dict[int, _LeafState] = {0: root}
+
+        if self._forced_splits is not None:
+            indices_buf = self._replay_forced_splits(
+                tree, leaves, indices_buf, grad, hess, base_mask, rng)
 
         for _split_idx in range(cfg.num_leaves - 1):
             # pick the splittable leaf with max gain (leaf-wise growth)
@@ -235,7 +275,18 @@ class SerialTreeLearner:
     def _fused_meta(self, base_mask, rng):
         mask = self._node_feature_mask(base_mask, rng) & (self.f_categorical == 0)
         return (self.f_numbins, self.f_missing, self.f_default, mask,
-                self.f_monotone)
+                self.f_monotone, self._feature_penalty)
+
+    def _cegb_cost(self, count: int) -> Optional[np.ndarray]:
+        if not self._cegb_enabled:
+            return None
+        cfg = self.config
+        cost = np.full(self.num_features,
+                       cfg.cegb_tradeoff * cfg.cegb_penalty_split * count)
+        cost += np.where(self._cegb_feature_used, 0.0,
+                         cfg.cegb_tradeoff * self._cegb_coupled)
+        cost += cfg.cegb_tradeoff * self._cegb_lazy * count
+        return cost.astype(np.float32)
 
     def _merge_categorical(self, st: "_LeafState", base_mask, rng) -> None:
         """Categorical split search runs as a separate (rarer) program and
@@ -285,11 +336,18 @@ class SerialTreeLearner:
             [sp["left_sum_grad"], sp["left_sum_hess"], sp["left_count"],
              sp["right_sum_grad"], sp["right_sum_hess"], sp["right_count"],
              lmin, lmax, rmin, rmax], dtype=np.float32)
+        if self._cegb_enabled:
+            child_costs = jnp.asarray(np.stack([
+                self._cegb_cost(sp["left_count"]),
+                self._cegb_cost(sp["right_count"])]))
+            self._cegb_feature_used[inner_f] = True
+        else:
+            child_costs = None
         out = fused_ops.fused_split_step(
             indices_buf, self.binned, grad, hess,
             jnp.asarray(iparams), jnp.asarray(bits.view(np.int32)),
             jnp.asarray(fparams), st.hist,
-            self._fused_meta(base_mask, rng),
+            self._fused_meta(base_mask, rng), child_costs,
             bucket=bucket, use_pallas=self._use_pallas, **self._scan_args())
 
         # ONE host fetch per split: left_count + the two winner tuples
@@ -347,6 +405,79 @@ class SerialTreeLearner:
         leaves[tree.num_leaves - 1] = right
         assert tree.num_leaves - 1 == new_leaf
         return out.indices_buf
+
+    def _replay_forced_splits(self, tree, leaves, indices_buf, grad, hess,
+                              base_mask, rng):
+        """Apply the forced-split JSON breadth-first before normal growth."""
+        cfg = self.config
+        ds = self.dataset
+        queue = [(0, self._forced_splits)]
+        while queue and tree.num_leaves < cfg.num_leaves:
+            leaf_id, node = queue.pop(0)
+            if node is None or "feature" not in node:
+                continue
+            real_f = int(node["feature"])
+            if real_f not in ds.used_features:
+                log.warning("Forced split feature %d unavailable; skipping",
+                            real_f)
+                continue
+            inner_f = ds.used_features.index(real_f)
+            mapper = ds.bin_mappers[real_f]
+            bin_thr = mapper.value_to_bin(float(node["threshold"]))
+            bin_thr = min(bin_thr, mapper.num_bin - 2)
+            st = leaves[leaf_id]
+            sp = self._gather_split_at(st, inner_f, bin_thr)
+            if sp is None:
+                continue
+            st.split = sp
+            indices_buf = self._apply_split(
+                tree, leaves, leaf_id, indices_buf, grad, hess,
+                base_mask, rng)
+            right_leaf = tree.num_leaves - 1
+            if "left" in node:
+                queue.append((leaf_id, node["left"]))
+            if "right" in node:
+                queue.append((right_leaf, node["right"]))
+        return indices_buf
+
+    def _gather_split_at(self, st: _LeafState, inner_f: int,
+                         bin_thr: int) -> Optional[dict]:
+        """Split record for a FIXED (feature, bin) from the leaf histogram
+        (reference: feature_histogram.hpp:281-419 GatherInfoForThreshold)."""
+        cfg = self.config
+        hrow = np.asarray(jax.device_get(st.hist[inner_f]), dtype=np.float64)
+        nb = int(np.asarray(self.f_numbins)[inner_f])
+        lg, lh, lc = hrow[: bin_thr + 1].sum(axis=0)
+        rg, rh, rc = st.sum_grad - lg, st.sum_hess - lh, st.count - lc
+        if lc < 1 or rc < 1:
+            return None
+
+        def tl1(s):
+            return np.sign(s) * max(0.0, abs(s) - cfg.lambda_l1)
+
+        def output(g, h):
+            o = -tl1(g) / (h + cfg.lambda_l2)
+            if cfg.max_delta_step > 0:
+                o = float(np.clip(o, -cfg.max_delta_step, cfg.max_delta_step))
+            return float(np.clip(o, st.min_c, st.max_c))
+
+        def gain_part(g, h, o):
+            return -(2.0 * tl1(g) * o + (h + cfg.lambda_l2) * o * o)
+
+        lo, ro = output(lg, lh), output(rg, rh)
+        gain_shift = gain_part(
+            st.sum_grad, st.sum_hess,
+            output(st.sum_grad, st.sum_hess))
+        gain = gain_part(lg, lh, lo) + gain_part(rg, rh, ro) - gain_shift
+        return {
+            "gain": float(gain), "feature": inner_f, "threshold": int(bin_thr),
+            "default_left": False,
+            "left_sum_grad": float(lg), "left_sum_hess": float(lh),
+            "left_count": int(round(lc)),
+            "right_sum_grad": float(rg), "right_sum_hess": float(rh),
+            "right_count": int(round(rc)),
+            "left_output": lo, "right_output": ro, "categorical": False,
+        }
 
     def _splittable(self, leaf: _LeafState, tree: Tree) -> bool:
         cfg = self.config

@@ -157,6 +157,9 @@ class RegressionL2(Objective):
             return jnp.sign(scores) * scores * scores
         return scores
 
+    def to_string(self):
+        return f"{self.name} sqrt" if self.sqrt else self.name
+
 
 class RegressionL1(RegressionL2):
     """reference: regression_objective.hpp:189 RegressionL1loss."""
@@ -401,6 +404,9 @@ class BinaryLogloss(Objective):
     def class_need_train(self, class_id):
         return self.need_train
 
+    def to_string(self):
+        return f"{self.name} sigmoid:{self.sigmoid:g}"
+
 
 class CrossEntropy(Objective):
     """reference: xentropy_objective.hpp:44 CrossEntropy."""
@@ -510,6 +516,9 @@ class MulticlassSoftmax(Objective):
         p = self._class_probs[class_id]
         return K_EPSILON < abs(p) < 1.0 - K_EPSILON
 
+    def to_string(self):
+        return f"{self.name} num_class:{self.num_class}"
+
 
 class MulticlassOVA(Objective):
     """reference: multiclass_objective.hpp:180 MulticlassOVA."""
@@ -549,6 +558,10 @@ class MulticlassOVA(Objective):
 
     def convert_output(self, scores):
         return 1.0 / (1.0 + jnp.exp(-self.sigmoid * scores))
+
+    def to_string(self):
+        return (f"{self.name} num_class:{self.num_class} "
+                f"sigmoid:{self.sigmoid:g}")
 
 
 # ----------------------------------------------------------------------

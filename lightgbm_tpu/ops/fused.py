@@ -36,11 +36,11 @@ class FusedStepOut(NamedTuple):
     right_res: split_ops.SplitResult
 
 
-def _scan(hist, sg, sh, cnt, meta, min_c, max_c, scan_kwargs):
-    (f_numbins, f_missing, f_default, feature_mask, monotone) = meta
+def _scan(hist, sg, sh, cnt, meta, min_c, max_c, scan_kwargs, cost=None):
+    (f_numbins, f_missing, f_default, feature_mask, monotone, penalty) = meta
     return split_ops.find_best_split.__wrapped__(
         hist, sg, sh, cnt, f_numbins, f_missing, f_default, feature_mask,
-        monotone, min_c, max_c, **scan_kwargs)
+        monotone, min_c, max_c, penalty, cost, **scan_kwargs)
 
 
 @functools.partial(
@@ -62,7 +62,8 @@ def fused_split_step(
                                  #  rsum_g, rsum_h, rcnt, lmin, lmax,
                                  #  rmin, rmax]
     parent_hist: jax.Array,                       # (F, B, 3)
-    feature_meta,                 # tuple of (F,) arrays + mask
+    feature_meta,                 # tuple of (F,) arrays + mask + penalty
+    child_costs=None,             # (2, F) CEGB costs for (left, right)
     *,
     bucket: int, num_bins: int,
     l1: float, l2: float, max_delta_step: float,
@@ -111,10 +112,12 @@ def fused_split_step(
         num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split)
+    lcost = child_costs[0] if child_costs is not None else None
+    rcost = child_costs[1] if child_costs is not None else None
     left_res = _scan(left_hist, left_sums[0], left_sums[1], left_sums[2],
-                     feature_meta, lmin, lmax, scan_kwargs)
+                     feature_meta, lmin, lmax, scan_kwargs, lcost)
     right_res = _scan(right_hist, right_sums[0], right_sums[1], right_sums[2],
-                      feature_meta, rmin, rmax, scan_kwargs)
+                      feature_meta, rmin, rmax, scan_kwargs, rcost)
     return FusedStepOut(new_buf, left_count, left_hist, right_hist,
                         left_res, right_res)
 
@@ -127,7 +130,7 @@ def fused_split_step(
 def fused_root_step(
     indices_buf: jax.Array, binned: jax.Array,
     grad: jax.Array, hess: jax.Array, count: jax.Array,
-    feature_meta,
+    feature_meta, root_cost=None,
     *, bucket: int, num_bins: int,
     l1: float, l2: float, max_delta_step: float,
     min_data_in_leaf: int, min_sum_hessian: float, min_gain_to_split: float,
@@ -147,5 +150,6 @@ def fused_root_step(
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split)
     res = _scan(hist, totals[0], totals[1], totals[2], feature_meta,
-                jnp.float32(-jnp.inf), jnp.float32(jnp.inf), scan_kwargs)
+                jnp.float32(-jnp.inf), jnp.float32(jnp.inf), scan_kwargs,
+                root_cost)
     return hist, totals, res

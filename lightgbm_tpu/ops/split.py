@@ -97,6 +97,11 @@ def per_feature_best(
     monotone: jax.Array,        # (F,) int32 constraints (-1/0/1)
     min_constraint: jax.Array,  # scalar leaf output min (monotone prop)
     max_constraint: jax.Array,  # scalar leaf output max
+    feature_penalty: jax.Array = None,  # (F,) gain multiplier
+                                 # (feature_contri; reference
+                                 # feature_histogram.hpp:88 gain *= penalty)
+    feature_cost: jax.Array = None,     # (F,) subtractive CEGB cost
+                                 # (reference cegb DetlaGain terms)
     *,
     num_bins: int,
     l1: float, l2: float, max_delta_step: float,
@@ -187,9 +192,18 @@ def per_feature_best(
     use_m1 = best_f_m1 >= best_f_p1
     per_feature_gain = jnp.where(use_m1, best_f_m1, best_f_p1)
     per_feature_t = jnp.where(use_m1, best_t_m1, best_t_p1)
-    # relative gains (reference: output->gain -= min_gain_shift)
+    # relative gains (reference: output->gain -= min_gain_shift), then the
+    # feature_contri multiplier and CEGB cost subtraction
     per_feature_rel = jnp.where(per_feature_gain > NEG_INF / 2,
                                 per_feature_gain - min_gain_shift, NEG_INF)
+    if feature_penalty is not None:
+        per_feature_rel = jnp.where(per_feature_rel > NEG_INF / 2,
+                                    per_feature_rel * feature_penalty,
+                                    per_feature_rel)
+    if feature_cost is not None:
+        per_feature_rel = jnp.where(per_feature_rel > NEG_INF / 2,
+                                    per_feature_rel - feature_cost,
+                                    per_feature_rel)
     prefix = (gl1, hl1, cl1, gr_m1, hr_m1, cr_m1)
     return per_feature_rel, per_feature_t, use_m1, prefix
 
@@ -227,13 +241,14 @@ def find_best_split(
     feature_missing: jax.Array, feature_default_bins: jax.Array,
     feature_mask: jax.Array, monotone: jax.Array,
     min_constraint: jax.Array, max_constraint: jax.Array,
+    feature_penalty: jax.Array = None, feature_cost: jax.Array = None,
     *, num_bins: int, l1: float, l2: float, max_delta_step: float,
     min_data_in_leaf: int, min_sum_hessian: float, min_gain_to_split: float,
 ) -> SplitResult:
     per_feature_rel, per_feature_t, use_m1, prefix = per_feature_best(
         hist, sum_grad, sum_hess, num_data, feature_num_bins,
         feature_missing, feature_default_bins, feature_mask, monotone,
-        min_constraint, max_constraint,
+        min_constraint, max_constraint, feature_penalty, feature_cost,
         num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split)

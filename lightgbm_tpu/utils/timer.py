@@ -1,0 +1,84 @@
+"""Phase timers + profiler integration.
+
+Equivalent of the reference's TIMETAG accumulating timers (reference:
+src/treelearner/serial_tree_learner.cpp:21-48, CMake USE_TIMETAG) printed at
+teardown, plus a jax.profiler trace hook for TPU timeline capture.
+
+Enable with env LGBM_TPU_TIMETAG=1 or config timetag=true; report via
+`report()` or automatically at interpreter exit.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict
+
+from . import log
+
+_acc: Dict[str, float] = defaultdict(float)
+_cnt: Dict[str, int] = defaultdict(int)
+_enabled = os.environ.get("LGBM_TPU_TIMETAG", "0") not in ("0", "", "false")
+
+
+def enable(flag: bool = True) -> None:
+    global _enabled
+    _enabled = flag
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def timer(name: str):
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _acc[name] += time.perf_counter() - t0
+        _cnt[name] += 1
+
+
+def add(name: str, seconds: float) -> None:
+    if _enabled:
+        _acc[name] += seconds
+        _cnt[name] += 1
+
+
+def report() -> Dict[str, float]:
+    if _acc:
+        log.info("cost summary:")
+        for name in sorted(_acc):
+            log.info("  %-24s %10.3fs  (%d calls)",
+                     name, _acc[name], _cnt[name])
+    return dict(_acc)
+
+
+def reset() -> None:
+    _acc.clear()
+    _cnt.clear()
+
+
+@atexit.register
+def _report_at_exit():  # pragma: no cover
+    if _enabled and _acc:
+        report()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture an XLA/TPU timeline with jax.profiler (view in TensorBoard
+    or xprof). The reference has no device tracing; this replaces its
+    wall-clock logs for kernel-level analysis."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,136 @@
+"""Cross-implementation parity against the reference LightGBM binary.
+
+The strongest consistency net (SURVEY.md §7 stage-1 milestone): models must
+interoperate byte-level in BOTH directions —
+  * a reference-trained model file loads in lightgbm_tpu and reproduces the
+    reference's own predictions;
+  * a lightgbm_tpu-saved model file loads in the reference CLI and predicts
+    identically to us;
+and training quality on the reference's example data must match.
+
+Requires the oracle binary (tools/build_reference_oracle.sh); skipped when
+absent. Fixture data is read from the reference tree at test time (never
+copied into this repo).
+"""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+ORACLE = "/tmp/refsrc/lightgbm"
+REF_EXAMPLES = "/root/reference/examples"
+BINARY_TRAIN = os.path.join(REF_EXAMPLES, "binary_classification", "binary.train")
+BINARY_TEST = os.path.join(REF_EXAMPLES, "binary_classification", "binary.test")
+
+needs_oracle = pytest.mark.skipif(
+    not os.path.exists(ORACLE) or not os.path.exists(BINARY_TRAIN),
+    reason="reference oracle binary or example data unavailable")
+
+
+def _run_oracle(workdir, *args):
+    r = subprocess.run([ORACLE, *args], cwd=workdir, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    return r
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0
+    return float((ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
+                 / (pos.sum() * (~pos).sum()))
+
+
+@pytest.fixture(scope="module")
+def ref_model(tmp_path_factory):
+    """Train the reference once on its example data."""
+    if not os.path.exists(ORACLE) or not os.path.exists(BINARY_TRAIN):
+        pytest.skip("oracle unavailable")
+    work = tmp_path_factory.mktemp("refrun")
+    model = work / "ref_model.txt"
+    _run_oracle(
+        str(work), "task=train", f"data={BINARY_TRAIN}",
+        "objective=binary", "num_trees=20", "num_leaves=31",
+        "learning_rate=0.1", "min_data_in_leaf=20", "verbosity=-1",
+        f"output_model={model}", "metric=auc")
+    pred_out = work / "ref_pred.txt"
+    _run_oracle(
+        str(work), "task=predict", f"data={BINARY_TEST}",
+        f"input_model={model}", f"output_result={pred_out}", "verbosity=-1")
+    return str(model), str(pred_out)
+
+
+@needs_oracle
+def test_load_reference_model_and_match_predictions(ref_model):
+    """Our loader + predictor must reproduce the reference's predictions on
+    a reference-trained model."""
+    model_path, ref_pred_path = ref_model
+    booster = lgb.Booster(model_file=model_path)
+    from lightgbm_tpu.io.parser import parse_file
+    x, y, _ = parse_file(BINARY_TEST)
+    ours = booster.predict(x)
+    theirs = np.loadtxt(ref_pred_path)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-5, atol=2e-6)
+
+
+@needs_oracle
+def test_reference_loads_our_model(tmp_path):
+    """The reference CLI must accept our model file and predict identically."""
+    from lightgbm_tpu.io.parser import parse_file
+    x, y, _ = parse_file(BINARY_TRAIN)
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 20}, ds,
+                    num_boost_round=10, verbose_eval=False)
+    model_path = tmp_path / "ours.txt"
+    bst.save_model(str(model_path))
+    pred_out = tmp_path / "ref_pred_ours.txt"
+    _run_oracle(
+        str(tmp_path), "task=predict", f"data={BINARY_TEST}",
+        f"input_model={model_path}", f"output_result={pred_out}",
+        "verbosity=-1")
+    xt, yt, _ = parse_file(BINARY_TEST)
+    ours = bst.predict(xt)
+    theirs = np.loadtxt(pred_out)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-5)
+
+
+@needs_oracle
+def test_training_quality_parity(ref_model, tmp_path):
+    """Same params, same data: our AUC must match the reference's within
+    the fp32-histogram tolerance the reference itself accepts for its GPU
+    path (GPU-Performance.rst:136-162)."""
+    from lightgbm_tpu.io.parser import parse_file
+    x, y, _ = parse_file(BINARY_TRAIN)
+    xt, yt, _ = parse_file(BINARY_TEST)
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "learning_rate": 0.1, "min_data_in_leaf": 20,
+                     "verbosity": -1}, ds, num_boost_round=20,
+                    verbose_eval=False)
+    ours_auc = _auc(yt, bst.predict(xt))
+    ref_booster = lgb.Booster(model_file=ref_model[0])
+    ref_auc = _auc(yt, ref_booster.predict(xt))
+    assert abs(ours_auc - ref_auc) < 0.006, (ours_auc, ref_auc)
+
+
+@needs_oracle
+def test_first_tree_structure_agreement(ref_model, tmp_path):
+    """With deterministic greedy growth the first tree's root split should
+    agree with the reference (same binning => same histograms)."""
+    from lightgbm_tpu.io.parser import parse_file
+    x, y, _ = parse_file(BINARY_TRAIN)
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "learning_rate": 0.1, "min_data_in_leaf": 20,
+                     "verbosity": -1}, ds, num_boost_round=1,
+                    verbose_eval=False)
+    ref = lgb.Booster(model_file=ref_model[0])
+    t_ours = bst._gbdt.models[0]
+    t_ref = ref._gbdt.models[0]
+    assert t_ours.split_feature[0] == t_ref.split_feature[0]

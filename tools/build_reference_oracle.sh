@@ -1,0 +1,21 @@
+#!/bin/sh
+# Build the reference LightGBM CLI as a test oracle (used by
+# tests/test_reference_parity.py; tests skip if the binary is absent).
+# The reference CMake links the executable into its source dir, so build
+# from a scratch copy — never write into /root/reference.
+set -e
+SRC=${1:-/root/reference}
+WORK=${2:-/tmp/refsrc}
+BUILD=/tmp/refbuild_oracle
+if [ -x "$WORK/lightgbm" ]; then
+  echo "oracle already built: $WORK/lightgbm"
+  exit 0
+fi
+rm -rf "$WORK" "$BUILD"
+cp -r "$SRC" "$WORK"
+rm -f "$WORK/lightgbm"
+mkdir -p "$BUILD"
+cd "$BUILD"
+cmake "$WORK" -DCMAKE_BUILD_TYPE=Release > cmake.log 2>&1
+make -j"$(nproc)" lightgbm > make.log 2>&1
+echo "oracle built: $WORK/lightgbm"
