@@ -1,0 +1,109 @@
+# Booster class (role of reference R-package/R/lgb.Booster.R).
+
+Booster <- R6::R6Class(
+  "lgb.Booster",
+  public = list(
+    handle = NULL,
+    best_iter = -1L,
+    record_evals = list(),
+
+    initialize = function(params = list(), train_set = NULL,
+                          modelfile = NULL, model_str = NULL) {
+      if (!is.null(train_set)) {
+        self$handle <- .Call(LGBMTPU_BoosterCreate_R, train_set$handle,
+                             lgb.params2str(params))
+      } else if (!is.null(modelfile)) {
+        self$handle <- .Call(LGBMTPU_BoosterCreateFromModelfile_R, modelfile)
+      } else {
+        stop("lgb.Booster: need train_set or modelfile")
+      }
+    },
+
+    add_valid = function(valid_set, name) {
+      .Call(LGBMTPU_BoosterAddValidData_R, self$handle, valid_set$handle)
+      private$valid_names <- c(private$valid_names, name)
+      invisible(self)
+    },
+
+    update = function() {
+      .Call(LGBMTPU_BoosterUpdateOneIter_R, self$handle)
+    },
+
+    rollback_one_iter = function() {
+      .Call(LGBMTPU_BoosterRollbackOneIter_R, self$handle)
+      invisible(self)
+    },
+
+    current_iter = function() {
+      .Call(LGBMTPU_BoosterGetCurrentIteration_R, self$handle)
+    },
+
+    eval = function(data_idx = 0L) {
+      .Call(LGBMTPU_BoosterGetEval_R, self$handle, as.integer(data_idx))
+    },
+
+    save_model = function(filename, num_iteration = -1L) {
+      .Call(LGBMTPU_BoosterSaveModel_R, self$handle,
+            as.integer(num_iteration), filename)
+      invisible(self)
+    },
+
+    save_model_to_string = function(num_iteration = -1L) {
+      .Call(LGBMTPU_BoosterSaveModelToString_R, self$handle,
+            as.integer(num_iteration))
+    },
+
+    predict = function(data, raw_score = FALSE, predleaf = FALSE,
+                       predcontrib = FALSE, num_iteration = -1L) {
+      data <- as.matrix(data)
+      storage.mode(data) <- "double"
+      ptype <- 0L
+      if (raw_score) ptype <- 1L
+      if (predleaf) ptype <- 2L
+      if (predcontrib) ptype <- 3L
+      res <- .Call(LGBMTPU_BoosterPredictForMat_R, self$handle, data,
+                   nrow(data), ncol(data), ptype, as.integer(num_iteration))
+      n <- nrow(data)
+      if (length(res) > n && length(res) %% n == 0) {
+        matrix(res, nrow = n, byrow = TRUE)
+      } else {
+        res
+      }
+    }
+  ),
+  private = list(valid_names = character(0))
+)
+
+#' @export
+lgb.Booster <- function(params = list(), train_set = NULL,
+                        modelfile = NULL) {
+  Booster$new(params, train_set, modelfile)
+}
+
+#' Predict method
+#' @export
+predict.lgb.Booster <- function(object, data, ...) {
+  object$predict(data, ...)
+}
+
+#' Load a model from file
+#' @export
+lgb.load <- function(filename) {
+  Booster$new(modelfile = filename)
+}
+
+#' Save a model to file
+#' @export
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  booster$save_model(filename, num_iteration)
+}
+
+#' Split/gain feature importance
+#' @export
+lgb.importance <- function(booster, num_iteration = -1L,
+                           importance_type = c("split", "gain")) {
+  importance_type <- match.arg(importance_type)
+  itype <- if (importance_type == "split") 0L else 1L
+  .Call(LGBMTPU_BoosterFeatureImportance_R, booster$handle,
+        as.integer(num_iteration), itype)
+}

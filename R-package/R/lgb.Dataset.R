@@ -1,0 +1,69 @@
+# Dataset class for lightgbm.tpu (role of reference R-package/R/lgb.Dataset.R:
+# an R6 handle owning the native binned dataset).
+
+#' @importFrom R6 R6Class
+Dataset <- R6::R6Class(
+  "lgb.Dataset",
+  public = list(
+    handle = NULL,
+
+    initialize = function(data, params = list(), label = NULL, weight = NULL,
+                          group = NULL, init_score = NULL, reference = NULL) {
+      private$params <- params
+      ref_handle <- if (is.null(reference)) NULL else reference$handle
+      if (is.character(data)) {
+        self$handle <- .Call(LGBMTPU_DatasetCreateFromFile_R, data,
+                             lgb.params2str(params), ref_handle)
+      } else {
+        data <- as.matrix(data)
+        storage.mode(data) <- "double"
+        self$handle <- .Call(LGBMTPU_DatasetCreateFromMat_R, data,
+                             nrow(data), ncol(data),
+                             lgb.params2str(params), ref_handle)
+      }
+      if (!is.null(label)) self$set_field("label", label)
+      if (!is.null(weight)) self$set_field("weight", weight)
+      if (!is.null(group)) self$set_field("group", group)
+      if (!is.null(init_score)) self$set_field("init_score", init_score)
+    },
+
+    set_field = function(name, data) {
+      if (name %in% c("group", "query")) {
+        data <- as.integer(data)
+      } else {
+        data <- as.numeric(data)
+      }
+      .Call(LGBMTPU_DatasetSetField_R, self$handle, name, data)
+      invisible(self)
+    },
+
+    dim = function() {
+      c(.Call(LGBMTPU_DatasetGetNumData_R, self$handle),
+        .Call(LGBMTPU_DatasetGetNumFeature_R, self$handle))
+    },
+
+    create_valid = function(data, label = NULL, weight = NULL, group = NULL) {
+      Dataset$new(data, private$params, label, weight, group,
+                  reference = self)
+    }
+  ),
+  private = list(params = NULL)
+)
+
+#' Construct a lgb.Dataset
+#' @export
+lgb.Dataset <- function(data, params = list(), label = NULL, weight = NULL,
+                        group = NULL, init_score = NULL, reference = NULL) {
+  Dataset$new(data, params, label, weight, group, init_score, reference)
+}
+
+# params list -> "k1=v1 k2=v2" string through the C ABI (the same free-form
+# contract the Python binding uses, reference basic.py param_dict_to_str)
+lgb.params2str <- function(params) {
+  if (length(params) == 0) return("")
+  paste(vapply(names(params), function(k) {
+    v <- params[[k]]
+    if (is.logical(v)) v <- tolower(as.character(v))
+    paste0(k, "=", paste(v, collapse = ","))
+  }, character(1)), collapse = " ")
+}

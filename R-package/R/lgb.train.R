@@ -1,0 +1,45 @@
+# Training loop (role of reference R-package/R/lgb.train.R).
+
+#' Train a lightgbm.tpu model
+#'
+#' Mirrors the upstream lgb.train signature subset: params list, lgb.Dataset,
+#' nrounds, valids, early stopping on the first metric.
+#' @export
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), early_stopping_rounds = NULL,
+                      verbose = 1L) {
+  booster <- Booster$new(params, train_set = data)
+  vnames <- names(valids)
+  for (i in seq_along(valids)) {
+    booster$add_valid(valids[[i]], vnames[[i]])
+  }
+  best_score <- Inf
+  best_iter <- -1L
+  for (i in seq_len(nrounds)) {
+    finished <- booster$update()
+    if (length(valids) > 0) {
+      ev <- booster$eval(1L)
+      if (length(ev) > 0) {
+        if (verbose > 0) {
+          message(sprintf("[%d] valid: %s", i,
+                          paste(signif(ev, 6), collapse = ", ")))
+        }
+        if (!is.null(early_stopping_rounds)) {
+          if (ev[[1]] < best_score) {
+            best_score <- ev[[1]]
+            best_iter <- i
+          } else if (i - best_iter >= early_stopping_rounds) {
+            if (verbose > 0) {
+              message(sprintf("Early stopping, best iteration is: %d",
+                              best_iter))
+            }
+            booster$best_iter <- best_iter
+            break
+          }
+        }
+      }
+    }
+    if (isTRUE(finished)) break
+  }
+  booster
+}

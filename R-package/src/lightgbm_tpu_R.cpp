@@ -1,0 +1,310 @@
+// R glue for lightgbm_tpu: .Call wrappers over the C ABI.
+//
+// Role of the reference's R glue (reference: R-package/src/lightgbm_R.cpp,
+// 627 LoC re-exporting the C API with SEXP types). Links against
+// lib_lightgbm_tpu.so (capi/c_api.cpp), which embeds the JAX runtime; R
+// only marshals vectors and external pointers.
+//
+// Build (from R-package/): R CMD INSTALL .   (Makevars links ../capi)
+
+#include <R.h>
+#include <Rinternals.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+const char* LGBM_GetLastError();
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters, DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               DatasetHandle reference, DatasetHandle* out);
+int LGBM_DatasetFree(DatasetHandle handle);
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type);
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out);
+int LGBM_BoosterCreateFromModelfile(const char* filename, int* out_num_iters,
+                                    BoosterHandle* out);
+int LGBM_BoosterLoadModelFromString(const char* model_str, int* out_num_iters,
+                                    BoosterHandle* out);
+int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, const char* filename);
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
+                                  int num_iteration, int64_t buffer_len,
+                                  int64_t* out_len, char* out_str);
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results);
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out);
+}
+
+namespace {
+
+void CheckCall(int err, const char* what) {
+  if (err != 0) {
+    Rf_error("lightgbm.tpu: %s failed: %s", what, LGBM_GetLastError());
+  }
+}
+
+void DatasetFinalizer(SEXP ptr) {
+  DatasetHandle h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    LGBM_DatasetFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+void BoosterFinalizer(SEXP ptr) {
+  BoosterHandle h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    LGBM_BoosterFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+SEXP WrapHandle(void* h, R_CFinalizer_t fin) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+// --- Dataset ---------------------------------------------------------------
+
+SEXP LGBMTPU_DatasetCreateFromMat_R(SEXP mat, SEXP nrow, SEXP ncol,
+                                    SEXP params, SEXP reference) {
+  DatasetHandle ref = Rf_isNull(reference)
+                          ? nullptr
+                          : R_ExternalPtrAddr(reference);
+  DatasetHandle out = nullptr;
+  // R matrices are column-major doubles
+  CheckCall(LGBM_DatasetCreateFromMat(REAL(mat), /*data_type=*/1,
+                                      Rf_asInteger(nrow), Rf_asInteger(ncol),
+                                      /*is_row_major=*/0,
+                                      CHAR(Rf_asChar(params)), ref, &out),
+            "DatasetCreateFromMat");
+  return WrapHandle(out, DatasetFinalizer);
+}
+
+SEXP LGBMTPU_DatasetCreateFromFile_R(SEXP filename, SEXP params,
+                                     SEXP reference) {
+  DatasetHandle ref = Rf_isNull(reference)
+                          ? nullptr
+                          : R_ExternalPtrAddr(reference);
+  DatasetHandle out = nullptr;
+  CheckCall(LGBM_DatasetCreateFromFile(CHAR(Rf_asChar(filename)),
+                                       CHAR(Rf_asChar(params)), ref, &out),
+            "DatasetCreateFromFile");
+  return WrapHandle(out, DatasetFinalizer);
+}
+
+SEXP LGBMTPU_DatasetSetField_R(SEXP handle, SEXP field, SEXP data) {
+  const char* name = CHAR(Rf_asChar(field));
+  int n = Rf_length(data);
+  if (strcmp(name, "group") == 0 || strcmp(name, "query") == 0) {
+    std::vector<int32_t> v(n);
+    for (int i = 0; i < n; ++i) v[i] = INTEGER(data)[i];
+    CheckCall(LGBM_DatasetSetField(R_ExternalPtrAddr(handle), name, v.data(),
+                                   n, /*type=*/2),
+              "DatasetSetField");
+  } else {
+    std::vector<float> v(n);
+    double* src = REAL(data);
+    for (int i = 0; i < n; ++i) v[i] = (float)src[i];
+    CheckCall(LGBM_DatasetSetField(R_ExternalPtrAddr(handle), name, v.data(),
+                                   n, /*type=*/0),
+              "DatasetSetField");
+  }
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_DatasetGetNumData_R(SEXP handle) {
+  int32_t out = 0;
+  CheckCall(LGBM_DatasetGetNumData(R_ExternalPtrAddr(handle), &out),
+            "DatasetGetNumData");
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBMTPU_DatasetGetNumFeature_R(SEXP handle) {
+  int32_t out = 0;
+  CheckCall(LGBM_DatasetGetNumFeature(R_ExternalPtrAddr(handle), &out),
+            "DatasetGetNumFeature");
+  return Rf_ScalarInteger(out);
+}
+
+// --- Booster ---------------------------------------------------------------
+
+SEXP LGBMTPU_BoosterCreate_R(SEXP train, SEXP params) {
+  BoosterHandle out = nullptr;
+  CheckCall(LGBM_BoosterCreate(R_ExternalPtrAddr(train),
+                               CHAR(Rf_asChar(params)), &out),
+            "BoosterCreate");
+  return WrapHandle(out, BoosterFinalizer);
+}
+
+SEXP LGBMTPU_BoosterCreateFromModelfile_R(SEXP filename) {
+  BoosterHandle out = nullptr;
+  int iters = 0;
+  CheckCall(LGBM_BoosterCreateFromModelfile(CHAR(Rf_asChar(filename)), &iters,
+                                            &out),
+            "BoosterCreateFromModelfile");
+  return WrapHandle(out, BoosterFinalizer);
+}
+
+SEXP LGBMTPU_BoosterAddValidData_R(SEXP handle, SEXP valid) {
+  CheckCall(LGBM_BoosterAddValidData(R_ExternalPtrAddr(handle),
+                                     R_ExternalPtrAddr(valid)),
+            "BoosterAddValidData");
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterUpdateOneIter_R(SEXP handle) {
+  int finished = 0;
+  CheckCall(LGBM_BoosterUpdateOneIter(R_ExternalPtrAddr(handle), &finished),
+            "BoosterUpdateOneIter");
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP LGBMTPU_BoosterRollbackOneIter_R(SEXP handle) {
+  CheckCall(LGBM_BoosterRollbackOneIter(R_ExternalPtrAddr(handle)),
+            "BoosterRollbackOneIter");
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterGetCurrentIteration_R(SEXP handle) {
+  int out = 0;
+  CheckCall(LGBM_BoosterGetCurrentIteration(R_ExternalPtrAddr(handle), &out),
+            "BoosterGetCurrentIteration");
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBMTPU_BoosterGetEval_R(SEXP handle, SEXP data_idx) {
+  int count = 0;
+  CheckCall(LGBM_BoosterGetEvalCounts(R_ExternalPtrAddr(handle), &count),
+            "BoosterGetEvalCounts");
+  std::vector<double> results(count > 0 ? count : 1);
+  int out_len = 0;
+  CheckCall(LGBM_BoosterGetEval(R_ExternalPtrAddr(handle),
+                                Rf_asInteger(data_idx), &out_len,
+                                results.data()),
+            "BoosterGetEval");
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, out_len));
+  for (int i = 0; i < out_len; ++i) REAL(out)[i] = results[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMTPU_BoosterSaveModel_R(SEXP handle, SEXP num_iteration,
+                                SEXP filename) {
+  CheckCall(LGBM_BoosterSaveModel(R_ExternalPtrAddr(handle), 0,
+                                  Rf_asInteger(num_iteration),
+                                  CHAR(Rf_asChar(filename))),
+            "BoosterSaveModel");
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterSaveModelToString_R(SEXP handle, SEXP num_iteration) {
+  int64_t out_len = 0;
+  // first call sizes the buffer
+  LGBM_BoosterSaveModelToString(R_ExternalPtrAddr(handle), 0,
+                                Rf_asInteger(num_iteration), 0, &out_len,
+                                nullptr);
+  std::vector<char> buf((size_t)out_len + 1);
+  CheckCall(LGBM_BoosterSaveModelToString(R_ExternalPtrAddr(handle), 0,
+                                          Rf_asInteger(num_iteration),
+                                          out_len + 1, &out_len, buf.data()),
+            "BoosterSaveModelToString");
+  return Rf_mkString(buf.data());
+}
+
+SEXP LGBMTPU_BoosterPredictForMat_R(SEXP handle, SEXP mat, SEXP nrow,
+                                    SEXP ncol, SEXP predict_type,
+                                    SEXP num_iteration) {
+  int nr = Rf_asInteger(nrow);
+  int nc = Rf_asInteger(ncol);
+  int num_class = 1;
+  LGBM_BoosterGetNumClasses(R_ExternalPtrAddr(handle), &num_class);
+  int64_t cap = (int64_t)nr * num_class;
+  if (Rf_asInteger(predict_type) == 2) cap = (int64_t)nr * 4096;  // leaves
+  if (Rf_asInteger(predict_type) == 3) cap = (int64_t)nr * (nc + 1) * num_class;
+  std::vector<double> out(cap);
+  int64_t out_len = 0;
+  CheckCall(LGBM_BoosterPredictForMat(
+                R_ExternalPtrAddr(handle), REAL(mat), /*data_type=*/1, nr, nc,
+                /*is_row_major=*/0, Rf_asInteger(predict_type),
+                Rf_asInteger(num_iteration), "", &out_len, out.data()),
+            "BoosterPredictForMat");
+  SEXP res = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)out_len));
+  memcpy(REAL(res), out.data(), sizeof(double) * (size_t)out_len);
+  UNPROTECT(1);
+  return res;
+}
+
+SEXP LGBMTPU_BoosterFeatureImportance_R(SEXP handle, SEXP num_iteration,
+                                        SEXP importance_type) {
+  int nfeat = 0;
+  CheckCall(LGBM_BoosterGetNumFeature(R_ExternalPtrAddr(handle), &nfeat),
+            "BoosterGetNumFeature");
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, nfeat));
+  CheckCall(LGBM_BoosterFeatureImportance(R_ExternalPtrAddr(handle),
+                                          Rf_asInteger(num_iteration),
+                                          Rf_asInteger(importance_type),
+                                          REAL(out)),
+            "BoosterFeatureImportance");
+  UNPROTECT(1);
+  return out;
+}
+
+// --- registration ----------------------------------------------------------
+
+static const R_CallMethodDef CallEntries[] = {
+    {"LGBMTPU_DatasetCreateFromMat_R", (DL_FUNC)&LGBMTPU_DatasetCreateFromMat_R, 5},
+    {"LGBMTPU_DatasetCreateFromFile_R", (DL_FUNC)&LGBMTPU_DatasetCreateFromFile_R, 3},
+    {"LGBMTPU_DatasetSetField_R", (DL_FUNC)&LGBMTPU_DatasetSetField_R, 3},
+    {"LGBMTPU_DatasetGetNumData_R", (DL_FUNC)&LGBMTPU_DatasetGetNumData_R, 1},
+    {"LGBMTPU_DatasetGetNumFeature_R", (DL_FUNC)&LGBMTPU_DatasetGetNumFeature_R, 1},
+    {"LGBMTPU_BoosterCreate_R", (DL_FUNC)&LGBMTPU_BoosterCreate_R, 2},
+    {"LGBMTPU_BoosterCreateFromModelfile_R", (DL_FUNC)&LGBMTPU_BoosterCreateFromModelfile_R, 1},
+    {"LGBMTPU_BoosterAddValidData_R", (DL_FUNC)&LGBMTPU_BoosterAddValidData_R, 2},
+    {"LGBMTPU_BoosterUpdateOneIter_R", (DL_FUNC)&LGBMTPU_BoosterUpdateOneIter_R, 1},
+    {"LGBMTPU_BoosterRollbackOneIter_R", (DL_FUNC)&LGBMTPU_BoosterRollbackOneIter_R, 1},
+    {"LGBMTPU_BoosterGetCurrentIteration_R", (DL_FUNC)&LGBMTPU_BoosterGetCurrentIteration_R, 1},
+    {"LGBMTPU_BoosterGetEval_R", (DL_FUNC)&LGBMTPU_BoosterGetEval_R, 2},
+    {"LGBMTPU_BoosterSaveModel_R", (DL_FUNC)&LGBMTPU_BoosterSaveModel_R, 3},
+    {"LGBMTPU_BoosterSaveModelToString_R", (DL_FUNC)&LGBMTPU_BoosterSaveModelToString_R, 2},
+    {"LGBMTPU_BoosterPredictForMat_R", (DL_FUNC)&LGBMTPU_BoosterPredictForMat_R, 6},
+    {"LGBMTPU_BoosterFeatureImportance_R", (DL_FUNC)&LGBMTPU_BoosterFeatureImportance_R, 3},
+    {NULL, NULL, 0}};
+
+void R_init_lightgbm_tpu(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, CallEntries, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}
+
+}  // extern "C"

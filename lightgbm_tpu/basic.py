@@ -191,6 +191,8 @@ class Dataset:
         sub.categorical_feature = self.categorical_feature
         inner = copy.copy(self._inner)
         inner.binned = self._inner.binned[used_indices]
+        if getattr(self._inner, "bundled", None) is not None:
+            inner.bundled = self._inner.bundled[used_indices]
         inner.num_data = len(used_indices)
         from .io.dataset import Metadata
         md = Metadata(inner.num_data)

@@ -110,6 +110,13 @@ class Dataset:
                 [self.bin_mappers[i].num_bin for i in self.used_features], default=1)
 
         self.binned = self._bin_data(data)
+        # EFB: plan storage columns and encode the bundled matrix
+        # (reference: dataset.cpp:69-225 FindGroups/FastFeatureBundling).
+        # self.binned stays the logical per-feature view for generic
+        # consumers; the device learner trains on the narrower bundle view.
+        self.columns = (reference.columns if reference is not None
+                        else self._plan_bundles())
+        self.bundled = self._encode_bundles() if self.columns else None
         # raw column stats used for leaf renewal on some objectives
         self._device_cache: Dict[str, Any] = {}
 
@@ -205,6 +212,56 @@ class Dataset:
         return out
 
     # ------------------------------------------------------------------
+    def _plan_bundles(self):
+        """EFB column plan from a sample of the binned matrix."""
+        from .bundling import plan_columns
+        cfg = self.config
+        if (not cfg.enable_bundle or self.num_features <= 1
+                or self.num_data == 0):
+            return None
+        sample = min(self.num_data, 50_000)
+        rows = (np.linspace(0, self.num_data - 1, sample).astype(np.int64)
+                if sample < self.num_data else np.arange(self.num_data))
+        sample_bins = [self.binned[rows, j].astype(np.int32)
+                       for j in range(self.num_features)]
+        cols = plan_columns(self.used_features, self.bin_mappers, sample_bins,
+                            cfg.max_conflict_rate, cfg.sparse_threshold)
+        if all(len(c.features) == 1 for c in cols):
+            return None
+        return cols
+
+    def _encode_bundles(self) -> np.ndarray:
+        from .bundling import encode_bundle
+        col_bins = max(c.num_bins for c in self.columns)
+        dtype = np.uint8 if col_bins <= 256 else np.uint16
+        out = np.zeros((self.num_data, len(self.columns)), dtype=dtype)
+        for ci, col in enumerate(self.columns):
+            if not col.is_bundle:
+                out[:, ci] = self.binned[:, col.features[0]].astype(dtype)
+                continue
+            for j, base in zip(col.features, col.bases):
+                m = self.bin_mappers[self.used_features[j]]
+                encode_bundle(out[:, ci], self.binned[:, j].astype(np.int32),
+                              base, m.default_bin)
+        return out
+
+    def bundle_arrays(self):
+        """Device maps for the bundled view (None when unbundled):
+        (bundled codes (N, C), f_col, f_base, f_elide, hist_idx, col_bins)."""
+        if self.bundled is None:
+            return None
+        import jax.numpy as jnp
+        if "bundle" not in self._device_cache:
+            from .bundling import expansion_arrays
+            f_col, f_base, f_elide, hist_idx, col_bins = expansion_arrays(
+                self.columns, self.used_features, self.bin_mappers,
+                self.num_features, self.max_num_bins)
+            self._device_cache["bundle"] = (
+                jnp.asarray(self.bundled), jnp.asarray(f_col),
+                jnp.asarray(f_base), jnp.asarray(f_elide),
+                jnp.asarray(hist_idx), col_bins)
+        return self._device_cache["bundle"]
+
     @property
     def num_features(self) -> int:
         return len(self.used_features)
@@ -303,5 +360,7 @@ class Dataset:
         if len(z["init_score"]):
             obj.metadata.init_score = z["init_score"]
         obj.reference = None
+        obj.columns = obj._plan_bundles()
+        obj.bundled = obj._encode_bundles() if obj.columns else None
         obj._device_cache = {}
         return obj

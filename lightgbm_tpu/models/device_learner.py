@@ -1,0 +1,675 @@
+"""Whole-tree-on-device leaf-wise learner.
+
+The host-loop learner (serial_learner.py) mirrors the reference's phase
+structure (serial_tree_learner.cpp:173-237) and pays one host round-trip per
+split — ruinous through a tunneled TPU, and every distinct leaf size
+recompiles a bucket shape. This learner is the TPU-native answer flagged in
+SURVEY.md §7 ("leaf-wise growth is inherently dynamic-shape"): grow the
+ENTIRE tree inside one jitted `lax.while_loop` with static shapes.
+
+Design deltas vs the reference's DataPartition/HistogramPool machinery:
+
+* No permutation buffer. Row membership is a dense (N,) `leaf_id` vector;
+  a split rewrites it with a masked `where` — O(N) elementwise, no sort.
+* Histograms are built over the FULL row set with per-row weights
+  `gh * (leaf_id == leaf)`. O(N) per split instead of O(leaf), but the
+  histogram path runs at HBM speed on the MXU (ops/pallas), so N x (L-1)
+  work is orders of magnitude cheaper than L-1 host syncs.
+* The histogram pool (feature_histogram.hpp:654-831) becomes a dense
+  (L, F, B, 3) device array: parent slot is overwritten by the left child,
+  the right child is parent - left (FeatureHistogram::Subtract semantics).
+* Per-split records (split leaf, feature, bin, gain, child stats) are
+  written into (L-1,) arrays; the host replays them into a `Tree` after the
+  loop — one device->host transfer per tree.
+* Leaf-wise leaf selection = argmax over the (L,) per-leaf best-gain array,
+  exactly the `best_split_per_leaf_` argmax of the reference.
+
+Monotone constraints propagate like serial_tree_learner.cpp:771-852 (basic
+mode); depth limits gate stored gains. Categorical splits, forced splits and
+CEGB fall back to the host-loop learner (create_tree_learner picks).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..io.binning import BIN_CATEGORICAL
+from ..io.dataset import Dataset
+from ..ops import bundle as bundle_ops
+from ..ops import split as split_ops
+from ..ops.partition import decide_left
+from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
+from ..utils import log
+from .tree import Tree
+
+NEG_INF = split_ops.NEG_INF
+_POOL_BYTE_LIMIT = 2 << 30
+
+
+def _env(name, default):
+    import os
+    return os.environ.get(name, default)
+
+
+class _Best(NamedTuple):
+    """Per-leaf best-split state, all (L,) arrays (the device analog of the
+    reference's best_split_per_leaf_)."""
+    gain: jax.Array
+    feat: jax.Array
+    thr: jax.Array
+    dleft: jax.Array
+    lsg: jax.Array
+    lsh: jax.Array
+    lcnt: jax.Array
+    rsg: jax.Array
+    rsh: jax.Array
+    rcnt: jax.Array
+    lout: jax.Array
+    rout: jax.Array
+
+
+class _Rec(NamedTuple):
+    """Per-split records, all (L-1,) arrays, replayed on host into a Tree."""
+    leaf: jax.Array
+    feat: jax.Array
+    thr: jax.Array
+    dleft: jax.Array
+    gain: jax.Array
+    lsg: jax.Array
+    lsh: jax.Array
+    lcnt: jax.Array
+    rsg: jax.Array
+    rsh: jax.Array
+    rcnt: jax.Array
+    lout: jax.Array
+    rout: jax.Array
+
+
+class _Carry(NamedTuple):
+    k: jax.Array
+    leaf_id: jax.Array
+    pool: jax.Array
+    depth: jax.Array
+    leaf_min: jax.Array
+    leaf_max: jax.Array
+    best: _Best
+    rec: _Rec
+    key: jax.Array
+
+
+def _hist_t(codes_t, gh, num_bins, use_pallas):
+    if use_pallas:
+        return build_histogram_pallas_t(codes_t, gh, num_bins)
+    from ..ops.histogram import build_histogram
+    return build_histogram(jnp.swapaxes(codes_t, 0, 1), gh, num_bins,
+                           use_pallas=False)
+
+
+def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
+                  f_penalty, f_elide, hist_idx, *, num_bins, max_depth,
+                  l1, l2, max_delta_step, min_data_in_leaf, min_sum_hessian,
+                  min_gain_to_split, bynode_k):
+    """Shared pieces of both growth strategies: per-node feature sampling,
+    the (expand + scan + materialize) split search, and per-leaf best-state
+    stores with depth gating."""
+    f = f_numbins.shape[0]
+    scan_kwargs = dict(
+        num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split)
+
+    def node_mask(key):
+        if bynode_k <= 0:
+            return base_mask
+        u = jnp.where(base_mask, jax.random.uniform(key, (f,)), jnp.inf)
+        kth = jnp.sort(u)[bynode_k - 1]
+        return base_mask & (u <= kth)
+
+    def scan(col_hist, sg, sh, cnt, mn, mx, fmask):
+        hist = bundle_ops.expand_column_hist(
+            col_hist, jnp.stack([sg, sh, cnt]), hist_idx, f_elide, f_default)
+        rel, t, use_m1, prefix = split_ops.per_feature_best(
+            hist, sg, sh, cnt, f_numbins, f_missing, f_default, fmask,
+            f_monotone, mn, mx, f_penalty, None, **scan_kwargs)
+        feat = jnp.argmax(rel).astype(jnp.int32)
+        return split_ops.materialize_split(
+            feat, rel, t, use_m1, prefix, sg, sh, cnt, mn, mx,
+            l1=l1, l2=l2, max_delta_step=max_delta_step)
+
+    def store_best(best: _Best, i, res: split_ops.SplitResult,
+                   child_depth) -> _Best:
+        gain = res.gain
+        if max_depth > 0:
+            gain = jnp.where(child_depth >= max_depth, NEG_INF, gain)
+        return _Best(
+            best.gain.at[i].set(gain), best.feat.at[i].set(res.feature),
+            best.thr.at[i].set(res.threshold),
+            best.dleft.at[i].set(res.default_left),
+            best.lsg.at[i].set(res.left_sum_grad),
+            best.lsh.at[i].set(res.left_sum_hess),
+            best.lcnt.at[i].set(res.left_count),
+            best.rsg.at[i].set(res.right_sum_grad),
+            best.rsh.at[i].set(res.right_sum_hess),
+            best.rcnt.at[i].set(res.right_count),
+            best.lout.at[i].set(res.left_output),
+            best.rout.at[i].set(res.right_output))
+
+    return node_mask, scan, store_best
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "num_bins", "col_bins", "max_depth",
+                     "l1", "l2",
+                     "max_delta_step", "min_data_in_leaf", "min_sum_hessian",
+                     "min_gain_to_split", "bynode_k", "use_pallas"))
+def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
+              grad: jax.Array, hess: jax.Array,   # (N,)
+              w: jax.Array,               # (N,) bagging weight (0/1)
+              base_mask: jax.Array,       # (F,) bool feature sample
+              f_numbins, f_missing, f_default, f_monotone,  # (F,) int32
+              f_penalty,                  # (F,) f32 gain multipliers
+              f_col, f_base, f_elide,     # (F,) int32 EFB maps
+              hist_idx,                   # (F, B) int32 expansion gather
+              rng_key,                    # PRNG key for by-node sampling
+              *, num_leaves: int, num_bins: int, col_bins: int,
+              max_depth: int,
+              l1: float, l2: float, max_delta_step: float,
+              min_data_in_leaf: int, min_sum_hessian: float,
+              min_gain_to_split: float, bynode_k: int, use_pallas: bool):
+    c_cols, n = codes_t.shape
+    f = f_numbins.shape[0]
+    L = num_leaves
+    gh = jnp.stack([grad * w, hess * w, w], axis=1)     # (N, 3)
+    node_mask, scan, store_best = _tree_helpers(
+        base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
+        f_elide, hist_idx,
+        num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
+        max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
+        bynode_k=bynode_k)
+
+    # ---- root ------------------------------------------------------------
+    hist0 = _hist_t(codes_t, gh, col_bins, use_pallas)
+    totals = hist0[0].sum(axis=0)                       # (3,): sum_g, sum_h, cnt
+    root_key, loop_key = jax.random.split(rng_key)
+    root_res = scan(hist0, totals[0], totals[1], totals[2],
+                    jnp.float32(-np.inf), jnp.float32(np.inf),
+                    node_mask(root_key))
+
+    zf = functools.partial(jnp.zeros, dtype=jnp.float32)
+    zi = functools.partial(jnp.zeros, dtype=jnp.int32)
+    best = _Best(jnp.full((L,), NEG_INF, jnp.float32), zi(L), zi(L),
+                 jnp.zeros(L, bool), zf(L), zf(L), zf(L), zf(L), zf(L),
+                 zf(L), zf(L), zf(L))
+    # the depth argument is the stored leaf's own depth (a leaf at depth d
+    # may split iff d < max_depth, reference _splittable); root sits at 0
+    best = store_best(best, 0, root_res, jnp.int32(0))
+    pool = jnp.zeros((L, c_cols, col_bins, 3), jnp.float32).at[0].set(hist0)
+    rec = _Rec(zi(L - 1), zi(L - 1), zi(L - 1), jnp.zeros(L - 1, bool),
+               zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1),
+               zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1))
+    carry = _Carry(
+        k=jnp.int32(0), leaf_id=jnp.zeros(n, jnp.int32), pool=pool,
+        depth=zi(L),
+        leaf_min=jnp.full((L,), -np.inf, jnp.float32),
+        leaf_max=jnp.full((L,), np.inf, jnp.float32),
+        best=best, rec=rec, key=loop_key)
+
+    def cond(c: _Carry):
+        return (c.k < L - 1) & (jnp.max(c.best.gain) > 1e-10)
+
+    def body(c: _Carry) -> _Carry:
+        b = c.best
+        l = jnp.argmax(b.gain).astype(jnp.int32)
+        new_id = c.k + 1
+        feat = b.feat[l]
+        thr = b.thr[l]
+        dleft = b.dleft[l]
+
+        col = jax.lax.dynamic_slice_in_dim(codes_t, f_col[feat], 1, axis=0)[0]
+        fbins = bundle_ops.logical_bins_for_feature(
+            col.astype(jnp.int32), f_base[feat], f_default[feat],
+            f_numbins[feat], f_elide[feat])
+        go_left = decide_left(fbins, thr, dleft,
+                              f_missing[feat], f_default[feat], f_numbins[feat])
+        parent = c.leaf_id == l
+        lmask = parent & go_left
+        leaf_id = jnp.where(parent & ~go_left, new_id, c.leaf_id)
+
+        ghl = gh * lmask[:, None].astype(jnp.float32)
+        hist_l = _hist_t(codes_t, ghl, col_bins, use_pallas)
+        hist_r = c.pool[l] - hist_l
+        pool = c.pool.at[l].set(hist_l).at[new_id].set(hist_r)
+
+        # monotone constraint propagation (basic mode)
+        mono_f = f_monotone[feat]
+        mid = (b.lout[l] + b.rout[l]) * 0.5
+        pmin, pmax = c.leaf_min[l], c.leaf_max[l]
+        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
+        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
+        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
+        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
+        leaf_min = c.leaf_min.at[l].set(lmin).at[new_id].set(rmin)
+        leaf_max = c.leaf_max.at[l].set(lmax).at[new_id].set(rmax)
+        child_depth = c.depth[l] + 1
+        depth = c.depth.at[l].set(child_depth).at[new_id].set(child_depth)
+
+        rec = _Rec(
+            c.rec.leaf.at[c.k].set(l), c.rec.feat.at[c.k].set(feat),
+            c.rec.thr.at[c.k].set(thr), c.rec.dleft.at[c.k].set(dleft),
+            c.rec.gain.at[c.k].set(b.gain[l]),
+            c.rec.lsg.at[c.k].set(b.lsg[l]), c.rec.lsh.at[c.k].set(b.lsh[l]),
+            c.rec.lcnt.at[c.k].set(b.lcnt[l]),
+            c.rec.rsg.at[c.k].set(b.rsg[l]), c.rec.rsh.at[c.k].set(b.rsh[l]),
+            c.rec.rcnt.at[c.k].set(b.rcnt[l]),
+            c.rec.lout.at[c.k].set(b.lout[l]),
+            c.rec.rout.at[c.k].set(b.rout[l]))
+
+        key, kl, kr = jax.random.split(c.key, 3)
+        res_l = scan(hist_l, b.lsg[l], b.lsh[l], b.lcnt[l], lmin, lmax,
+                     node_mask(kl))
+        res_r = scan(hist_r, b.rsg[l], b.rsh[l], b.rcnt[l], rmin, rmax,
+                     node_mask(kr))
+        best = store_best(b, l, res_l, child_depth)
+        best = store_best(best, new_id, res_r, child_depth)
+        return _Carry(new_id, leaf_id, pool, depth, leaf_min, leaf_max,
+                      best, rec, key)
+
+    out = jax.lax.while_loop(cond, body, carry)
+    return out.rec, out.leaf_id, out.k, totals
+
+
+class _CarryC(NamedTuple):
+    k: jax.Array
+    perm: jax.Array          # (N + Wmax,) row ids grouped by leaf window
+    pos_leaf: jax.Array      # (N + Wmax,) leaf id per PERM POSITION
+    leaf_begin: jax.Array    # (L,)
+    leaf_phys: jax.Array     # (L,) physical rows in the window
+    pool: jax.Array
+    depth: jax.Array
+    leaf_min: jax.Array
+    leaf_max: jax.Array
+    best: "_Best"
+    rec: "_Rec"
+    key: jax.Array
+
+
+def _size_classes(n: int, min_bucket: int = 4096, step: int = 4):
+    ws = []
+    wcur = min_bucket
+    while wcur < n:
+        ws.append(wcur)
+        wcur *= step
+    ws.append(n)
+    return ws
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "num_bins", "col_bins", "max_depth",
+                     "l1", "l2", "max_delta_step", "min_data_in_leaf",
+                     "min_sum_hessian", "min_gain_to_split", "bynode_k",
+                     "use_pallas"))
+def grow_tree_compact(
+        codes: jax.Array,            # (N, C) row-major for window gathers
+        codes_t: jax.Array,          # (C, N) for the root pass
+        grad: jax.Array, hess: jax.Array, w: jax.Array,
+        base_mask: jax.Array,
+        f_numbins, f_missing, f_default, f_monotone, f_penalty,
+        f_col, f_base, f_elide, hist_idx, rng_key,
+        *, num_leaves: int, num_bins: int, col_bins: int, max_depth: int,
+        l1: float, l2: float, max_delta_step: float,
+        min_data_in_leaf: int, min_sum_hessian: float,
+        min_gain_to_split: float, bynode_k: int, use_pallas: bool):
+    """Compaction-based whole-tree growth: O(leaf-size) work per split.
+
+    The masked strategy in grow_tree pays a full O(N) histogram pass per
+    split — ruinous at Higgs scale. This variant keeps the reference's
+    DataPartition idea (data_partition.hpp:20-205) on device: a permutation
+    buffer groups rows by leaf, each split gathers ONLY the split leaf's
+    window, partitions it with a stable 2-bit-key sort, and builds the
+    SMALLER child's histogram from the gathered window (sibling =
+    parent - smaller, FeatureHistogram::Subtract). Dynamic leaf sizes meet
+    XLA's static shapes through a small ladder of padded window classes
+    (x4 steps) dispatched with lax.switch — each class is traced once.
+    """
+    c_cols, n = codes_t.shape
+    L = num_leaves
+    gh = jnp.stack([grad * w, hess * w, w], axis=1)
+    node_mask, scan, store_best = _tree_helpers(
+        base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
+        f_elide, hist_idx,
+        num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
+        max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
+        bynode_k=bynode_k)
+
+    classes = _size_classes(n)
+    wmax = classes[-1]
+    thresholds = jnp.asarray(np.array(classes[:-1], np.int32))
+
+    # ---- root ------------------------------------------------------------
+    hist0 = _hist_t(codes_t, gh, col_bins, use_pallas)
+    totals = hist0[0].sum(axis=0)
+    root_key, loop_key = jax.random.split(rng_key)
+    root_res = scan(hist0, totals[0], totals[1], totals[2],
+                    jnp.float32(-np.inf), jnp.float32(np.inf),
+                    node_mask(root_key))
+
+    zf = functools.partial(jnp.zeros, dtype=jnp.float32)
+    zi = functools.partial(jnp.zeros, dtype=jnp.int32)
+    best = _Best(jnp.full((L,), NEG_INF, jnp.float32), zi(L), zi(L),
+                 jnp.zeros(L, bool), zf(L), zf(L), zf(L), zf(L), zf(L),
+                 zf(L), zf(L), zf(L))
+    best = store_best(best, 0, root_res, jnp.int32(0))
+    pool = jnp.zeros((L, c_cols, col_bins, 3), jnp.float32).at[0].set(hist0)
+    rec = _Rec(zi(L - 1), zi(L - 1), zi(L - 1), jnp.zeros(L - 1, bool),
+               zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1),
+               zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1))
+    carry = _CarryC(
+        k=jnp.int32(0),
+        perm=jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                              jnp.zeros(wmax, jnp.int32)]),
+        pos_leaf=jnp.zeros(n + wmax, jnp.int32),
+        leaf_begin=zi(L), leaf_phys=zi(L).at[0].set(n),
+        pool=pool, depth=zi(L),
+        leaf_min=jnp.full((L,), -np.inf, jnp.float32),
+        leaf_max=jnp.full((L,), np.inf, jnp.float32),
+        best=best, rec=rec, key=loop_key)
+
+    def cond(c: _CarryC):
+        return (c.k < L - 1) & (jnp.max(c.best.gain) > 1e-10)
+
+    def make_branch(wsz: int):
+        def branch(c: _CarryC) -> _CarryC:
+            b = c.best
+            l = jnp.argmax(b.gain).astype(jnp.int32)
+            new_id = c.k + 1
+            feat = b.feat[l]
+            begin = c.leaf_begin[l]
+            pcount = c.leaf_phys[l]
+
+            window = jax.lax.dynamic_slice(c.perm, (begin,), (wsz,))
+            valid = jnp.arange(wsz, dtype=jnp.int32) < pcount
+            rows = jnp.take(codes, window, axis=0)        # (W, C)
+            col = jax.lax.dynamic_slice_in_dim(
+                rows, f_col[feat], 1, axis=1)[:, 0].astype(jnp.int32)
+            fbins = bundle_ops.logical_bins_for_feature(
+                col, f_base[feat], f_default[feat], f_numbins[feat],
+                f_elide[feat])
+            go_left = decide_left(fbins, b.thr[l], b.dleft[l],
+                                  f_missing[feat], f_default[feat],
+                                  f_numbins[feat]) & valid
+
+            # stable partition of the window (reference DataPartition::Split)
+            key3 = jnp.where(valid, jnp.where(go_left, 0, 1), 2)
+            order = jnp.argsort(key3.astype(jnp.int8), stable=True)
+            new_window = window[order]
+            perm = jax.lax.dynamic_update_slice(c.perm, new_window, (begin,))
+            lphys = jnp.sum(go_left.astype(jnp.int32))
+
+            pos = jnp.arange(wsz, dtype=jnp.int32)
+            old_slice = jax.lax.dynamic_slice(c.pos_leaf, (begin,), (wsz,))
+            new_slice = jnp.where(pos < lphys, l,
+                                  jnp.where(pos < pcount, new_id, old_slice))
+            pos_leaf = jax.lax.dynamic_update_slice(
+                c.pos_leaf, new_slice, (begin,))
+
+            leaf_begin = c.leaf_begin.at[new_id].set(begin + lphys)
+            leaf_phys = c.leaf_phys.at[l].set(lphys).at[new_id].set(
+                pcount - lphys)
+
+            # smaller child's histogram from the (unsorted) gathered window
+            left_small = lphys * 2 <= pcount
+            small_mask = jnp.where(left_small, go_left, valid & ~go_left)
+            gh_w = jnp.take(gh, window, axis=0) * small_mask[:, None]
+            hist_small = _hist_t(jnp.swapaxes(rows, 0, 1), gh_w, col_bins,
+                                 use_pallas)
+            parent = c.pool[l]
+            hist_l = jnp.where(left_small, hist_small, parent - hist_small)
+            hist_r = jnp.where(left_small, parent - hist_small, hist_small)
+            pool = c.pool.at[l].set(hist_l).at[new_id].set(hist_r)
+
+            # monotone propagation + depth (same as masked strategy)
+            mono_f = f_monotone[feat]
+            mid = (b.lout[l] + b.rout[l]) * 0.5
+            pmin, pmax = c.leaf_min[l], c.leaf_max[l]
+            lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
+            lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
+            rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
+            rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
+            leaf_min = c.leaf_min.at[l].set(lmin).at[new_id].set(rmin)
+            leaf_max = c.leaf_max.at[l].set(lmax).at[new_id].set(rmax)
+            child_depth = c.depth[l] + 1
+            depth = c.depth.at[l].set(child_depth).at[new_id].set(child_depth)
+
+            rec2 = _Rec(
+                c.rec.leaf.at[c.k].set(l), c.rec.feat.at[c.k].set(feat),
+                c.rec.thr.at[c.k].set(b.thr[l]),
+                c.rec.dleft.at[c.k].set(b.dleft[l]),
+                c.rec.gain.at[c.k].set(b.gain[l]),
+                c.rec.lsg.at[c.k].set(b.lsg[l]),
+                c.rec.lsh.at[c.k].set(b.lsh[l]),
+                c.rec.lcnt.at[c.k].set(b.lcnt[l]),
+                c.rec.rsg.at[c.k].set(b.rsg[l]),
+                c.rec.rsh.at[c.k].set(b.rsh[l]),
+                c.rec.rcnt.at[c.k].set(b.rcnt[l]),
+                c.rec.lout.at[c.k].set(b.lout[l]),
+                c.rec.rout.at[c.k].set(b.rout[l]))
+
+            key, kl, kr = jax.random.split(c.key, 3)
+            res_l = scan(hist_l, b.lsg[l], b.lsh[l], b.lcnt[l], lmin, lmax,
+                         node_mask(kl))
+            res_r = scan(hist_r, b.rsg[l], b.rsh[l], b.rcnt[l], rmin, rmax,
+                         node_mask(kr))
+            best2 = store_best(b, l, res_l, child_depth)
+            best2 = store_best(best2, new_id, res_r, child_depth)
+            return _CarryC(new_id, perm, pos_leaf, leaf_begin, leaf_phys,
+                           pool, depth, leaf_min, leaf_max, best2, rec2, key)
+        return branch
+
+    branches = [make_branch(wsz) for wsz in classes]
+
+    def body(c: _CarryC) -> _CarryC:
+        l = jnp.argmax(c.best.gain).astype(jnp.int32)
+        pcount = c.leaf_phys[l]
+        j = jnp.sum((pcount > thresholds).astype(jnp.int32))
+        return jax.lax.switch(j, branches, c)
+
+    out = jax.lax.while_loop(cond, body, carry)
+    # final row -> leaf map: scatter window-position leaves onto row ids
+    leaf_id = jnp.zeros(n, jnp.int32).at[out.perm[:n]].set(
+        out.pos_leaf[:n], unique_indices=True)
+    return out.rec, leaf_id, out.k, totals
+
+
+class DeviceTreeLearner:
+    """Drop-in TreeLearner whose Train runs one jitted program per tree."""
+
+    def __init__(self, config: Config, dataset: Dataset):
+        self.config = config
+        self.dataset = dataset
+        (self.f_numbins, self.f_missing, self.f_default,
+         self.f_categorical, self.f_monotone) = dataset.feature_meta_arrays()
+        self.num_features = dataset.num_features
+        self.num_bins = int(dataset.max_num_bins)
+        b = 1 << max(4, (self.num_bins - 1).bit_length())
+        self.device_bins = min(b, 256) if self.num_bins <= 256 else b
+        bundle = dataset.bundle_arrays()
+        if bundle is not None:
+            codes, f_col, f_base, f_elide, hist_idx, col_bins = bundle
+            self.codes_t = jnp.asarray(jnp.swapaxes(codes, 0, 1))  # (C, N)
+            self.f_col, self.f_base, self.f_elide = f_col, f_base, f_elide
+            cb = 1 << max(4, (int(col_bins) - 1).bit_length())
+            self.col_device_bins = min(cb, 256) if col_bins <= 256 else cb
+            # pad hist_idx bin axis to device_bins; pad slots hit the
+            # trailing zero entry of the flattened column histogram
+            zero_slot = len(dataset.columns) * self.col_device_bins
+            hi = np.asarray(hist_idx)
+            # re-space flat indices for the padded column bin count
+            raw_cb = int(col_bins)
+            cols_i = hi // raw_cb
+            bins_i = hi % raw_cb
+            invalid = hi == (len(dataset.columns) * raw_cb)
+            hi2 = np.where(invalid, zero_slot,
+                           cols_i * self.col_device_bins + bins_i)
+            pad = self.device_bins - hi2.shape[1]
+            if pad > 0:
+                hi2 = np.concatenate(
+                    [hi2, np.full((hi2.shape[0], pad), zero_slot, np.int32)],
+                    axis=1)
+            self.hist_idx = jnp.asarray(hi2.astype(np.int32))
+        else:
+            binned = dataset.device_binned()
+            self.codes_t = jnp.asarray(jnp.swapaxes(binned, 0, 1))  # (F, N)
+            nf = self.num_features
+            self.f_col = jnp.arange(nf, dtype=jnp.int32)
+            self.f_base = jnp.zeros(nf, jnp.int32)
+            self.f_elide = jnp.zeros(nf, jnp.int32)
+            self.col_device_bins = self.device_bins
+            zero_slot = nf * self.device_bins
+            hi = (np.arange(nf, dtype=np.int64)[:, None] * self.device_bins
+                  + np.arange(self.device_bins)[None, :])
+            nb = np.asarray(self.f_numbins)[:, None]
+            hi = np.where(np.arange(self.device_bins)[None, :] < nb,
+                          hi, zero_slot)
+            self.hist_idx = jnp.asarray(hi.astype(np.int32))
+        contri = config.feature_contri or []
+        pen = np.array([contri[fr] if fr < len(contri) else 1.0
+                        for fr in dataset.used_features], dtype=np.float32)
+        self.f_penalty = jnp.asarray(pen)
+        self._use_pallas = jax.default_backend() == "tpu"
+        # strategy: compaction pays off once O(N)-per-split masked passes
+        # dominate; small data stays on the simpler masked program
+        strat = _env("LGBM_TPU_STRATEGY", "auto")
+        if strat == "auto":
+            strat = "compact" if dataset.num_data >= 65536 else "masked"
+        self.strategy = strat
+        if self.strategy == "compact":
+            host_codes = (dataset.bundled if dataset.bundled is not None
+                          else dataset.binned)
+            self.codes_row = jnp.asarray(host_codes)      # (N, C)
+        else:
+            self.codes_row = None
+        self._ones_w = None
+        self.last_leaf_id: Optional[jax.Array] = None
+        self._leaf_id_host: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def supports(config: Config, dataset: Dataset) -> bool:
+        """Static capability check; unsupported configs use the host-loop
+        learner (create_tree_learner falls back)."""
+        if any(dataset.bin_mappers[fr].bin_type == BIN_CATEGORICAL
+               for fr in dataset.used_features):
+            return False
+        if config.forcedsplits_filename:
+            return False
+        if config.cegb_tradeoff > 0 and (
+                config.cegb_penalty_split > 0
+                or bool(config.cegb_penalty_feature_coupled)
+                or bool(config.cegb_penalty_feature_lazy)):
+            return False
+        nf = max(1, dataset.num_features)
+        nb = 1 << max(4, (int(dataset.max_num_bins) - 1).bit_length())
+        pool_bytes = config.num_leaves * nf * min(nb, 256) * 3 * 4
+        if pool_bytes > _POOL_BYTE_LIMIT:
+            return False
+        return True
+
+    def _statics(self):
+        cfg = self.config
+        bynode_k = 0
+        if 0.0 < cfg.feature_fraction_bynode < 1.0:
+            bynode_k = max(1, int(self.num_features * cfg.feature_fraction_bynode))
+        return dict(
+            num_leaves=int(cfg.num_leaves), num_bins=self.device_bins,
+            col_bins=self.col_device_bins,
+            max_depth=int(cfg.max_depth), l1=float(cfg.lambda_l1),
+            l2=float(cfg.lambda_l2),
+            max_delta_step=float(cfg.max_delta_step),
+            min_data_in_leaf=int(cfg.min_data_in_leaf),
+            min_sum_hessian=float(cfg.min_sum_hessian_in_leaf),
+            min_gain_to_split=float(cfg.min_gain_to_split),
+            bynode_k=bynode_k, use_pallas=self._use_pallas)
+
+    def _feature_mask(self, rng: np.random.RandomState) -> np.ndarray:
+        frac = self.config.feature_fraction
+        mask = np.ones(self.num_features, dtype=bool)
+        if 0.0 < frac < 1.0:
+            k = max(1, int(self.num_features * frac))
+            chosen = rng.choice(self.num_features, k, replace=False)
+            mask[:] = False
+            mask[chosen] = True
+        return mask
+
+    # ------------------------------------------------------------------
+    def train(self, grad: jax.Array, hess: jax.Array,
+              bag_indices: Optional[np.ndarray] = None,
+              iter_seed: int = 0) -> Tree:
+        cfg = self.config
+        ds = self.dataset
+        n = ds.num_data
+        if bag_indices is None:
+            if self._ones_w is None:
+                self._ones_w = jnp.ones(n, jnp.float32)
+            w = self._ones_w
+        else:
+            wv = np.zeros(n, dtype=np.float32)
+            wv[bag_indices] = 1.0
+            w = jnp.asarray(wv)
+        rng = np.random.RandomState(
+            (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
+        base_mask = jnp.asarray(self._feature_mask(rng)
+                                & np.asarray(self.f_categorical == 0))
+        key = jax.random.PRNGKey(iter_seed)
+
+        if self.strategy == "compact":
+            rec, leaf_id, n_splits, _ = grow_tree_compact(
+                self.codes_row, self.codes_t, grad, hess, w, base_mask,
+                self.f_numbins, self.f_missing, self.f_default,
+                self.f_monotone, self.f_penalty, self.f_col, self.f_base,
+                self.f_elide, self.hist_idx, key, **self._statics())
+        else:
+            rec, leaf_id, n_splits, _ = grow_tree(
+                self.codes_t, grad, hess, w, base_mask,
+                self.f_numbins, self.f_missing, self.f_default,
+                self.f_monotone, self.f_penalty, self.f_col, self.f_base,
+                self.f_elide, self.hist_idx, key, **self._statics())
+
+        self.last_leaf_id = leaf_id
+        self._leaf_id_host = None
+        rec_h, k = jax.device_get((rec, n_splits))
+        k = int(k)
+        if k == 0:
+            log.warning("No further splits with positive gain")
+        tree = Tree(cfg.num_leaves)
+        for i in range(k):
+            inner_f = int(rec_h.feat[i])
+            real_f = ds.inner_to_real(inner_f)
+            mapper = ds.bin_mappers[real_f]
+            thr_bin = int(rec_h.thr[i])
+            tree.split(
+                int(rec_h.leaf[i]), inner_f, real_f, thr_bin,
+                ds.real_threshold(inner_f, thr_bin),
+                float(rec_h.lout[i]), float(rec_h.rout[i]),
+                int(round(float(rec_h.lcnt[i]))),
+                int(round(float(rec_h.rcnt[i]))),
+                float(rec_h.lsh[i]), float(rec_h.rsh[i]),
+                float(rec_h.gain[i]), mapper.missing_type,
+                bool(rec_h.dleft[i]))
+        return tree
+
+    # ------------------------------------------------------------------
+    def leaf_rows(self, leaf: int) -> np.ndarray:
+        """Row indices of a leaf after training (leaf renewal path)."""
+        if self._leaf_id_host is None:
+            self._leaf_id_host = np.asarray(jax.device_get(self.last_leaf_id))
+        return np.nonzero(self._leaf_id_host == leaf)[0]

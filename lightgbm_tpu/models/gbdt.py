@@ -66,6 +66,17 @@ class ScoreUpdater:
             self.f_numbins, tree)
         self.score = self.score.at[class_id].add(vals)
 
+    def add_tree_by_leaf_id(self, tree: Tree, leaf_id, class_id: int) -> None:
+        """Score update from the device learner's row->leaf assignment:
+        a (N,) gather instead of re-walking the tree (the role of the
+        reference's in-bag AddScore(tree_learner) fast path,
+        score_updater.hpp:84)."""
+        leaf_vals = jnp.asarray(
+            np.asarray(tree.leaf_value[:max(tree.num_leaves, 1)],
+                       dtype=np.float32))
+        self.score = self.score.at[class_id].add(
+            jnp.take(leaf_vals, jnp.clip(leaf_id, 0, tree.num_leaves - 1)))
+
     def multiply(self, factor: float, class_id: int) -> None:
         self.score = self.score.at[class_id].multiply(jnp.float32(factor))
 
@@ -109,11 +120,8 @@ class GBDT:
         else:
             self.num_class = max(1, cfg.num_class)
         self.num_tree_per_iteration = self.num_class
-        if cfg.tree_learner == "serial":
-            self.learner = SerialTreeLearner(cfg, train_set)
-        else:
-            from ..parallel.learners import create_tree_learner
-            self.learner = create_tree_learner(cfg, train_set)
+        from ..parallel.learners import create_tree_learner
+        self.learner = create_tree_learner(cfg, train_set)
         self.score_updater = ScoreUpdater(train_set, self.num_class)
         self.num_data = train_set.num_data
         self.train_metrics = create_metrics(cfg.metric, cfg, cfg.objective)
@@ -249,7 +257,11 @@ class GBDT:
         return False
 
     def _update_score(self, tree: Tree, class_id: int) -> None:
-        self.score_updater.add_tree(tree, class_id)
+        leaf_id = getattr(self.learner, "last_leaf_id", None)
+        if leaf_id is not None:
+            self.score_updater.add_tree_by_leaf_id(tree, leaf_id, class_id)
+        else:
+            self.score_updater.add_tree(tree, class_id)
         for vu in self.valid_updaters:
             vu.add_tree(tree, class_id)
 

@@ -1,0 +1,52 @@
+"""Device-side EFB helpers: column-histogram expansion and row routing.
+
+Counterpart of the reference's per-group histogram offsets + FixHistogram
+(reference: src/io/dataset.cpp:820-960 ConstructHistograms works per
+feature-GROUP; FeatureHistogram reads its subfeature's offset slice and
+Dataset::FixHistogram (dataset.h:419) reconstructs the elided default bin
+by subtraction from the leaf totals). Both steps are static gathers /
+elementwise math — ideal XLA work.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def expand_column_hist(col_hist: jax.Array,       # (C, Bc, 3)
+                       totals: jax.Array,         # (3,) leaf sums
+                       hist_idx: jax.Array,       # (F, B) int32 flat index
+                       f_elide: jax.Array,        # (F,) int32 0/1
+                       f_default: jax.Array,      # (F,) int32 default bin
+                       ) -> jax.Array:
+    """Column histograms -> per-feature histograms (F, B, 3).
+
+    hist_idx points into the flattened (C*Bc, 3) array with one trailing
+    zero slot for invalid positions; elided default bins are reconstructed
+    as totals - sum(other bins), the FixHistogram identity.
+    """
+    c, bc, _ = col_hist.shape
+    flat = jnp.concatenate(
+        [col_hist.reshape(c * bc, 3), jnp.zeros((1, 3), col_hist.dtype)])
+    fh = flat[hist_idx]                               # (F, B, 3)
+    rem = totals[None, :] - fh.sum(axis=1)            # (F, 3)
+    b = fh.shape[1]
+    donehot = (jnp.arange(b, dtype=jnp.int32)[None, :]
+               == f_default[:, None]).astype(fh.dtype)       # (F, B)
+    fix = donehot[:, :, None] * rem[:, None, :] * f_elide[:, None, None]
+    return fh + fix
+
+
+def logical_bins_for_feature(col_codes: jax.Array, base, default_bin,
+                             num_bins_f, elide) -> jax.Array:
+    """Map a column's raw codes to one subfeature's logical bins.
+
+    For single-feature columns (elide == 0) codes ARE the bins. For bundle
+    members, codes in [base, base + nbin - 2] unmap to the feature's
+    non-default bins; anything else means 'this feature at its default'.
+    """
+    j = col_codes - base
+    inside = (j >= 0) & (j < num_bins_f - 1)
+    logical = j + (j >= default_bin).astype(col_codes.dtype)
+    bundled = jnp.where(inside, logical, default_bin)
+    return jnp.where(elide > 0, bundled, col_codes)

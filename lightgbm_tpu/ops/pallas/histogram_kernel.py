@@ -2,16 +2,25 @@
 
 TPU-native replacement for the reference's OpenCL histogram kernels
 (reference: src/treelearner/ocl/histogram256.cl — per-workgroup local-memory
-float atomics). TPUs have no scatter-atomics; instead each grid step builds a
-one-hot matrix for a (row-chunk x feature-tile) block in VMEM and contracts it
-with (grad, hess, count) on the MXU, accumulating into the output block that
-stays resident in VMEM across the row-chunk grid axis.
+float atomics). TPUs have no scatter-atomics; instead each grid step builds
+one-hot tiles in VMEM and contracts them with (grad, hess, count) on the MXU,
+accumulating into an output block that stays resident in VMEM across the
+row-chunk grid axis. The one-hot never touches HBM — that is the entire
+point versus the plain-XLA formulation in ops/histogram.py.
 
-Layout notes:
-  * gh comes in transposed (3, P) so the matmul is (3, C) @ (C, Ft*B) —
-    full 128-lane utilization on the output's last axis.
-  * output is (3, F, B); the public wrapper transposes to the framework's
-    (F, B, 3) contract (tiny array, negligible).
+Mosaic tiling rules require the last two dims of every block to be
+(8k, 128k) or span the whole array, so the codes come in TRANSPOSED (F, P)
+layout: the feature axis rides sublanes (tile 8) and the row axis rides
+lanes (tile 128). Layouts:
+
+    codes (F, P) int8  -> block (8, C)
+    gh    (P, 3) f32   -> block (C, 3)      (3 spans the array: allowed)
+    out   (F, B, 3) f32-> block (8, B, 3), index ignores the row-chunk grid
+                          dim, so Pallas keeps it in VMEM and we accumulate.
+
+Per feature in the tile: onehot (B, C) = (codes_row == iota) and a skinny
+MXU matmul (B, C) @ (C, 3). The N=3 axis underuses lanes, but MXU time only
+scales with M and K, so the pass is effectively free at B <= 128.
 """
 from __future__ import annotations
 
@@ -20,60 +29,69 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+FEAT_TILE = 8
 
 
 def _hist_kernel(codes_ref, gh_ref, out_ref, *, num_bins: int):
     p_idx = pl.program_id(1)
-    codes = codes_ref[...].astype(jnp.int32)          # (C, Ft)
-    c, ft = codes.shape
-    iota = jax.lax.broadcasted_iota(jnp.int32, (c, ft, num_bins), 2)
-    onehot = (codes[:, :, None] == iota).astype(jnp.float32)
-    oh2 = onehot.reshape(c, ft * num_bins)
-    gh = gh_ref[...]                                   # (3, C) f32
-    acc = jax.lax.dot_general(
-        gh, oh2, dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )                                                  # (3, Ft*B)
-    acc3 = acc.reshape(3, ft, num_bins)
 
     @pl.when(p_idx == 0)
     def _init():
-        out_ref[...] = acc3
+        out_ref[...] = jnp.zeros_like(out_ref)
 
-    @pl.when(p_idx > 0)
-    def _acc():
-        out_ref[...] += acc3
+    gh = gh_ref[...]                                   # (C, 3) f32
+    codes = codes_ref[...].astype(jnp.int32)           # (Ft, C)
+    ft, c = codes.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, (ft, num_bins, c), 1)
+    onehot = (codes[:, None, :] == iota).astype(jnp.float32)  # (Ft, B, C)
+    part = jax.lax.dot_general(
+        onehot.reshape(ft * num_bins, c), gh,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                  # (Ft*B, 3)
+    out_ref[...] += part.reshape(ft, num_bins, 3)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "chunk_rows", "feat_tile"))
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk_rows"))
 def build_histogram_pallas(binned_rows: jax.Array, gh: jax.Array, num_bins: int,
-                           chunk_rows: int = 512, feat_tile: int = 8) -> jax.Array:
+                           chunk_rows: int = 1024) -> jax.Array:
     """(P, F) codes + (P, 3) gh -> (F, B, 3) f32 histogram."""
-    p, f = binned_rows.shape
-    # pad rows to chunk multiple (pad gh rows are zero so they add nothing)
+    return build_histogram_pallas_t(binned_rows.T, gh, num_bins,
+                                    chunk_rows=chunk_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk_rows"))
+def build_histogram_pallas_t(codes_t: jax.Array, gh: jax.Array, num_bins: int,
+                             chunk_rows: int = 1024) -> jax.Array:
+    """(F, P) transposed codes + (P, 3) gh -> (F, B, 3) f32 histogram.
+
+    The layout the device tree learner stores natively (column-major codes),
+    so no transpose sits on the hot path. Pad rows carry gh == 0 so padding
+    never contributes mass.
+    """
+    f, p = codes_t.shape
     pad_p = (-p) % chunk_rows
-    pad_f = (-f) % feat_tile
+    pad_f = (-f) % FEAT_TILE
     if pad_p or pad_f:
-        binned_rows = jnp.pad(binned_rows, ((0, pad_p), (0, pad_f)))
+        codes_t = jnp.pad(codes_t, ((0, pad_f), (0, pad_p)))
     if pad_p:
         gh = jnp.pad(gh, ((0, pad_p), (0, 0)))
     pp, ff = p + pad_p, f + pad_f
-    gh_t = gh.T                                        # (3, P)
 
-    grid = (ff // feat_tile, pp // chunk_rows)
+    grid = (ff // FEAT_TILE, pp // chunk_rows)
     out = pl.pallas_call(
         functools.partial(_hist_kernel, num_bins=num_bins),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((chunk_rows, feat_tile), lambda fi, pi: (pi, fi)),
-            pl.BlockSpec((3, chunk_rows), lambda fi, pi: (0, pi)),
+            pl.BlockSpec((FEAT_TILE, chunk_rows), lambda fi, pi: (fi, pi)),
+            pl.BlockSpec((chunk_rows, 3), lambda fi, pi: (pi, 0)),
         ],
-        out_specs=pl.BlockSpec((3, feat_tile, num_bins), lambda fi, pi: (0, fi, 0)),
-        out_shape=jax.ShapeDtypeStruct((3, ff, num_bins), jnp.float32),
-    )(binned_rows, gh_t)
-    hist = jnp.transpose(out, (1, 2, 0))               # (F, B, 3)
+        out_specs=pl.BlockSpec((FEAT_TILE, num_bins, 3),
+                               lambda fi, pi: (fi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((ff, num_bins, 3), jnp.float32),
+    )(codes_t, gh)
     if pad_f:
-        hist = hist[:f]
-    return hist
+        out = out[:f]
+    return out

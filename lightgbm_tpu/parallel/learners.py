@@ -458,6 +458,11 @@ def create_tree_learner(config: Config, dataset: Dataset,
     src/treelearner/tree_learner.cpp:13-36 CreateTreeLearner)."""
     name = config.tree_learner
     if name in ("serial",):
+        import os
+        from ..models.device_learner import DeviceTreeLearner
+        if (os.environ.get("LGBM_TPU_HOST_LEARNER", "0") != "1"
+                and DeviceTreeLearner.supports(config, dataset)):
+            return DeviceTreeLearner(config, dataset)
         return SerialTreeLearner(config, dataset)
     if name in ("feature", "feature_parallel"):
         return FeatureParallelTreeLearner(config, dataset, mesh)

@@ -1,0 +1,132 @@
+/* SWIG interface for the lightgbm_tpu C ABI (role of the reference's
+ * swig/lightgbmlib.i — a generated Java/JNI wrapper over the stable C API
+ * used by mmlspark). Targets the same LGBM_* surface exported by
+ * capi/lib_lightgbm_tpu.so.
+ *
+ * Generate + build (swig and a JDK are NOT in the CI image; run where
+ * available):
+ *   swig -java -package com.lightgbm.tpu -outdir java/com/lightgbm/tpu \
+ *        lightgbm_tpu.i
+ *   g++ -shared -fPIC lightgbm_tpu_wrap.cxx -I$JAVA_HOME/include \
+ *        -I$JAVA_HOME/include/linux -L../capi -llightgbm_tpu \
+ *        -o lib_lightgbm_tpu_swig.so
+ */
+%module lightgbmlibtpu
+
+%{
+#include <cstdint>
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+extern "C" {
+const char* LGBM_GetLastError();
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               DatasetHandle reference, DatasetHandle* out);
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters, DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type);
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetFree(DatasetHandle handle);
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out);
+int LGBM_BoosterCreateFromModelfile(const char* filename, int* out_num_iters,
+                                    BoosterHandle* out);
+int LGBM_BoosterLoadModelFromString(const char* model_str, int* out_num_iters,
+                                    BoosterHandle* out);
+int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, const char* filename);
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results);
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines);
+int LGBM_NetworkFree();
+}
+%}
+
+%include "stdint.i"
+%include "typemaps.i"
+%include "arrays_java.i"
+%include "carrays.i"
+
+/* handle types surface as opaque longs on the Java side, matching the
+ * reference wrapper's voidpp/handle pattern */
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+%apply int* OUTPUT { int* is_finished, int* out_iteration, int* out_len,
+                     int* out_num_iters };
+%apply int32_t* OUTPUT { int32_t* out };
+
+%array_functions(double, doubleArray)
+%array_functions(float, floatArray)
+%array_functions(int, intArray)
+
+/* pointer-to-handle helpers (the reference exposes voidpp_handle etc.) */
+%inline %{
+DatasetHandle* new_DatasetHandlep() { return new DatasetHandle(0); }
+DatasetHandle DatasetHandlep_value(DatasetHandle* p) { return *p; }
+void delete_DatasetHandlep(DatasetHandle* p) { delete p; }
+BoosterHandle* new_BoosterHandlep() { return new BoosterHandle(0); }
+BoosterHandle BoosterHandlep_value(BoosterHandle* p) { return *p; }
+void delete_BoosterHandlep(BoosterHandle* p) { delete p; }
+int64_t* new_int64p() { return new int64_t(0); }
+int64_t int64p_value(int64_t* p) { return *p; }
+void delete_int64p(int64_t* p) { delete p; }
+%}
+
+const char* LGBM_GetLastError();
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               DatasetHandle reference, DatasetHandle* out);
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters, DatasetHandle reference,
+                              DatasetHandle* out);
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type);
+int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
+int LGBM_DatasetFree(DatasetHandle handle);
+int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out);
+int LGBM_BoosterCreateFromModelfile(const char* filename, int* out_num_iters,
+                                    BoosterHandle* out);
+int LGBM_BoosterLoadModelFromString(const char* model_str, int* out_num_iters,
+                                    BoosterHandle* out);
+int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, const char* filename);
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results);
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines);
+int LGBM_NetworkFree();

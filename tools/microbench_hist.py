@@ -1,0 +1,49 @@
+"""Micro-benchmark: histogram build paths on the current backend.
+
+Usage: python tools/microbench_hist.py [rows] [features] [bins]
+Compares the XLA one-hot path vs the Pallas kernel for correctness and
+throughput, which decides the serial learner's default.
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from lightgbm_tpu.ops.histogram import build_histogram  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+F = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+B = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+
+r = np.random.RandomState(0)
+codes = jnp.asarray(r.randint(0, B, size=(N, F), dtype=np.uint8))
+gh = jnp.asarray(np.concatenate(
+    [r.randn(N, 2).astype(np.float32), np.ones((N, 1), np.float32)], axis=1))
+
+print(f"backend={jax.default_backend()} N={N} F={F} B={B}")
+
+
+def run(use_pallas, iters=10):
+    h = build_histogram(codes, gh, B, use_pallas=use_pallas)
+    h.block_until_ready()
+    t0 = time.time()
+    for _ in range(iters):
+        h = build_histogram(codes, gh, B, use_pallas=use_pallas)
+    h.block_until_ready()
+    dt = (time.time() - t0) / iters
+    gbps = N * F / dt / 1e9
+    print(f"use_pallas={use_pallas}: {dt*1e3:.2f} ms  "
+          f"({gbps:.1f} Gcode/s)")
+    return h
+
+
+h_xla = run(False)
+h_pl = run(True)
+err = float(jnp.max(jnp.abs(h_xla - h_pl)))
+rel = err / max(1.0, float(jnp.max(jnp.abs(h_xla))))
+print(f"max abs diff {err:.3e} (rel {rel:.2e})")
+assert rel < 1e-5, "pallas/xla histogram mismatch"
+print("OK")

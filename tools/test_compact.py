@@ -1,0 +1,29 @@
+"""Dev check: compact strategy vs masked strategy must agree."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 4000
+r = np.random.RandomState(7)
+x = r.randn(n, 10)
+y = (x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+     + r.randn(n) * 0.5 > 0).astype(np.float64)
+params = dict(objective="binary", num_leaves=31, learning_rate=0.1,
+              verbose=-1)
+
+preds = {}
+for strat in ("masked", "compact"):
+    os.environ["LGBM_TPU_STRATEGY"] = strat
+    ds = lgb.Dataset(x, label=y)
+    bst = lgb.train(params, ds, num_boost_round=8)
+    preds[strat] = bst.predict(x)
+    print(strat, "done", flush=True)
+
+d = np.max(np.abs(preds["masked"] - preds["compact"]))
+print("maxdiff", d)
+assert d < 1e-5, "strategies disagree"
+print("OK")
