@@ -42,6 +42,14 @@ Booster <- R6::R6Class(
       .Call(LGBMTPU_BoosterGetEval_R, self$handle, as.integer(data_idx))
     },
 
+    eval_names = function() {
+      .Call(LGBMTPU_BoosterGetEvalNames_R, self$handle)
+    },
+
+    eval_higher_better = function() {
+      .Call(LGBMTPU_BoosterGetEvalHigherBetter_R, self$handle)
+    },
+
     save_model = function(filename, num_iteration = -1L) {
       .Call(LGBMTPU_BoosterSaveModel_R, self$handle,
             as.integer(num_iteration), filename)

@@ -15,18 +15,26 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   }
   best_score <- Inf
   best_iter <- -1L
+  # direction of the first metric (auc/ndcg/map maximize); queried from the
+  # C ABI so it tracks whatever metric the params resolved to
+  eval_sign <- 1
   for (i in seq_len(nrounds)) {
     finished <- booster$update()
     if (length(valids) > 0) {
       ev <- booster$eval(1L)
       if (length(ev) > 0) {
+        if (i == 1L) {
+          hb <- tryCatch(booster$eval_higher_better(),
+                         error = function(e) logical(0))
+          if (length(hb) > 0 && isTRUE(hb[[1]])) eval_sign <- -1
+        }
         if (verbose > 0) {
           message(sprintf("[%d] valid: %s", i,
                           paste(signif(ev, 6), collapse = ", ")))
         }
         if (!is.null(early_stopping_rounds)) {
-          if (ev[[1]] < best_score) {
-            best_score <- ev[[1]]
+          if (eval_sign * ev[[1]] < best_score) {
+            best_score <- eval_sign * ev[[1]]
             best_iter <- i
           } else if (i - best_iter >= early_stopping_rounds) {
             if (verbose > 0) {

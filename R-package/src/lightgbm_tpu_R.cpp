@@ -45,6 +45,10 @@ int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
 int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
 int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
                         double* out_results);
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs);
+int LGBM_BoosterGetEvalHigherBetter(BoosterHandle handle, int* out_len,
+                                    int* out_flags);
 int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
                           int num_iteration, const char* filename);
 int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
@@ -220,6 +224,40 @@ SEXP LGBMTPU_BoosterGetEval_R(SEXP handle, SEXP data_idx) {
   return out;
 }
 
+SEXP LGBMTPU_BoosterGetEvalNames_R(SEXP handle) {
+  int count = 0;
+  CheckCall(LGBM_BoosterGetEvalCounts(R_ExternalPtrAddr(handle), &count),
+            "BoosterGetEvalCounts");
+  if (count < 1) count = 1;
+  std::vector<std::vector<char>> bufs(count, std::vector<char>(128, 0));
+  std::vector<char*> ptrs(count);
+  for (int i = 0; i < count; ++i) ptrs[i] = bufs[i].data();
+  int out_len = 0;
+  CheckCall(LGBM_BoosterGetEvalNames(R_ExternalPtrAddr(handle), &out_len,
+                                     ptrs.data()),
+            "BoosterGetEvalNames");
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, out_len));
+  for (int i = 0; i < out_len; ++i)
+    SET_STRING_ELT(out, i, Rf_mkChar(ptrs[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMTPU_BoosterGetEvalHigherBetter_R(SEXP handle) {
+  int count = 0;
+  CheckCall(LGBM_BoosterGetEvalCounts(R_ExternalPtrAddr(handle), &count),
+            "BoosterGetEvalCounts");
+  std::vector<int> flags(count > 0 ? count : 1, 0);
+  int out_len = 0;
+  CheckCall(LGBM_BoosterGetEvalHigherBetter(R_ExternalPtrAddr(handle),
+                                            &out_len, flags.data()),
+            "BoosterGetEvalHigherBetter");
+  SEXP out = PROTECT(Rf_allocVector(LGLSXP, out_len));
+  for (int i = 0; i < out_len; ++i) LOGICAL(out)[i] = flags[i] != 0;
+  UNPROTECT(1);
+  return out;
+}
+
 SEXP LGBMTPU_BoosterSaveModel_R(SEXP handle, SEXP num_iteration,
                                 SEXP filename) {
   CheckCall(LGBM_BoosterSaveModel(R_ExternalPtrAddr(handle), 0,
@@ -251,7 +289,16 @@ SEXP LGBMTPU_BoosterPredictForMat_R(SEXP handle, SEXP mat, SEXP nrow,
   int num_class = 1;
   LGBM_BoosterGetNumClasses(R_ExternalPtrAddr(handle), &num_class);
   int64_t cap = (int64_t)nr * num_class;
-  if (Rf_asInteger(predict_type) == 2) cap = (int64_t)nr * 4096;  // leaves
+  if (Rf_asInteger(predict_type) == 2) {
+    // leaf-index prediction emits one value per (row, class, iteration);
+    // size from the booster's real iteration count, never a fixed cap
+    int cur_iter = 0;
+    LGBM_BoosterGetCurrentIteration(R_ExternalPtrAddr(handle), &cur_iter);
+    int want = Rf_asInteger(num_iteration);
+    int iters = (want > 0 && want < cur_iter) ? want : cur_iter;
+    if (iters < 1) iters = 1;
+    cap = (int64_t)nr * num_class * iters;
+  }
   if (Rf_asInteger(predict_type) == 3) cap = (int64_t)nr * (nc + 1) * num_class;
   std::vector<double> out(cap);
   int64_t out_len = 0;
@@ -296,6 +343,8 @@ static const R_CallMethodDef CallEntries[] = {
     {"LGBMTPU_BoosterRollbackOneIter_R", (DL_FUNC)&LGBMTPU_BoosterRollbackOneIter_R, 1},
     {"LGBMTPU_BoosterGetCurrentIteration_R", (DL_FUNC)&LGBMTPU_BoosterGetCurrentIteration_R, 1},
     {"LGBMTPU_BoosterGetEval_R", (DL_FUNC)&LGBMTPU_BoosterGetEval_R, 2},
+    {"LGBMTPU_BoosterGetEvalNames_R", (DL_FUNC)&LGBMTPU_BoosterGetEvalNames_R, 1},
+    {"LGBMTPU_BoosterGetEvalHigherBetter_R", (DL_FUNC)&LGBMTPU_BoosterGetEvalHigherBetter_R, 1},
     {"LGBMTPU_BoosterSaveModel_R", (DL_FUNC)&LGBMTPU_BoosterSaveModel_R, 3},
     {"LGBMTPU_BoosterSaveModelToString_R", (DL_FUNC)&LGBMTPU_BoosterSaveModelToString_R, 2},
     {"LGBMTPU_BoosterPredictForMat_R", (DL_FUNC)&LGBMTPU_BoosterPredictForMat_R, 6},

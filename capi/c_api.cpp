@@ -15,6 +15,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <cstdarg>
 #include <mutex>
@@ -368,6 +369,41 @@ LGBM_API int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
   Py_ssize_t n = PyList_Size(r);
   for (Py_ssize_t i = 0; i < n; ++i) {
     out_results[i] = PyFloat_AsDouble(PyList_GetItem(r, i));
+  }
+  *out_len = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+// out_strs: caller-allocated array of char buffers (reference sizes them at
+// 128 bytes each, c_api.cpp GetEvalNames)
+LGBM_API int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                                      char** out_strs) {
+  Gil gil;
+  PyObject* r = Call("booster_eval_names",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    std::snprintf(out_strs[i], 128, "%s", s ? s : "");
+  }
+  *out_len = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Extension beyond the reference surface: per-eval-slot direction flags so
+// thin bindings (R) can early-stop correctly on auc/ndcg/map.
+LGBM_API int LGBM_BoosterGetEvalHigherBetter(BoosterHandle handle,
+                                             int* out_len, int* out_flags) {
+  Gil gil;
+  PyObject* r = Call("booster_eval_higher_better",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    out_flags[i] = (int)PyLong_AsLong(PyList_GetItem(r, i));
   }
   *out_len = (int)n;
   Py_DECREF(r);

@@ -182,6 +182,25 @@ def booster_eval_counts(h):
     return sum(len(m.names) for m in bst._gbdt.train_metrics)
 
 
+def booster_eval_names(h):
+    """Metric display names, order-aligned with booster_get_eval results
+    (reference: LGBM_BoosterGetEvalNames, c_api.cpp)."""
+    bst = _get(h)
+    names = []
+    for m in bst._gbdt.train_metrics:
+        names.extend(m.names)
+    return [str(n) for n in names]
+
+
+def booster_eval_higher_better(h):
+    """1/0 per eval slot: whether larger metric values are better."""
+    bst = _get(h)
+    out = []
+    for m in bst._gbdt.train_metrics:
+        out.extend([1 if m.higher_better else 0] * len(m.names))
+    return out
+
+
 def booster_get_eval(h, data_idx):
     """data_idx 0 = train, i>0 = valid i-1 (reference c_api semantics)."""
     bst = _get(h)

@@ -46,6 +46,7 @@ from ..ops import split as split_ops
 from ..ops.partition import decide_left
 from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
 from ..utils import log
+from ..utils.envs import use_pallas_env
 from .tree import Tree
 
 NEG_INF = split_ops.NEG_INF
@@ -545,7 +546,11 @@ class DeviceTreeLearner:
         pen = np.array([contri[fr] if fr < len(contri) else 1.0
                         for fr in dataset.used_features], dtype=np.float32)
         self.f_penalty = jnp.asarray(pen)
-        self._use_pallas = jax.default_backend() == "tpu"
+        # Measured on v5e (tools/microbench_injit.py): the XLA one-hot
+        # contraction beats the Pallas kernel ~2.4x (XLA fuses the one-hot
+        # build into the matmul pipeline better than Mosaic schedules it),
+        # so the fused XLA path is the default even on TPU.
+        self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
         # strategy: compaction pays off once O(N)-per-split masked passes
         # dominate; small data stays on the simpler masked program
         strat = _env("LGBM_TPU_STRATEGY", "auto")
@@ -561,6 +566,7 @@ class DeviceTreeLearner:
         self._ones_w = None
         self.last_leaf_id: Optional[jax.Array] = None
         self._leaf_id_host: Optional[np.ndarray] = None
+        self._bag_mask_host: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -577,9 +583,18 @@ class DeviceTreeLearner:
                 or bool(config.cegb_penalty_feature_coupled)
                 or bool(config.cegb_penalty_feature_lazy)):
             return False
-        nf = max(1, dataset.num_features)
-        nb = 1 << max(4, (int(dataset.max_num_bins) - 1).bit_length())
-        pool_bytes = config.num_leaves * nf * min(nb, 256) * 3 * 4
+        # mirror __init__'s pool sizing exactly: bundled column count when
+        # EFB is active, and the same pow2 bin padding (only clamped to 256
+        # when the logical bin count itself is <= 256)
+        if dataset.columns:
+            ncols = max(1, len(dataset.columns))
+            raw_bins = max(c.num_bins for c in dataset.columns)
+        else:
+            ncols = max(1, dataset.num_features)
+            raw_bins = int(dataset.max_num_bins)
+        nb = 1 << max(4, (raw_bins - 1).bit_length())
+        device_bins = min(nb, 256) if raw_bins <= 256 else nb
+        pool_bytes = config.num_leaves * ncols * device_bins * 3 * 4
         if pool_bytes > _POOL_BYTE_LIMIT:
             return False
         return True
@@ -621,10 +636,12 @@ class DeviceTreeLearner:
             if self._ones_w is None:
                 self._ones_w = jnp.ones(n, jnp.float32)
             w = self._ones_w
+            self._bag_mask_host = None
         else:
             wv = np.zeros(n, dtype=np.float32)
             wv[bag_indices] = 1.0
             w = jnp.asarray(wv)
+            self._bag_mask_host = wv > 0
         rng = np.random.RandomState(
             (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
         base_mask = jnp.asarray(self._feature_mask(rng)
@@ -669,7 +686,15 @@ class DeviceTreeLearner:
 
     # ------------------------------------------------------------------
     def leaf_rows(self, leaf: int) -> np.ndarray:
-        """Row indices of a leaf after training (leaf renewal path)."""
+        """IN-BAG row indices of a leaf after training (leaf renewal path).
+
+        last_leaf_id routes every row (out-of-bag included), but leaf
+        renewal must use in-bag rows only, matching the reference's
+        RenewTreeOutput over the data partition (serial_tree_learner.cpp:
+        855-893) and SerialTreeLearner.leaf_rows."""
         if self._leaf_id_host is None:
             self._leaf_id_host = np.asarray(jax.device_get(self.last_leaf_id))
-        return np.nonzero(self._leaf_id_host == leaf)[0]
+        in_leaf = self._leaf_id_host == leaf
+        if self._bag_mask_host is not None:
+            in_leaf = in_leaf & self._bag_mask_host
+        return np.nonzero(in_leaf)[0]

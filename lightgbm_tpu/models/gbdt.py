@@ -14,7 +14,7 @@ ride on host.
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -129,6 +129,8 @@ class GBDT:
             m.init(train_set.metadata, train_set.num_data)
         self._bag_rng = np.random.RandomState(cfg.bagging_seed % (2**31 - 1))
         self._bag_indices: Optional[np.ndarray] = None
+        self._last_leaf_ids: Dict[int, Any] = {}
+        self._last_leaf_ids_iter = -1
         self._class_need_train = [
             self.objective.class_need_train(k) if self.objective else True
             for k in range(self.num_class)]
@@ -260,8 +262,14 @@ class GBDT:
         leaf_id = getattr(self.learner, "last_leaf_id", None)
         if leaf_id is not None:
             self.score_updater.add_tree_by_leaf_id(tree, leaf_id, class_id)
+            # remember the routing so rollback_one_iter subtracts along the
+            # exact same path (EFB bundle-conflict rows can route
+            # differently under tree traversal than under the partition)
+            self._last_leaf_ids[class_id] = leaf_id
+            self._last_leaf_ids_iter = self.iter
         else:
             self.score_updater.add_tree(tree, class_id)
+            self._last_leaf_ids.pop(class_id, None)
         for vu in self.valid_updaters:
             vu.add_tree(tree, class_id)
 
@@ -290,9 +298,15 @@ class GBDT:
         for k in range(self.num_tree_per_iteration):
             tree = self.models[len(self.models) - self.num_tree_per_iteration + k]
             tree.apply_shrinkage(-1.0)
-            self.score_updater.add_tree(tree, k)
+            leaf_id = (self._last_leaf_ids.get(k)
+                       if self._last_leaf_ids_iter == self.iter - 1 else None)
+            if leaf_id is not None and tree.num_leaves > 1:
+                self.score_updater.add_tree_by_leaf_id(tree, leaf_id, k)
+            else:
+                self.score_updater.add_tree(tree, k)
             for vu in self.valid_updaters:
                 vu.add_tree(tree, k)
+        self._last_leaf_ids.clear()
         del self.models[-self.num_tree_per_iteration:]
         self.iter -= 1
 

@@ -30,6 +30,7 @@ from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
 from ..ops import split as split_ops
 from ..utils import log
+from ..utils.envs import use_pallas_env
 from .tree import Tree
 
 _MIN_BUCKET = 256
@@ -76,8 +77,9 @@ class SerialTreeLearner:
         self._has_categorical = any(
             dataset.bin_mappers[f].bin_type == BIN_CATEGORICAL
             for f in dataset.used_features)
-        default_pallas = "1" if jax.default_backend() == "tpu" else "0"
-        self._use_pallas = bool(int(_env("LGBM_TPU_PALLAS_HIST", default_pallas)))
+        # XLA's fused one-hot contraction measured faster than the Pallas
+        # kernel on v5e (tools/microbench_injit.py); opt-in only.
+        self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
         self._mono_enabled = bool(np.any(np.asarray(self.f_monotone) != 0))
         # feature_contri gain multipliers (reference FeatureMetainfo penalty)
         contri = config.feature_contri or []

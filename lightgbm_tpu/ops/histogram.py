@@ -35,16 +35,21 @@ def _hist_chunk(binned_chunk: jax.Array, gh_chunk: jax.Array, num_bins: int) -> 
     c, f = binned_chunk.shape
     iota = jnp.arange(num_bins, dtype=jnp.int32)
     onehot = (binned_chunk.astype(jnp.int32)[:, :, None] == iota[None, None, :])
-    onehot2d = onehot.reshape(c, f * num_bins).astype(jnp.float32)
-    # (FB, C) @ (C, 3) on the MXU. HIGHEST keeps true-f32 products — the
-    # TPU default would round gh to bf16 (one-hot is bf16-exact, gradients
-    # are not); the reference's GPU path is full fp32 too.
-    hist = jax.lax.dot_general(
-        onehot2d, gh_chunk,
-        dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )
+    # (FB, C) @ (C, 3) on the MXU. The one-hot is bf16-exact; gh is split
+    # into bf16 hi + lo parts so each product is a fast single-pass bf16
+    # matmul while the sum keeps ~f32 fidelity (rel err ~8e-7 vs HIGHEST,
+    # tools/microbench_hist2.py). Plain DEFAULT would round gradients to
+    # bf16, whose absolute error survives sibling subtraction
+    # (subtract_histogram) disproportionately for small leaves; HIGHEST
+    # costs ~40% more MXU time.
+    onehot2d = onehot.reshape(c, f * num_bins).astype(jnp.bfloat16)
+    gh_hi = gh_chunk.astype(jnp.bfloat16)
+    gh_lo = (gh_chunk - gh_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    dn = (((0,), (0,)), ((), ()))
+    hist = (jax.lax.dot_general(onehot2d, gh_hi, dimension_numbers=dn,
+                                preferred_element_type=jnp.float32)
+            + jax.lax.dot_general(onehot2d, gh_lo, dimension_numbers=dn,
+                                  preferred_element_type=jnp.float32))
     return hist.reshape(f, num_bins, 3)
 
 

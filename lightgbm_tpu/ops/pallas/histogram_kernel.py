@@ -54,17 +54,21 @@ def _hist_kernel(codes_ref, gh_ref, out_ref, *, num_bins: int):
     out_ref[...] += part.reshape(ft, num_bins, 3)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "chunk_rows"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "chunk_rows", "interpret"))
 def build_histogram_pallas(binned_rows: jax.Array, gh: jax.Array, num_bins: int,
-                           chunk_rows: int = 1024) -> jax.Array:
+                           chunk_rows: int = 1024,
+                           interpret: bool = False) -> jax.Array:
     """(P, F) codes + (P, 3) gh -> (F, B, 3) f32 histogram."""
     return build_histogram_pallas_t(binned_rows.T, gh, num_bins,
-                                    chunk_rows=chunk_rows)
+                                    chunk_rows=chunk_rows, interpret=interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "chunk_rows"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "chunk_rows", "interpret"))
 def build_histogram_pallas_t(codes_t: jax.Array, gh: jax.Array, num_bins: int,
-                             chunk_rows: int = 1024) -> jax.Array:
+                             chunk_rows: int = 1024,
+                             interpret: bool = False) -> jax.Array:
     """(F, P) transposed codes + (P, 3) gh -> (F, B, 3) f32 histogram.
 
     The layout the device tree learner stores natively (column-major codes),
@@ -91,6 +95,7 @@ def build_histogram_pallas_t(codes_t: jax.Array, gh: jax.Array, num_bins: int,
         out_specs=pl.BlockSpec((FEAT_TILE, num_bins, 3),
                                lambda fi, pi: (fi, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((ff, num_bins, 3), jnp.float32),
+        interpret=interpret,
     )(codes_t, gh)
     if pad_f:
         out = out[:f]

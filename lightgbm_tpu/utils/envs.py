@@ -1,0 +1,16 @@
+"""Environment knobs shared across modules (single parse, single name)."""
+from __future__ import annotations
+
+import os
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+def flag(name: str, default: str = "0") -> bool:
+    return os.environ.get(name, default).strip().lower() in _TRUE
+
+
+def use_pallas_env() -> bool:
+    """Opt-in to the Pallas histogram kernel (both learners honor both
+    spellings; the XLA one-hot path measured faster on v5e so default off)."""
+    return flag("LGBM_TPU_PALLAS") or flag("LGBM_TPU_PALLAS_HIST")

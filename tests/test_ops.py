@@ -187,3 +187,46 @@ def test_partition_preserves_overrun_region():
     # rows 50..59 have bin 0 -> left; rows 0..49 bin 1 -> right
     assert int(left_cnt) == 10
     np.testing.assert_array_equal(new_buf[:10], np.arange(50, 60))
+
+
+@pytest.mark.parametrize("num_bins", [16, 64, 128])
+def test_pallas_histogram_interpret_parity(num_bins):
+    """Execute the Pallas kernel (interpret mode on CPU, compiled on TPU)
+    and compare against the XLA one-hot path — the GPU_DEBUG_COMPARE
+    host-oracle pattern (reference: gpu_tree_learner.cpp:996-1019)."""
+    import jax
+    from lightgbm_tpu.ops.pallas import histogram_kernel as pk
+    r = np.random.RandomState(7)
+    n, f = 3000, 11          # non-multiples of chunk_rows / FEAT_TILE
+    binned = r.randint(0, num_bins, size=(n, f)).astype(np.uint8)
+    g = r.randn(n).astype(np.float32)
+    h = r.rand(n).astype(np.float32)
+    valid = np.ones(n, dtype=bool)
+    valid[2700:] = False
+    gh = np.stack([g * valid, h * valid, valid.astype(np.float32)], axis=1)
+    interpret = jax.default_backend() != "tpu"
+    got = np.asarray(pk.build_histogram_pallas(
+        jnp.asarray(binned), jnp.asarray(gh), num_bins, interpret=interpret))
+    want = np.asarray(hist_ops.build_histogram(
+        jnp.asarray(binned), jnp.asarray(gh), num_bins=num_bins,
+        use_pallas=False))
+    # XLA path sums via split-bf16 passes, the kernel in f32 — allow the
+    # ~1e-5 relative drift between the two float paths
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-3)
+    # and against the scalar oracle for absolute ground truth
+    ref = _ref_histogram(binned, g, h, valid, num_bins)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_histogram_transposed_layout_interpret():
+    import jax
+    from lightgbm_tpu.ops.pallas import histogram_kernel as pk
+    r = np.random.RandomState(8)
+    n, f, b = 2048, 8, 32
+    binned = r.randint(0, b, size=(n, f)).astype(np.uint8)
+    gh = np.stack([r.randn(n), r.rand(n), np.ones(n)], axis=1).astype(np.float32)
+    interpret = jax.default_backend() != "tpu"
+    got = np.asarray(pk.build_histogram_pallas_t(
+        jnp.asarray(binned.T.copy()), jnp.asarray(gh), b, interpret=interpret))
+    want = _ref_histogram(binned, gh[:, 0], gh[:, 1], np.ones(n, bool), b)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

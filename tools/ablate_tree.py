@@ -1,0 +1,57 @@
+"""Ablation: time grow_tree_compact / grow_tree under config variations on
+the live backend. Decides the production defaults (pallas on/off, precision,
+strategy crossover, leaf count scaling).
+
+Usage: python tools/ablate_tree.py [rows] [trees]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+T = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import Dataset  # noqa: E402
+from lightgbm_tpu.models.device_learner import DeviceTreeLearner  # noqa: E402
+
+r = np.random.RandomState(17)
+F = 28
+x = r.randn(N, F).astype(np.float32)
+w = r.randn(F) * (r.rand(F) > 0.4)
+y = ((x @ w * 0.3 + r.randn(N)) > 0).astype(np.float64)
+
+grad = jnp.asarray((r.rand(N) - 0.5).astype(np.float32))
+hess = jnp.asarray((0.1 + r.rand(N) * 0.2).astype(np.float32))
+
+
+def run(name, leaves, strategy, pallas_env):
+    os.environ["LGBM_TPU_STRATEGY"] = strategy
+    os.environ["LGBM_TPU_PALLAS"] = pallas_env
+    cfg = Config({"objective": "binary", "num_leaves": leaves,
+                  "max_bin": 63, "min_data_in_leaf": 20, "verbosity": -1})
+    ds = Dataset(x, config=cfg, label=y)
+    lrn = DeviceTreeLearner(cfg, ds)
+    t = lrn.train(grad, hess, iter_seed=0)   # compile + warm
+    t0 = time.time()
+    for i in range(T):
+        t = lrn.train(grad, hess, iter_seed=i + 1)
+    dt = (time.time() - t0) / T
+    print(f"{name:44s} {dt*1e3:9.1f} ms/tree  ({t.num_leaves} leaves)")
+    return dt
+
+
+print(f"backend={jax.default_backend()} N={N} F={F} trees={T}")
+run("compact pallas L=255", 255, "compact", "1")
+run("compact xla    L=255", 255, "compact", "0")
+run("compact xla    L=63", 63, "compact", "0")
+run("compact xla    L=15", 15, "compact", "0")
+run("masked  xla    L=255", 255, "masked", "0")
+run("masked  xla    L=63", 63, "masked", "0")

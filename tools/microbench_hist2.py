@@ -1,0 +1,129 @@
+"""Histogram formulation shootout (in-jit timing): f32-HIGHEST one-hot vs
+bf16 one-hot with split-gh 2-pass, vs single bf16 pass; plus gather layout
+experiments. Decides the production histogram path constants.
+
+Usage: python tools/microbench_hist2.py [rows] [reps]
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+N = (N // 2048) * 2048
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+F = 28
+B = 64
+CH = 2048
+
+r = np.random.RandomState(0)
+codes = jnp.asarray(r.randint(0, B, (N, F), dtype=np.uint8))
+gh = jnp.asarray(np.stack(
+    [r.randn(N), r.rand(N), np.ones(N)], 1).astype(np.float32))
+idx = jnp.asarray(r.permutation(N).astype(np.int32))
+codes_pack = jnp.asarray(
+    np.ascontiguousarray(np.asarray(codes).reshape(N, F // 4, 4)
+                         .astype(np.uint32))
+    .dot(np.array([1, 256, 65536, 16777216], dtype=np.uint32))
+    .astype(np.uint32))
+
+
+def timed(name, make_body, *args, reps=REPS):
+    @jax.jit
+    def run(*a):
+        def body(i, acc):
+            out = make_body(i, a)
+            return acc + out.ravel()[0].astype(jnp.float32)
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
+    out = run(*args)
+    np.asarray(jax.device_get(out))
+    t0 = time.time()
+    out = run(*args)
+    np.asarray(jax.device_get(out))
+    dt = (time.time() - t0) / reps * 1e3
+    print(f"{name:52s} {dt:8.3f} ms")
+    return dt
+
+
+def onehot_chunks(c, gh_, prec, oh_dtype, gh_dtype):
+    """chunked one-hot contraction, parameterized precisions."""
+    n_chunks = N // CH
+    cc = c.reshape(n_chunks, CH, F)
+    gg = gh_.reshape(n_chunks, CH, 3)
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    def body(acc, chunk):
+        cb, gb = chunk
+        onehot = (cb.astype(jnp.int32)[:, :, None] == iota).reshape(
+            CH, F * B).astype(oh_dtype)
+        h = jax.lax.dot_general(
+            onehot.T, gb.astype(gh_dtype),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec)
+        return acc + h, None
+
+    init = jnp.zeros((F * B, 3), jnp.float32)
+    out, _ = jax.lax.scan(body, init, (cc, gg))
+    return out
+
+
+def onehot_2pass(c, gh_):
+    """bf16 one-hot; gh split hi/lo bf16 for ~f32 accuracy at bf16 speed."""
+    n_chunks = N // CH
+    cc = c.reshape(n_chunks, CH, F)
+    hi = gh_.astype(jnp.bfloat16)
+    lo = (gh_ - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    hh = hi.reshape(n_chunks, CH, 3)
+    ll = lo.reshape(n_chunks, CH, 3)
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    def body(acc, chunk):
+        cb, hb, lb = chunk
+        onehot = (cb.astype(jnp.int32)[:, :, None] == iota).reshape(
+            CH, F * B).astype(jnp.bfloat16)
+        h1 = jax.lax.dot_general(
+            onehot.T, hb, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        h2 = jax.lax.dot_general(
+            onehot.T, lb, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc + h1 + h2, None
+
+    init = jnp.zeros((F * B, 3), jnp.float32)
+    out, _ = jax.lax.scan(body, init, (cc, hh, ll))
+    return out
+
+
+print(f"backend={jax.default_backend()} N={N} F={F} B={B} chunk={CH}")
+P = jax.lax.Precision
+timed("one-hot f32 HIGHEST (current)", lambda i, a: onehot_chunks(
+    a[0], jnp.roll(a[1], i, axis=0), P.HIGHEST, jnp.float32, jnp.float32),
+    codes, gh)
+timed("one-hot f32 DEFAULT", lambda i, a: onehot_chunks(
+    a[0], jnp.roll(a[1], i, axis=0), P.DEFAULT, jnp.float32, jnp.float32),
+    codes, gh)
+timed("one-hot bf16xbf16 single pass", lambda i, a: onehot_chunks(
+    a[0], jnp.roll(a[1], i, axis=0), P.DEFAULT, jnp.bfloat16, jnp.bfloat16),
+    codes, gh)
+timed("one-hot bf16 2-pass (hi+lo)", lambda i, a: onehot_2pass(
+    a[0], jnp.roll(a[1], i, axis=0)), codes, gh)
+
+# accuracy check of 2-pass vs HIGHEST
+h_ref = onehot_chunks(codes, gh, P.HIGHEST, jnp.float32, jnp.float32)
+h_2p = onehot_2pass(codes, gh)
+h_1p = onehot_chunks(codes, gh, P.DEFAULT, jnp.bfloat16, jnp.bfloat16)
+den = float(jnp.max(jnp.abs(h_ref)))
+print(f"2-pass rel err {float(jnp.max(jnp.abs(h_2p-h_ref)))/den:.2e}   "
+      f"1-pass rel err {float(jnp.max(jnp.abs(h_1p-h_ref)))/den:.2e}")
+
+# gather layouts
+timed("gather rows uint8 (N,28)", lambda i, a: jnp.take(
+    a[0], jnp.roll(a[1], i), axis=0).astype(jnp.float32), codes, idx)
+timed("gather rows packed uint32 (N,7)", lambda i, a: jnp.take(
+    a[0], jnp.roll(a[1], i), axis=0).astype(jnp.float32), codes_pack, idx)
+timed("gather gh f32 (N,3)", lambda i, a: jnp.take(
+    a[0], jnp.roll(a[1], i), axis=0), gh, idx)

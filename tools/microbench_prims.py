@@ -7,6 +7,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
 F = 28
 r = np.random.RandomState(0)

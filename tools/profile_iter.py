@@ -1,0 +1,69 @@
+"""Decompose one GBDT boosting iteration into phases with wall timing.
+
+Usage: python tools/profile_iter.py [rows] [iters]
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+ITERS = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+
+import jax  # noqa: E402
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import Dataset  # noqa: E402
+from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+
+r = np.random.RandomState(17)
+F = 28
+x = r.randn(N, F).astype(np.float32)
+w = r.randn(F) * (r.rand(F) > 0.4)
+y = ((x @ w * 0.3 + r.randn(N)) > 0).astype(np.float64)
+
+cfg = Config({"objective": "binary", "num_leaves": 255, "max_bin": 63,
+              "metric": "none", "min_data_in_leaf": 20, "verbosity": -1})
+t0 = time.time()
+ds = Dataset(x, config=cfg, label=y)
+ds.construct() if hasattr(ds, "construct") else None
+bst = create_boosting(cfg, ds)
+print(f"setup {time.time()-t0:.1f}s  backend={jax.default_backend()} "
+      f"learner={type(bst.learner).__name__}")
+
+# warm (compile)
+for _ in range(2):
+    bst.train_one_iter()
+
+def sync(v):
+    np.asarray(jax.device_get(v.ravel()[:1]))
+
+acc = {}
+def phase(name, fn):
+    t = time.time()
+    out = fn()
+    dt = time.time() - t
+    acc[name] = acc.get(name, 0.0) + dt
+    return out
+
+for it in range(ITERS):
+    init = phase("boost_avg", lambda: [bst._boost_from_average(k, True)
+                                       for k in range(1)])
+    g, h = phase("gradients", lambda: bst._compute_gradients())
+    phase("grad_sync", lambda: sync(g))
+    bag = phase("bagging", lambda: bst._bagging(bst.iter))
+    tree = phase("tree_train", lambda: bst.learner.train(
+        g[0], h[0], bag, iter_seed=bst.iter))
+    phase("tree_sync", lambda: sync(bst.learner.last_leaf_id))
+    phase("shrink", lambda: tree.apply_shrinkage(bst.shrinkage_rate))
+    phase("update_score", lambda: bst._update_score(tree, 0))
+    phase("score_sync", lambda: sync(bst.score_updater.score))
+    bst.models.append(tree)
+    bst.iter += 1
+
+total = sum(acc.values())
+for k, v in acc.items():
+    print(f"{k:14s} {v/ITERS*1e3:9.1f} ms/iter")
+print(f"{'TOTAL':14s} {total/ITERS*1e3:9.1f} ms/iter")
