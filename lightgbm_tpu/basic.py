@@ -406,6 +406,13 @@ class Booster:
         self.params.update(params)
         self._gbdt.config.update(params)
         self._gbdt.shrinkage_rate = self._gbdt.config.learning_rate
+        # learning_rate rides the fused step as a traced argument; any other
+        # param is baked in at trace time, so drop the cached programs
+        # (the DP learner caches its sharded tree program the same way)
+        if any(k != "learning_rate" for k in params):
+            self._gbdt._fused_step = None
+            if hasattr(self._gbdt.learner, "_tree_w_fn"):
+                self._gbdt.learner._tree_w_fn = None
         return self
 
     def set_network(self, machines, local_listen_port=12400,

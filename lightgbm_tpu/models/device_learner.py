@@ -58,38 +58,16 @@ def _env(name, default):
     return os.environ.get(name, default)
 
 
-class _Best(NamedTuple):
-    """Per-leaf best-split state, all (L,) arrays (the device analog of the
-    reference's best_split_per_leaf_)."""
-    gain: jax.Array
-    feat: jax.Array
-    thr: jax.Array
-    dleft: jax.Array
-    lsg: jax.Array
-    lsh: jax.Array
-    lcnt: jax.Array
-    rsg: jax.Array
-    rsh: jax.Array
-    rcnt: jax.Array
-    lout: jax.Array
-    rout: jax.Array
+# Per-leaf best-split state lives in ONE (L, 12) f32 array (the device
+# analog of the reference's best_split_per_leaf_) so each update is a single
+# row write instead of 12 tiny scatters. feat/thr ride as exact small f32.
+B_GAIN, B_FEAT, B_THR, B_DLEFT, B_LSG, B_LSH, B_LCNT, B_RSG, B_RSH, \
+    B_RCNT, B_LOUT, B_ROUT = range(12)
 
-
-class _Rec(NamedTuple):
-    """Per-split records, all (L-1,) arrays, replayed on host into a Tree."""
-    leaf: jax.Array
-    feat: jax.Array
-    thr: jax.Array
-    dleft: jax.Array
-    gain: jax.Array
-    lsg: jax.Array
-    lsh: jax.Array
-    lcnt: jax.Array
-    rsg: jax.Array
-    rsh: jax.Array
-    rcnt: jax.Array
-    lout: jax.Array
-    rout: jax.Array
+# Per-split records: ONE (L-1, 13) f32 array fetched to host in a single
+# transfer per tree and replayed into a Tree.
+R_LEAF, R_FEAT, R_THR, R_DLEFT, R_GAIN, R_LSG, R_LSH, R_LCNT, R_RSG, \
+    R_RSH, R_RCNT, R_LOUT, R_ROUT = range(13)
 
 
 class _Carry(NamedTuple):
@@ -99,8 +77,8 @@ class _Carry(NamedTuple):
     depth: jax.Array
     leaf_min: jax.Array
     leaf_max: jax.Array
-    best: _Best
-    rec: _Rec
+    best: jax.Array          # (L, 12) f32
+    rec: jax.Array           # (L-1, 13) f32
     key: jax.Array
 
 
@@ -143,25 +121,33 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
             feat, rel, t, use_m1, prefix, sg, sh, cnt, mn, mx,
             l1=l1, l2=l2, max_delta_step=max_delta_step)
 
-    def store_best(best: _Best, i, res: split_ops.SplitResult,
-                   child_depth) -> _Best:
+    def _best_row(res: split_ops.SplitResult, child_depth) -> jax.Array:
         gain = res.gain
         if max_depth > 0:
             gain = jnp.where(child_depth >= max_depth, NEG_INF, gain)
-        return _Best(
-            best.gain.at[i].set(gain), best.feat.at[i].set(res.feature),
-            best.thr.at[i].set(res.threshold),
-            best.dleft.at[i].set(res.default_left),
-            best.lsg.at[i].set(res.left_sum_grad),
-            best.lsh.at[i].set(res.left_sum_hess),
-            best.lcnt.at[i].set(res.left_count),
-            best.rsg.at[i].set(res.right_sum_grad),
-            best.rsh.at[i].set(res.right_sum_hess),
-            best.rcnt.at[i].set(res.right_count),
-            best.lout.at[i].set(res.left_output),
-            best.rout.at[i].set(res.right_output))
+        return jnp.stack([
+            gain, res.feature.astype(jnp.float32),
+            res.threshold.astype(jnp.float32),
+            res.default_left.astype(jnp.float32),
+            res.left_sum_grad, res.left_sum_hess, res.left_count,
+            res.right_sum_grad, res.right_sum_hess, res.right_count,
+            res.left_output, res.right_output])
 
-    return node_mask, scan, store_best
+    def store_best(best: jax.Array, i, res: split_ops.SplitResult,
+                   child_depth) -> jax.Array:
+        return best.at[i].set(_best_row(res, child_depth))
+
+    def scan2(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2):
+        """Both children's split scans in one vectorized pass."""
+        fmask2 = jax.vmap(node_mask)(keys2)
+        return jax.vmap(scan)(col_hist2, sg2, sh2, cnt2, mn2, mx2, fmask2)
+
+    def store_best2(best, i2, res2: split_ops.SplitResult, child_depth):
+        rows = jax.vmap(functools.partial(_best_row,
+                                          child_depth=child_depth))(res2)
+        return best.at[i2].set(rows)
+
+    return node_mask, scan, store_best, scan2, store_best2
 
 
 @functools.partial(
@@ -188,7 +174,7 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
     f = f_numbins.shape[0]
     L = num_leaves
     gh = jnp.stack([grad * w, hess * w, w], axis=1)     # (N, 3)
-    node_mask, scan, store_best = _tree_helpers(
+    node_mask, scan, store_best, scan2, store_best2 = _tree_helpers(
         base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
         f_elide, hist_idx,
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
@@ -204,18 +190,14 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
                     jnp.float32(-np.inf), jnp.float32(np.inf),
                     node_mask(root_key))
 
-    zf = functools.partial(jnp.zeros, dtype=jnp.float32)
-    zi = functools.partial(jnp.zeros, dtype=jnp.int32)
-    best = _Best(jnp.full((L,), NEG_INF, jnp.float32), zi(L), zi(L),
-                 jnp.zeros(L, bool), zf(L), zf(L), zf(L), zf(L), zf(L),
-                 zf(L), zf(L), zf(L))
+    best = jnp.full((L, 12), NEG_INF, jnp.float32) \
+        .at[:, B_FEAT:].set(0.0)
     # the depth argument is the stored leaf's own depth (a leaf at depth d
     # may split iff d < max_depth, reference _splittable); root sits at 0
     best = store_best(best, 0, root_res, jnp.int32(0))
     pool = jnp.zeros((L, c_cols, col_bins, 3), jnp.float32).at[0].set(hist0)
-    rec = _Rec(zi(L - 1), zi(L - 1), zi(L - 1), jnp.zeros(L - 1, bool),
-               zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1),
-               zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1))
+    rec = jnp.zeros((L - 1, 13), jnp.float32)
+    zi = functools.partial(jnp.zeros, dtype=jnp.int32)
     carry = _Carry(
         k=jnp.int32(0), leaf_id=jnp.zeros(n, jnp.int32), pool=pool,
         depth=zi(L),
@@ -224,15 +206,16 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
         best=best, rec=rec, key=loop_key)
 
     def cond(c: _Carry):
-        return (c.k < L - 1) & (jnp.max(c.best.gain) > 1e-10)
+        return (c.k < L - 1) & (jnp.max(c.best[:, B_GAIN]) > 1e-10)
 
     def body(c: _Carry) -> _Carry:
         b = c.best
-        l = jnp.argmax(b.gain).astype(jnp.int32)
+        l = jnp.argmax(b[:, B_GAIN]).astype(jnp.int32)
+        row = b[l]
         new_id = c.k + 1
-        feat = b.feat[l]
-        thr = b.thr[l]
-        dleft = b.dleft[l]
+        feat = row[B_FEAT].astype(jnp.int32)
+        thr = row[B_THR].astype(jnp.int32)
+        dleft = row[B_DLEFT] > 0.5
 
         col = jax.lax.dynamic_slice_in_dim(codes_t, f_col[feat], 1, axis=0)[0]
         fbins = bundle_ops.logical_bins_for_feature(
@@ -251,7 +234,7 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
 
         # monotone constraint propagation (basic mode)
         mono_f = f_monotone[feat]
-        mid = (b.lout[l] + b.rout[l]) * 0.5
+        mid = (row[B_LOUT] + row[B_ROUT]) * 0.5
         pmin, pmax = c.leaf_min[l], c.leaf_max[l]
         lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
         lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
@@ -262,26 +245,22 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
         child_depth = c.depth[l] + 1
         depth = c.depth.at[l].set(child_depth).at[new_id].set(child_depth)
 
-        rec = _Rec(
-            c.rec.leaf.at[c.k].set(l), c.rec.feat.at[c.k].set(feat),
-            c.rec.thr.at[c.k].set(thr), c.rec.dleft.at[c.k].set(dleft),
-            c.rec.gain.at[c.k].set(b.gain[l]),
-            c.rec.lsg.at[c.k].set(b.lsg[l]), c.rec.lsh.at[c.k].set(b.lsh[l]),
-            c.rec.lcnt.at[c.k].set(b.lcnt[l]),
-            c.rec.rsg.at[c.k].set(b.rsg[l]), c.rec.rsh.at[c.k].set(b.rsh[l]),
-            c.rec.rcnt.at[c.k].set(b.rcnt[l]),
-            c.rec.lout.at[c.k].set(b.lout[l]),
-            c.rec.rout.at[c.k].set(b.rout[l]))
+        rec_row = jnp.concatenate([
+            jnp.stack([l.astype(jnp.float32), row[B_FEAT], row[B_THR],
+                       row[B_DLEFT], row[B_GAIN]]),
+            row[B_LSG:]])
+        rec2 = c.rec.at[c.k].set(rec_row)
 
         key, kl, kr = jax.random.split(c.key, 3)
-        res_l = scan(hist_l, b.lsg[l], b.lsh[l], b.lcnt[l], lmin, lmax,
-                     node_mask(kl))
-        res_r = scan(hist_r, b.rsg[l], b.rsh[l], b.rcnt[l], rmin, rmax,
-                     node_mask(kr))
-        best = store_best(b, l, res_l, child_depth)
-        best = store_best(best, new_id, res_r, child_depth)
+        res2 = scan2(jnp.stack([hist_l, hist_r]),
+                     jnp.stack([row[B_LSG], row[B_RSG]]),
+                     jnp.stack([row[B_LSH], row[B_RSH]]),
+                     jnp.stack([row[B_LCNT], row[B_RCNT]]),
+                     jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
+                     jnp.stack([kl, kr]))
+        best2 = store_best2(b, jnp.stack([l, new_id]), res2, child_depth)
         return _Carry(new_id, leaf_id, pool, depth, leaf_min, leaf_max,
-                      best, rec, key)
+                      best2, rec2, key)
 
     out = jax.lax.while_loop(cond, body, carry)
     return out.rec, out.leaf_id, out.k, totals
@@ -289,16 +268,16 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
 
 class _CarryC(NamedTuple):
     k: jax.Array
-    perm: jax.Array          # (N + Wmax,) row ids grouped by leaf window
-    pos_leaf: jax.Array      # (N + Wmax,) leaf id per PERM POSITION
+    data: jax.Array          # (N + Wmax, D) u32 packed rows grouped by leaf
+    pos_leaf: jax.Array      # (N + Wmax,) leaf id per physical POSITION
     leaf_begin: jax.Array    # (L,)
     leaf_phys: jax.Array     # (L,) physical rows in the window
     pool: jax.Array
     depth: jax.Array
     leaf_min: jax.Array
     leaf_max: jax.Array
-    best: "_Best"
-    rec: "_Rec"
+    best: jax.Array          # (L, 12) f32
+    rec: jax.Array           # (L-1, 13) f32
     key: jax.Array
 
 
@@ -312,39 +291,80 @@ def _size_classes(n: int, min_bucket: int = 4096, step: int = 4):
     return ws
 
 
+def _unpack_codes(words: jax.Array, c_cols: int, item_bits: int) -> jax.Array:
+    """(W, CW) u32 packed codes -> (W, c_cols) i32."""
+    per = 32 // item_bits
+    shifts = (jnp.arange(per, dtype=jnp.uint32) * item_bits)[None, None, :]
+    u = (words[:, :, None] >> shifts) & jnp.uint32((1 << item_bits) - 1)
+    return u.reshape(words.shape[0], words.shape[1] * per)[:, :c_cols] \
+            .astype(jnp.int32)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("num_leaves", "num_bins", "col_bins", "max_depth",
+    static_argnames=("c_cols", "item_bits",
+                     "num_leaves", "num_bins", "col_bins", "max_depth",
                      "l1", "l2", "max_delta_step", "min_data_in_leaf",
                      "min_sum_hessian", "min_gain_to_split", "bynode_k",
                      "use_pallas"))
 def grow_tree_compact(
-        codes: jax.Array,            # (N, C) row-major for window gathers
-        codes_t: jax.Array,          # (C, N) for the root pass
+        codes_pack: jax.Array,       # (N, CW) u32: packed column codes
+        codes_row: jax.Array,        # (N, C) u8/u16 for the root pass
         grad: jax.Array, hess: jax.Array, w: jax.Array,
         base_mask: jax.Array,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
         f_col, f_base, f_elide, hist_idx, rng_key,
-        *, num_leaves: int, num_bins: int, col_bins: int, max_depth: int,
+        *, c_cols: int, item_bits: int,
+        num_leaves: int, num_bins: int, col_bins: int, max_depth: int,
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool):
+    return grow_tree_compact_core(
+        codes_pack, codes_row, grad, hess, w, base_mask,
+        f_numbins, f_missing, f_default, f_monotone, f_penalty,
+        f_col, f_base, f_elide, hist_idx, rng_key,
+        c_cols=c_cols, item_bits=item_bits, num_leaves=num_leaves,
+        num_bins=num_bins, col_bins=col_bins, max_depth=max_depth,
+        l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
+        use_pallas=use_pallas, axis_name=None)
+
+
+def grow_tree_compact_core(
+        codes_pack: jax.Array, codes_row: jax.Array,
+        grad: jax.Array, hess: jax.Array, w: jax.Array,
+        base_mask: jax.Array,
+        f_numbins, f_missing, f_default, f_monotone, f_penalty,
+        f_col, f_base, f_elide, hist_idx, rng_key,
+        *, c_cols: int, item_bits: int,
+        num_leaves: int, num_bins: int, col_bins: int, max_depth: int,
+        l1: float, l2: float, max_delta_step: float,
+        min_data_in_leaf: int, min_sum_hessian: float,
+        min_gain_to_split: float, bynode_k: int, use_pallas: bool,
+        axis_name=None):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
     split — ruinous at Higgs scale. This variant keeps the reference's
-    DataPartition idea (data_partition.hpp:20-205) on device: a permutation
-    buffer groups rows by leaf, each split gathers ONLY the split leaf's
-    window, partitions it with a stable 2-bit-key sort, and builds the
-    SMALLER child's histogram from the gathered window (sibling =
+    DataPartition idea (data_partition.hpp:20-205) on device, but instead
+    of a permutation of row IDS it physically reorders one packed
+    (N, CW + 4) u32 buffer (bit-packed codes | bitcast grad,hess,weight |
+    row id). Random access is latency-bound on TPU (~14ns/row regardless
+    of width), so moving WHOLE rows once per split costs the same as
+    moving bare indices — and then every window read (feature column,
+    histogram input, gh) is a contiguous dynamic_slice at HBM bandwidth
+    instead of a full-table gather. The histogram is built from the
+    SMALLER child's contiguous half-window after the partition (sibling =
     parent - smaller, FeatureHistogram::Subtract). Dynamic leaf sizes meet
     XLA's static shapes through a small ladder of padded window classes
     (x4 steps) dispatched with lax.switch — each class is traced once.
     """
-    c_cols, n = codes_t.shape
+    n = grad.shape[0]
+    cw = codes_pack.shape[1]
     L = num_leaves
     gh = jnp.stack([grad * w, hess * w, w], axis=1)
-    node_mask, scan, store_best = _tree_helpers(
+    node_mask, scan, store_best, scan2, store_best2 = _tree_helpers(
         base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
         f_elide, hist_idx,
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
@@ -355,29 +375,36 @@ def grow_tree_compact(
     classes = _size_classes(n)
     wmax = classes[-1]
     thresholds = jnp.asarray(np.array(classes[:-1], np.int32))
+    item_mask = jnp.uint32((1 << item_bits) - 1)
+    per = 32 // item_bits
+    d_cols = cw + 4
+
+    # packed working buffer: codes | gh (bitcast) | row id, padded by wmax
+    gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)          # (N, 3)
+    ids = jnp.arange(n, dtype=jnp.uint32)[:, None]
+    data0 = jnp.concatenate([codes_pack, gh_u, ids], axis=1)
+    data0 = jnp.concatenate(
+        [data0, jnp.zeros((wmax, d_cols), jnp.uint32)], axis=0)
 
     # ---- root ------------------------------------------------------------
-    hist0 = _hist_t(codes_t, gh, col_bins, use_pallas)
+    from ..ops.histogram import build_histogram
+    hist0 = build_histogram(codes_row, gh, col_bins, use_pallas=use_pallas)
+    if axis_name is not None:
+        hist0 = jax.lax.psum(hist0, axis_name)
     totals = hist0[0].sum(axis=0)
     root_key, loop_key = jax.random.split(rng_key)
     root_res = scan(hist0, totals[0], totals[1], totals[2],
                     jnp.float32(-np.inf), jnp.float32(np.inf),
                     node_mask(root_key))
 
-    zf = functools.partial(jnp.zeros, dtype=jnp.float32)
     zi = functools.partial(jnp.zeros, dtype=jnp.int32)
-    best = _Best(jnp.full((L,), NEG_INF, jnp.float32), zi(L), zi(L),
-                 jnp.zeros(L, bool), zf(L), zf(L), zf(L), zf(L), zf(L),
-                 zf(L), zf(L), zf(L))
+    best = jnp.full((L, 12), NEG_INF, jnp.float32).at[:, B_FEAT:].set(0.0)
     best = store_best(best, 0, root_res, jnp.int32(0))
     pool = jnp.zeros((L, c_cols, col_bins, 3), jnp.float32).at[0].set(hist0)
-    rec = _Rec(zi(L - 1), zi(L - 1), zi(L - 1), jnp.zeros(L - 1, bool),
-               zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1),
-               zf(L - 1), zf(L - 1), zf(L - 1), zf(L - 1))
+    rec = jnp.zeros((L - 1, 13), jnp.float32)
     carry = _CarryC(
         k=jnp.int32(0),
-        perm=jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
-                              jnp.zeros(wmax, jnp.int32)]),
+        data=data0,
         pos_leaf=jnp.zeros(n + wmax, jnp.int32),
         leaf_begin=zi(L), leaf_phys=zi(L).at[0].set(n),
         pool=pool, depth=zi(L),
@@ -386,35 +413,41 @@ def grow_tree_compact(
         best=best, rec=rec, key=loop_key)
 
     def cond(c: _CarryC):
-        return (c.k < L - 1) & (jnp.max(c.best.gain) > 1e-10)
+        return (c.k < L - 1) & (jnp.max(c.best[:, B_GAIN]) > 1e-10)
 
     def make_branch(wsz: int):
-        def branch(c: _CarryC) -> _CarryC:
-            b = c.best
-            l = jnp.argmax(b.gain).astype(jnp.int32)
-            new_id = c.k + 1
-            feat = b.feat[l]
+        half = (wsz + 1) // 2
+
+        def branch(op):
+            c, l, row, new_id = op
+            feat = row[B_FEAT].astype(jnp.int32)
             begin = c.leaf_begin[l]
             pcount = c.leaf_phys[l]
 
-            window = jax.lax.dynamic_slice(c.perm, (begin,), (wsz,))
+            win = jax.lax.dynamic_slice(c.data, (begin, 0), (wsz, d_cols))
             valid = jnp.arange(wsz, dtype=jnp.int32) < pcount
-            rows = jnp.take(codes, window, axis=0)        # (W, C)
-            col = jax.lax.dynamic_slice_in_dim(
-                rows, f_col[feat], 1, axis=1)[:, 0].astype(jnp.int32)
+            word = (f_col[feat] // per).astype(jnp.int32)
+            sub = (f_col[feat] % per).astype(jnp.uint32)
+            col32 = jax.lax.dynamic_slice(win, (0, word), (wsz, 1))[:, 0]
+            col = ((col32 >> (sub * item_bits)) & item_mask).astype(jnp.int32)
             fbins = bundle_ops.logical_bins_for_feature(
                 col, f_base[feat], f_default[feat], f_numbins[feat],
                 f_elide[feat])
-            go_left = decide_left(fbins, b.thr[l], b.dleft[l],
+            go_left = decide_left(fbins, row[B_THR].astype(jnp.int32),
+                                  row[B_DLEFT] > 0.5,
                                   f_missing[feat], f_default[feat],
                                   f_numbins[feat]) & valid
 
-            # stable partition of the window (reference DataPartition::Split)
+            # stable partition of the window (reference DataPartition::
+            # Split): overrun rows past pcount get key 2, so the stable
+            # sort returns them to their original slots untouched
             key3 = jnp.where(valid, jnp.where(go_left, 0, 1), 2)
             order = jnp.argsort(key3.astype(jnp.int8), stable=True)
-            new_window = window[order]
-            perm = jax.lax.dynamic_update_slice(c.perm, new_window, (begin,))
+            win_sorted = jnp.take(win, order, axis=0)
+            data = jax.lax.dynamic_update_slice(c.data, win_sorted,
+                                                (begin, 0))
             lphys = jnp.sum(go_left.astype(jnp.int32))
+            rphys = pcount - lphys
 
             pos = jnp.arange(wsz, dtype=jnp.int32)
             old_slice = jax.lax.dynamic_slice(c.pos_leaf, (begin,), (wsz,))
@@ -424,77 +457,132 @@ def grow_tree_compact(
                 c.pos_leaf, new_slice, (begin,))
 
             leaf_begin = c.leaf_begin.at[new_id].set(begin + lphys)
-            leaf_phys = c.leaf_phys.at[l].set(lphys).at[new_id].set(
-                pcount - lphys)
+            leaf_phys = c.leaf_phys.at[l].set(lphys).at[new_id].set(rphys)
 
-            # smaller child's histogram from the (unsorted) gathered window
-            left_small = lphys * 2 <= pcount
-            small_mask = jnp.where(left_small, go_left, valid & ~go_left)
-            gh_w = jnp.take(gh, window, axis=0) * small_mask[:, None]
-            hist_small = _hist_t(jnp.swapaxes(rows, 0, 1), gh_w, col_bins,
-                                 use_pallas)
-            parent = c.pool[l]
-            hist_l = jnp.where(left_small, hist_small, parent - hist_small)
-            hist_r = jnp.where(left_small, parent - hist_small, hist_small)
-            pool = c.pool.at[l].set(hist_l).at[new_id].set(hist_r)
+            # LOCAL histogram of the GLOBALLY smaller child (all shards
+            # must hist the same side so the cross-shard sum is one
+            # child's histogram; the choice key is the replicated global
+            # count from the split record). Fast path: the side fits the
+            # contiguous half window; fallback (possible only when local
+            # physical share is skewed vs the global choice under
+            # bagging/sharding): masked pass over the full window.
+            left_small = row[B_LCNT] <= row[B_RCNT]
+            s_begin = jnp.where(left_small, 0, lphys)
+            s_count = jnp.where(left_small, lphys, rphys)
 
-            # monotone propagation + depth (same as masked strategy)
-            mono_f = f_monotone[feat]
-            mid = (b.lout[l] + b.rout[l]) * 0.5
-            pmin, pmax = c.leaf_min[l], c.leaf_max[l]
-            lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
-            lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
-            rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
-            rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
-            leaf_min = c.leaf_min.at[l].set(lmin).at[new_id].set(rmin)
-            leaf_max = c.leaf_max.at[l].set(lmax).at[new_id].set(rmax)
-            child_depth = c.depth[l] + 1
-            depth = c.depth.at[l].set(child_depth).at[new_id].set(child_depth)
+            def hist_half(_):
+                start = jnp.clip(s_begin, 0, wsz - half)
+                off = s_begin - start
+                sw = jax.lax.dynamic_slice(win_sorted, (start, 0),
+                                           (half, d_cols))
+                s_codes = _unpack_codes(sw[:, :cw], c_cols, item_bits)
+                j = jnp.arange(half, dtype=jnp.int32)
+                sv = ((j >= off) & (j < off + s_count)).astype(jnp.float32)
+                s_gh = jax.lax.bitcast_convert_type(
+                    sw[:, cw:cw + 3], jnp.float32) * sv[:, None]
+                return build_histogram(s_codes, s_gh, col_bins,
+                                       use_pallas=use_pallas)
 
-            rec2 = _Rec(
-                c.rec.leaf.at[c.k].set(l), c.rec.feat.at[c.k].set(feat),
-                c.rec.thr.at[c.k].set(b.thr[l]),
-                c.rec.dleft.at[c.k].set(b.dleft[l]),
-                c.rec.gain.at[c.k].set(b.gain[l]),
-                c.rec.lsg.at[c.k].set(b.lsg[l]),
-                c.rec.lsh.at[c.k].set(b.lsh[l]),
-                c.rec.lcnt.at[c.k].set(b.lcnt[l]),
-                c.rec.rsg.at[c.k].set(b.rsg[l]),
-                c.rec.rsh.at[c.k].set(b.rsh[l]),
-                c.rec.rcnt.at[c.k].set(b.rcnt[l]),
-                c.rec.lout.at[c.k].set(b.lout[l]),
-                c.rec.rout.at[c.k].set(b.rout[l]))
+            def hist_full(_):
+                s_codes = _unpack_codes(win_sorted[:, :cw], c_cols,
+                                        item_bits)
+                j = jnp.arange(wsz, dtype=jnp.int32)
+                sv = ((j >= s_begin)
+                      & (j < s_begin + s_count)).astype(jnp.float32)
+                s_gh = jax.lax.bitcast_convert_type(
+                    win_sorted[:, cw:cw + 3], jnp.float32) * sv[:, None]
+                return build_histogram(s_codes, s_gh, col_bins,
+                                       use_pallas=use_pallas)
 
-            key, kl, kr = jax.random.split(c.key, 3)
-            res_l = scan(hist_l, b.lsg[l], b.lsh[l], b.lcnt[l], lmin, lmax,
-                         node_mask(kl))
-            res_r = scan(hist_r, b.rsg[l], b.rsh[l], b.rcnt[l], rmin, rmax,
-                         node_mask(kr))
-            best2 = store_best(b, l, res_l, child_depth)
-            best2 = store_best(best2, new_id, res_r, child_depth)
-            return _CarryC(new_id, perm, pos_leaf, leaf_begin, leaf_phys,
-                           pool, depth, leaf_min, leaf_max, best2, rec2, key)
+            hist_small = jax.lax.cond(s_count <= half, hist_half, hist_full,
+                                      operand=None)
+            return data, pos_leaf, leaf_begin, leaf_phys, hist_small
         return branch
 
     branches = [make_branch(wsz) for wsz in classes]
 
     def body(c: _CarryC) -> _CarryC:
-        l = jnp.argmax(c.best.gain).astype(jnp.int32)
+        b = c.best
+        l = jnp.argmax(b[:, B_GAIN]).astype(jnp.int32)
+        row = b[l]
+        new_id = c.k + 1
+        feat = row[B_FEAT].astype(jnp.int32)
         pcount = c.leaf_phys[l]
         j = jnp.sum((pcount > thresholds).astype(jnp.int32))
-        return jax.lax.switch(j, branches, c)
+        data, pos_leaf, leaf_begin, leaf_phys, hist_small = jax.lax.switch(
+            j, branches, (c, l, row, new_id))
+        if axis_name is not None:
+            # the reference reduce-scatters per-machine histograms
+            # (data_parallel_tree_learner.cpp:149-164); psum over ICI is
+            # the dense equivalent and leaves the sums replicated for the
+            # identical best-split scan on every shard
+            hist_small = jax.lax.psum(hist_small, axis_name)
+
+        left_small = row[B_LCNT] <= row[B_RCNT]
+        parent = c.pool[l]
+        hist_l = jnp.where(left_small, hist_small, parent - hist_small)
+        hist_r = jnp.where(left_small, parent - hist_small, hist_small)
+        pool = c.pool.at[l].set(hist_l).at[new_id].set(hist_r)
+
+        # monotone propagation + depth (same as masked strategy)
+        mono_f = f_monotone[feat]
+        mid = (row[B_LOUT] + row[B_ROUT]) * 0.5
+        pmin, pmax = c.leaf_min[l], c.leaf_max[l]
+        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
+        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
+        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
+        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
+        leaf_min = c.leaf_min.at[l].set(lmin).at[new_id].set(rmin)
+        leaf_max = c.leaf_max.at[l].set(lmax).at[new_id].set(rmax)
+        child_depth = c.depth[l] + 1
+        depth = c.depth.at[l].set(child_depth).at[new_id].set(child_depth)
+
+        rec_row = jnp.concatenate([
+            jnp.stack([l.astype(jnp.float32), row[B_FEAT], row[B_THR],
+                       row[B_DLEFT], row[B_GAIN]]),
+            row[B_LSG:]])
+        rec2 = c.rec.at[c.k].set(rec_row)
+
+        key, kl, kr = jax.random.split(c.key, 3)
+        res2 = scan2(jnp.stack([hist_l, hist_r]),
+                     jnp.stack([row[B_LSG], row[B_RSG]]),
+                     jnp.stack([row[B_LSH], row[B_RSH]]),
+                     jnp.stack([row[B_LCNT], row[B_RCNT]]),
+                     jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
+                     jnp.stack([kl, kr]))
+        best2 = store_best2(b, jnp.stack([l, new_id]), res2, child_depth)
+        return _CarryC(new_id, data, pos_leaf, leaf_begin, leaf_phys,
+                       pool, depth, leaf_min, leaf_max, best2, rec2, key)
 
     out = jax.lax.while_loop(cond, body, carry)
-    # final row -> leaf map: scatter window-position leaves onto row ids
-    leaf_id = jnp.zeros(n, jnp.int32).at[out.perm[:n]].set(
+    # final row -> leaf map: scatter physical-position leaves onto row ids
+    row_ids = out.data[:n, d_cols - 1].astype(jnp.int32)
+    leaf_id = jnp.zeros(n, jnp.int32).at[row_ids].set(
         out.pos_leaf[:n], unique_indices=True)
     return out.rec, leaf_id, out.k, totals
+
+
+def leaf_values_from_rec(rec: jax.Array, k: jax.Array, L: int) -> jax.Array:
+    """On-device replay of the (L-1, 13) split records into the final (L,)
+    leaf-value vector: split i rewrites its leaf with lout and writes rout
+    into leaf i+1 (the same ids the host replay assigns)."""
+    def body(i, lv):
+        do = i < k
+        leaf = rec[i, R_LEAF].astype(jnp.int32)
+        lv = lv.at[leaf].set(jnp.where(do, rec[i, R_LOUT], lv[leaf]))
+        lv = lv.at[i + 1].set(jnp.where(do, rec[i, R_ROUT], lv[i + 1]))
+        return lv
+    return jax.lax.fori_loop(0, L - 1, body, jnp.zeros((L,), jnp.float32))
 
 
 class DeviceTreeLearner:
     """Drop-in TreeLearner whose Train runs one jitted program per tree."""
 
-    def __init__(self, config: Config, dataset: Dataset):
+    def __init__(self, config: Config, dataset: Dataset,
+                 strategy: Optional[str] = None, device_place: bool = True):
+        # device_place=False keeps the compact buffers host-side so a
+        # sharding subclass can place them itself without a device
+        # round-trip (DeviceDataParallelTreeLearner)
         self.config = config
         self.dataset = dataset
         (self.f_numbins, self.f_missing, self.f_default,
@@ -553,16 +641,36 @@ class DeviceTreeLearner:
         self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
         # strategy: compaction pays off once O(N)-per-split masked passes
         # dominate; small data stays on the simpler masked program
-        strat = _env("LGBM_TPU_STRATEGY", "auto")
+        strat = strategy or _env("LGBM_TPU_STRATEGY", "auto")
         if strat == "auto":
             strat = "compact" if dataset.num_data >= 65536 else "masked"
         self.strategy = strat
         if self.strategy == "compact":
             host_codes = (dataset.bundled if dataset.bundled is not None
                           else dataset.binned)
-            self.codes_row = jnp.asarray(host_codes)      # (N, C)
+            host_codes = np.asarray(host_codes)
+            # bit-pack column codes into u32 words for the physically
+            # reordered working buffer (4 u8 or 2 u16 codes per word)
+            self.item_bits = 16 if host_codes.dtype.itemsize == 2 else 8
+            per = 32 // self.item_bits
+            nrow, ncol = host_codes.shape
+            padded = np.zeros((nrow, ((ncol + per - 1) // per) * per),
+                              dtype=np.uint8 if self.item_bits == 8
+                              else np.uint16)
+            padded[:, :ncol] = host_codes
+            packed = np.ascontiguousarray(padded).view(np.uint32)
+            self.c_cols = ncol
+            if device_place:
+                self.codes_row = jnp.asarray(host_codes)      # (N, C)
+                self.codes_pack = jnp.asarray(packed)
+            else:
+                self.codes_row = host_codes
+                self.codes_pack = packed
         else:
             self.codes_row = None
+            self.codes_pack = None
+            self.item_bits = 8
+            self.c_cols = int(self.codes_t.shape[0])
         self._ones_w = None
         self.last_leaf_id: Optional[jax.Array] = None
         self._leaf_id_host: Optional[np.ndarray] = None
@@ -650,10 +758,12 @@ class DeviceTreeLearner:
 
         if self.strategy == "compact":
             rec, leaf_id, n_splits, _ = grow_tree_compact(
-                self.codes_row, self.codes_t, grad, hess, w, base_mask,
+                self.codes_pack, self.codes_row, grad, hess, w, base_mask,
                 self.f_numbins, self.f_missing, self.f_default,
                 self.f_monotone, self.f_penalty, self.f_col, self.f_base,
-                self.f_elide, self.hist_idx, key, **self._statics())
+                self.f_elide, self.hist_idx, key,
+                c_cols=self.c_cols, item_bits=self.item_bits,
+                **self._statics())
         else:
             rec, leaf_id, n_splits, _ = grow_tree(
                 self.codes_t, grad, hess, w, base_mask,
@@ -667,22 +777,81 @@ class DeviceTreeLearner:
         k = int(k)
         if k == 0:
             log.warning("No further splits with positive gain")
-        tree = Tree(cfg.num_leaves)
+        return self.replay_tree(rec_h, k)
+
+    def replay_tree(self, rec_h, k: int) -> Tree:
+        """Materialize a host Tree from the fetched (L-1, 13) split-record
+        array (the one device->host transfer per tree)."""
+        ds = self.dataset
+        rec_h = np.asarray(rec_h)
+        tree = Tree(self.config.num_leaves)
         for i in range(k):
-            inner_f = int(rec_h.feat[i])
+            r = rec_h[i]
+            inner_f = int(r[R_FEAT])
             real_f = ds.inner_to_real(inner_f)
             mapper = ds.bin_mappers[real_f]
-            thr_bin = int(rec_h.thr[i])
+            thr_bin = int(r[R_THR])
             tree.split(
-                int(rec_h.leaf[i]), inner_f, real_f, thr_bin,
+                int(r[R_LEAF]), inner_f, real_f, thr_bin,
                 ds.real_threshold(inner_f, thr_bin),
-                float(rec_h.lout[i]), float(rec_h.rout[i]),
-                int(round(float(rec_h.lcnt[i]))),
-                int(round(float(rec_h.rcnt[i]))),
-                float(rec_h.lsh[i]), float(rec_h.rsh[i]),
-                float(rec_h.gain[i]), mapper.missing_type,
-                bool(rec_h.dleft[i]))
+                float(r[R_LOUT]), float(r[R_ROUT]),
+                int(round(float(r[R_LCNT]))),
+                int(round(float(r[R_RCNT]))),
+                float(r[R_LSH]), float(r[R_RSH]),
+                float(r[R_GAIN]), mapper.missing_type,
+                bool(r[R_DLEFT] > 0.5))
         return tree
+
+    # ------------------------------------------------------------------
+    def make_fused_step(self, objective):
+        """One boosting iteration as a single device program: gradients ->
+        bag sampling -> whole-tree growth -> on-device leaf-value replay ->
+        score update. Through a tunneled TPU every extra dispatch costs
+        ~10ms and every H2D ~130ms/4MB, so the fused step leaves exactly
+        one small D2H fetch (the split records) per iteration.
+
+        Returns step(score_row, base_mask, tree_key, bag_key, shrinkage)
+        -> (new_score_row, rec, leaf_id, num_splits).
+        """
+        statics = self._statics()
+        n = self.dataset.num_data
+        cfg = self.config
+        use_compact = self.strategy == "compact"
+        grow = grow_tree_compact if use_compact else grow_tree
+        meta = (self.f_numbins, self.f_missing, self.f_default,
+                self.f_monotone, self.f_penalty, self.f_col, self.f_base,
+                self.f_elide, self.hist_idx)
+        bag_on = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+        bag_k = max(1, int(n * cfg.bagging_fraction))
+        L = statics["num_leaves"]
+
+        @jax.jit
+        def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+            g, h = objective.get_gradients(score_row)
+            if bag_on:
+                # exactly bag_k in-bag rows, deterministic per bag_key
+                # (reference Bagging, gbdt.cpp:210-276)
+                u = jax.random.uniform(bag_key, (n,))
+                cut = jnp.sort(u)[bag_k - 1]
+                w = (u <= cut).astype(jnp.float32)
+            else:
+                w = jnp.ones((n,), jnp.float32)
+            if use_compact:
+                rec, leaf_id, k, _ = grow(
+                    self.codes_pack, self.codes_row, g, h, w, base_mask,
+                    *meta, tree_key, c_cols=self.c_cols,
+                    item_bits=self.item_bits, **statics)
+            else:
+                rec, leaf_id, k, _ = grow(
+                    self.codes_t, g, h, w, base_mask, *meta, tree_key,
+                    **statics)
+
+            # on-device leaf-value replay avoids any H2D of leaf values
+            lv = leaf_values_from_rec(rec, k, L)
+            delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
+            return score_row + delta, rec, leaf_id, k
+
+        return step
 
     # ------------------------------------------------------------------
     def leaf_rows(self, leaf: int) -> np.ndarray:

@@ -131,6 +131,7 @@ class GBDT:
         self._bag_indices: Optional[np.ndarray] = None
         self._last_leaf_ids: Dict[int, Any] = {}
         self._last_leaf_ids_iter = -1
+        self._fused_step = None
         self._class_need_train = [
             self.objective.class_need_train(k) if self.objective else True
             for k in range(self.num_class)]
@@ -206,9 +207,74 @@ class GBDT:
         return idx
 
     # ------------------------------------------------------------------
+    def _fused_eligible(self) -> bool:
+        """Whether the single-program device iteration applies (plain GBDT,
+        single-class jittable objective, device learner, plain bagging)."""
+        from .device_learner import DeviceTreeLearner
+        return (self.__class__ is GBDT
+                and isinstance(self.learner, DeviceTreeLearner)
+                and self.objective is not None
+                and not self.objective.is_renew_tree_output
+                and self.num_class == 1
+                and self.num_tree_per_iteration == 1
+                and self._class_need_train[0]
+                and self.train_set.num_features > 0
+                and self.config.pos_bagging_fraction >= 1.0
+                and self.config.neg_bagging_fraction >= 1.0)
+
+    def _train_one_iter_fused(self) -> bool:
+        """One boosting iteration as one device program + one small fetch
+        (see DeviceTreeLearner.make_fused_step)."""
+        cfg = self.config
+        init_score = self._boost_from_average(0, True)
+        if self._fused_step is None:
+            self._fused_step = self.learner.make_fused_step(self.objective)
+        rng = np.random.RandomState(
+            (cfg.feature_fraction_seed + self.iter) % (2**31 - 1))
+        base_mask = jnp.asarray(
+            self.learner._feature_mask(rng)
+            & np.asarray(self.learner.f_categorical == 0))
+        tree_key = jax.random.PRNGKey(self.iter)
+        # same bag key for bagging_freq consecutive iterations == reference
+        # re-bags only on iter % freq == 0 and reuses the bag otherwise
+        freq = max(cfg.bagging_freq, 1)
+        bag_key = jax.random.PRNGKey(
+            (cfg.bagging_seed + (self.iter // freq)) % (2**31 - 1))
+        new_score, rec, leaf_id, k_dev = self._fused_step(
+            self.score_updater.score[0], base_mask, tree_key, bag_key,
+            jnp.float32(self.shrinkage_rate))
+        rec_h, k = jax.device_get((rec, k_dev))
+        k = int(k)
+        if k == 0:
+            # delegate the stop bookkeeping (constant init-score tree on a
+            # first-iteration stop, warning, model trimming) to the generic
+            # path so both paths produce identical final models
+            return self._train_one_iter_generic()
+        tree = self.learner.replay_tree(rec_h, k)
+        tree.apply_shrinkage(self.shrinkage_rate)
+        if abs(init_score) > K_EPSILON:
+            tree.add_bias(init_score)
+        self.learner.last_leaf_id = leaf_id
+        self.learner._leaf_id_host = None
+        self.learner._bag_mask_host = None
+        self.score_updater.score = self.score_updater.score.at[0].set(new_score)
+        self._last_leaf_ids[0] = leaf_id
+        self._last_leaf_ids_iter = self.iter
+        for vu in self.valid_updaters:
+            vu.add_tree(tree, 0)
+        self.models.append(tree)
+        self.iter += 1
+        return False
+
+    # ------------------------------------------------------------------
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         """One boosting iteration; returns True when training should stop
         (no tree with >1 leaf was produced)."""
+        if gradients is None and hessians is None and self._fused_eligible():
+            return self._train_one_iter_fused()
+        return self._train_one_iter_generic(gradients, hessians)
+
+    def _train_one_iter_generic(self, gradients=None, hessians=None) -> bool:
         init_scores = [0.0] * self.num_tree_per_iteration
         if gradients is None or hessians is None:
             for k in range(self.num_tree_per_iteration):

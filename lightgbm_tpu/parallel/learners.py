@@ -40,7 +40,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..io.dataset import Dataset
+from ..models.device_learner import DeviceTreeLearner
 from ..models.serial_learner import SerialTreeLearner, _bucket, _MIN_BUCKET
+from ..models.tree import Tree
 from ..ops import histogram as hist_ops
 from ..ops import split as split_ops
 from ..utils import log
@@ -452,21 +454,194 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             child.hist = "voting" if self._splittable_dp(child) else None
 
 
+class DeviceDataParallelTreeLearner(DeviceTreeLearner):
+    """Whole-tree data-parallel learner: rows sharded over a 1-D 'data'
+    mesh, the ENTIRE leaf-wise tree (partition + histograms + scans) grown
+    inside one jitted shard_map program.
+
+    The reference's per-split communication — ReduceScatter of all local
+    histograms plus an Allreduce of the best split (reference:
+    src/treelearner/data_parallel_tree_learner.cpp:149-164, :246
+    SyncUpGlobalBestSplit) — collapses into ONE psum of the smaller
+    child's (C, B, 3) histogram per split, after which every shard runs
+    the identical replicated argmax/scan, so the global-best sync costs
+    nothing extra. Each shard physically partitions only its own rows
+    (local DataPartition semantics, :256-262 global leaf counts come from
+    the summed histograms). No host round-trips inside a tree.
+    """
+
+    def __init__(self, config: Config, dataset: Dataset,
+                 mesh: Optional[Mesh] = None):
+        super().__init__(config, dataset, strategy="compact",
+                         device_place=False)
+        self.mesh = mesh or make_mesh(axis_name="data")
+        self.shards = int(self.mesh.devices.size)
+        n = dataset.num_data
+        self.local_n = -(-n // self.shards)
+        self.n_pad = self.local_n * self.shards
+
+        # place the packed buffers row-sharded and padded (the base class
+        # kept them host-side); pad rows carry zero codes and are fenced
+        # off by w == 0 inside the step
+        pad = self.n_pad - n
+        rsh = NamedSharding(self.mesh, P("data", None))
+        cp, cr = self.codes_pack, self.codes_row
+        if pad:
+            cp = np.pad(cp, ((0, pad), (0, 0)))
+            cr = np.pad(cr, ((0, pad), (0, 0)))
+        self.codes_pack = jax.device_put(jnp.asarray(cp), rsh)
+        self.codes_row = jax.device_put(jnp.asarray(cr), rsh)
+        self._meta = (self.f_numbins, self.f_missing, self.f_default,
+                      self.f_monotone, self.f_penalty, self.f_col,
+                      self.f_base, self.f_elide, self.hist_idx)
+        self._tree_w_fn = None
+
+    # ------------------------------------------------------------------
+    def _grow_statics(self):
+        return dict(c_cols=self.c_cols, item_bits=self.item_bits,
+                    **self._statics())
+
+    def _sharded_tree_fn(self, with_bag_key: bool):
+        """shard_map'd whole-tree program. with_bag_key=True computes the
+        per-shard bag weights inside the program (fused path); False takes
+        an explicit (n_pad,) weight vector (generic path)."""
+        from ..models.device_learner import grow_tree_compact_core
+        statics = self._grow_statics()
+        meta = self._meta
+        cfg = self.config
+        n = self.dataset.num_data
+        local_n = self.local_n
+        bag_on = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+        frac = float(cfg.bagging_fraction)
+
+        def local(cp_l, cr_l, g_l, h_l, w_or_key, base_mask, key):
+            i = jax.lax.axis_index("data")
+            pos = jnp.arange(local_n, dtype=jnp.int32)
+            real = jnp.clip(n - i * local_n, 0, local_n)
+            alive = pos < real
+            if with_bag_key:
+                if bag_on:
+                    # per-shard exact-count bagging over the shard's real
+                    # rows (reference bags each machine's local partition,
+                    # gbdt.cpp:210-276 under num_machines > 1)
+                    u = jnp.where(
+                        alive,
+                        jax.random.uniform(
+                            jax.random.fold_in(w_or_key, i), (local_n,)),
+                        jnp.inf)
+                    k_local = jnp.maximum(
+                        1, (real.astype(jnp.float32) * frac)
+                        .astype(jnp.int32))
+                    cut = jnp.sort(u)[k_local - 1]
+                    # the alive guard matters on an all-padding shard
+                    # (real == 0): u is all-inf there and (u <= cut) would
+                    # otherwise select every pad row
+                    w_l = ((u <= cut) & alive).astype(jnp.float32)
+                else:
+                    w_l = alive.astype(jnp.float32)
+            else:
+                w_l = w_or_key * alive.astype(jnp.float32)
+            return grow_tree_compact_core(
+                cp_l, cr_l, g_l, h_l, w_l, base_mask, *meta, key,
+                axis_name="data", **statics)
+
+        w_spec = P() if with_bag_key else P("data")
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P("data", None), P("data", None), P("data"),
+                      P("data"), w_spec, P(), P()),
+            out_specs=(P(), P("data"), P(), P()), check_vma=False)
+
+    # ------------------------------------------------------------------
+    def train(self, grad: jax.Array, hess: jax.Array,
+              bag_indices: Optional[np.ndarray] = None,
+              iter_seed: int = 0) -> Tree:
+        cfg = self.config
+        n = self.dataset.num_data
+        pad = self.n_pad - n
+        if bag_indices is None:
+            wv = np.ones(self.n_pad, dtype=np.float32)
+            if pad:
+                wv[n:] = 0.0
+            self._bag_mask_host = None
+        else:
+            wv = np.zeros(self.n_pad, dtype=np.float32)
+            wv[bag_indices] = 1.0
+            self._bag_mask_host = wv[:n] > 0
+        rng = np.random.RandomState(
+            (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
+        base_mask = jnp.asarray(self._feature_mask(rng)
+                                & np.asarray(self.f_categorical == 0))
+        key = jax.random.PRNGKey(iter_seed)
+        if self._tree_w_fn is None:
+            fn = self._sharded_tree_fn(with_bag_key=False)
+            nn, npad = n, self.n_pad
+
+            @jax.jit
+            def run(cp, cr, g, h, w, mask, k):
+                g = jnp.pad(g, (0, npad - nn))
+                h = jnp.pad(h, (0, npad - nn))
+                rec, leaf_id, ks, tot = fn(cp, cr, g, h, w, mask, k)
+                return rec, leaf_id[:nn], ks, tot
+            self._tree_w_fn = run
+        rec, leaf_id, n_splits, _ = self._tree_w_fn(
+            self.codes_pack, self.codes_row, grad, hess, jnp.asarray(wv),
+            base_mask, key)
+        self.last_leaf_id = leaf_id
+        self._leaf_id_host = None
+        rec_h, k = jax.device_get((rec, n_splits))
+        k = int(k)
+        if k == 0:
+            log.warning("No further splits with positive gain")
+        return self.replay_tree(rec_h, k)
+
+    # ------------------------------------------------------------------
+    def make_fused_step(self, objective):
+        """Fused sharded boosting iteration (see DeviceTreeLearner
+        .make_fused_step): gradients auto-shard over the score, the tree
+        grows under shard_map with per-split psum, the score update is
+        elementwise over the sharded leaf assignment."""
+        from ..models.device_learner import leaf_values_from_rec
+        n = self.dataset.num_data
+        npad = self.n_pad
+        L = int(self.config.num_leaves)
+        fn = self._sharded_tree_fn(with_bag_key=True)
+
+        @jax.jit
+        def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+            g, h = objective.get_gradients(score_row)
+            g = jnp.pad(g, (0, npad - n))
+            h = jnp.pad(h, (0, npad - n))
+            rec, leaf_id_pad, k, _ = fn(self.codes_pack, self.codes_row,
+                                        g, h, bag_key, base_mask, tree_key)
+            leaf_id = leaf_id_pad[:n]
+            lv = leaf_values_from_rec(rec, k, L)
+            delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
+            return score_row + delta, rec, leaf_id, k
+
+        return step
+
+
 def create_tree_learner(config: Config, dataset: Dataset,
                         mesh: Optional[Mesh] = None):
     """Factory: {serial, feature, data, voting} (reference:
-    src/treelearner/tree_learner.cpp:13-36 CreateTreeLearner)."""
+    src/treelearner/tree_learner.cpp:13-36 CreateTreeLearner). Each mode
+    prefers its whole-tree-on-device variant (the reference composes device
+    x parallelism the same way, tree_learner.cpp:24-33 GPU templates) and
+    falls back to the host-loop learner for unsupported configs."""
+    import os
+    from ..models.device_learner import DeviceTreeLearner
+    host_only = os.environ.get("LGBM_TPU_HOST_LEARNER", "0") == "1"
     name = config.tree_learner
     if name in ("serial",):
-        import os
-        from ..models.device_learner import DeviceTreeLearner
-        if (os.environ.get("LGBM_TPU_HOST_LEARNER", "0") != "1"
-                and DeviceTreeLearner.supports(config, dataset)):
+        if not host_only and DeviceTreeLearner.supports(config, dataset):
             return DeviceTreeLearner(config, dataset)
         return SerialTreeLearner(config, dataset)
     if name in ("feature", "feature_parallel"):
         return FeatureParallelTreeLearner(config, dataset, mesh)
     if name in ("data", "data_parallel"):
+        if not host_only and DeviceTreeLearner.supports(config, dataset):
+            return DeviceDataParallelTreeLearner(config, dataset, mesh)
         return DataParallelTreeLearner(config, dataset, mesh)
     if name in ("voting", "voting_parallel"):
         return VotingParallelTreeLearner(config, dataset, mesh)

@@ -11,8 +11,14 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-# tests are small; persistent cache churn is not worth it
-os.environ.setdefault("LGBM_TPU_NO_COMP_CACHE", "1")
+# Persistent XLA compile cache: the suite's wall clock is dominated by
+# recompiles of the tree-growth programs (one per shape/config family);
+# warm runs cut it several-fold. Point it at a repo-local dir so CI can
+# cache the directory across runs too.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".xla_cache"))
 
 import jax
 

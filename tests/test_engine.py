@@ -335,3 +335,67 @@ def test_refit():
     new_bst = bst.refit(x2, y2)
     assert new_bst.num_trees() == bst.num_trees()
     assert _auc(y2, new_bst.predict(x2)) > 0.8
+
+
+def test_device_strategies_agree_exactly():
+    """masked vs compact whole-tree strategies must produce identical
+    models without bagging (same histograms, same scans; host-oracle
+    pattern of the reference's GPU_DEBUG_COMPARE)."""
+    import os
+    import lightgbm_tpu as lgb
+    r = np.random.RandomState(9)
+    x = r.randn(3000, 7).astype(np.float32)
+    x[r.rand(3000, 7) < 0.1] = np.nan
+    y = (np.nan_to_num(x[:, 0]) + 0.5 * np.nan_to_num(x[:, 1]) > 0).astype(float)
+
+    def run(strategy):
+        os.environ["LGBM_TPU_STRATEGY"] = strategy
+        try:
+            b = lgb.Booster(
+                params={"objective": "binary", "num_leaves": 31,
+                        "verbosity": -1, "min_data_in_leaf": 5},
+                train_set=lgb.Dataset(x, y))
+            for _ in range(4):
+                b.update()
+            return b
+        finally:
+            os.environ.pop("LGBM_TPU_STRATEGY", None)
+
+    bm, bc = run("masked"), run("compact")
+    for tm, tc in zip(bm._gbdt.models, bc._gbdt.models):
+        assert tm.num_leaves == tc.num_leaves
+        for i in range(tm.num_leaves - 1):
+            assert int(tm.split_feature[i]) == int(tc.split_feature[i])
+            assert int(tm.threshold_in_bin[i]) == int(tc.threshold_in_bin[i])
+    np.testing.assert_allclose(
+        bm.predict(x[:300], raw_score=True),
+        bc.predict(x[:300], raw_score=True), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_iteration_matches_generic_path():
+    """The single-program fused device iteration must equal the generic
+    (multi-dispatch) path tree-for-tree."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models import gbdt as gbdt_mod
+    r = np.random.RandomState(4)
+    x = r.randn(3000, 6).astype(np.float32)
+    y = (x[:, 0] + 0.4 * x[:, 1] ** 2 + r.randn(3000) * 0.4 > 0.2).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 10}
+
+    b1 = lgb.Booster(params=params, train_set=lgb.Dataset(x, y))
+    for _ in range(4):
+        b1.update()
+    assert b1._gbdt._fused_step is not None, "fused path not taken"
+
+    orig = gbdt_mod.GBDT._fused_eligible
+    gbdt_mod.GBDT._fused_eligible = lambda self: False
+    try:
+        b2 = lgb.Booster(params=params, train_set=lgb.Dataset(x, y))
+        for _ in range(4):
+            b2.update()
+    finally:
+        gbdt_mod.GBDT._fused_eligible = orig
+    np.testing.assert_allclose(
+        b1.predict(x[:500], raw_score=True),
+        b2.predict(x[:500], raw_score=True), rtol=1e-5, atol=1e-6)

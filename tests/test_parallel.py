@@ -1,9 +1,14 @@
 """Distributed learner tests on the virtual 8-device CPU mesh.
 
 Mirrors what the reference leaves untested (SURVEY.md §4: no automated
-distributed tests) and does better: every parallel mode must agree with the
-serial learner on the same data (the parallel modes are exact algorithms,
-not approximations — except voting, which is validated by quality)."""
+distributed tests) and does better: data- and feature-parallel are EXACT
+algorithms modulo floating-point reduction order, so they must agree with
+the serial learner tree-for-tree (feature, counts, gain per node; the bin
+threshold may legally differ only within an equal-gain plateau — empty
+bins give several cut points the identical partition, and psum rounding
+can pick a different one than the serial sum order, exactly as the
+reference's ReduceScatter would). Voting is validated by quality.
+"""
 import numpy as np
 import pytest
 
@@ -38,33 +43,83 @@ def _train(x, y, tree_learner, rounds=8, **extra):
     return b
 
 
+def assert_trees_structurally_equal(bs, bo, n_trees, what):
+    """Tree-for-tree structural equality: same split feature, same child
+    counts, same gain (1e-4 rel) at every node; thresholds equal except
+    inside an equal-gain plateau (see module docstring)."""
+    assert len(bo.models) >= n_trees and len(bs.models) >= n_trees
+    for ti in range(n_trees):
+        ts, to = bs.models[ti], bo.models[ti]
+        assert ts.num_leaves == to.num_leaves, (what, ti)
+        for i in range(ts.num_leaves - 1):
+            assert int(ts.split_feature[i]) == int(to.split_feature[i]), \
+                (what, ti, i)
+            assert int(ts.internal_count[i]) == int(to.internal_count[i]), \
+                (what, ti, i)
+            gs, go = float(ts.split_gain[i]), float(to.split_gain[i])
+            assert abs(gs - go) <= 1e-4 * max(1.0, abs(gs)), (what, ti, i)
+            if int(ts.threshold_in_bin[i]) != int(to.threshold_in_bin[i]):
+                # allowed only on an equal-gain plateau (empty bins give
+                # several cut points the identical partition); demand the
+                # gains match far tighter than the general tolerance AND
+                # the partition is provably the same (counts checked above)
+                assert abs(gs - go) <= 1e-6 * max(1.0, abs(gs)), \
+                    (what, ti, i, "threshold differs with different gain")
+
+
 def test_devices_available():
     assert len(jax.devices()) == 8
 
 
-def test_data_parallel_matches_serial():
+def test_data_parallel_matches_serial_structurally():
     x, y = make_binary(1600, 8)
     bs = _train(x, y, "serial")
     bd = _train(x, y, "data")
-    ps = bs.predict(x, raw_score=True)
-    pd = bd.predict(x, raw_score=True)
-    # same algorithm, different reduction order -> near-identical trees
-    np.testing.assert_allclose(ps, pd, rtol=2e-2, atol=2e-2)
-    # structural agreement on the first tree's root split
-    t_s, t_d = bs.models[0], bd.models[0]
-    assert t_s.split_feature[0] == t_d.split_feature[0]
-    assert t_s.threshold_in_bin[0] == t_d.threshold_in_bin[0]
+    assert_trees_structurally_equal(bs, bd, 8, "data-parallel")
+    np.testing.assert_allclose(bs.predict(x, raw_score=True),
+                               bd.predict(x, raw_score=True),
+                               rtol=1e-3, atol=1e-4)
 
 
-def test_feature_parallel_matches_serial():
+def test_data_parallel_uses_device_learner():
+    from lightgbm_tpu.parallel.learners import DeviceDataParallelTreeLearner
+    x, y = make_binary(1000, 6)
+    bd = _train(x, y, "data", rounds=1)
+    assert isinstance(bd.learner, DeviceDataParallelTreeLearner)
+
+
+def test_data_parallel_host_learner_matches_serial():
+    """The host-loop fallback DP learner (categoricals etc.) stays exact."""
+    import os
+    os.environ["LGBM_TPU_HOST_LEARNER"] = "1"
+    try:
+        x, y = make_binary(1200, 8)
+        bs = _train(x, y, "serial", rounds=5)
+        bd = _train(x, y, "data", rounds=5)
+    finally:
+        os.environ.pop("LGBM_TPU_HOST_LEARNER", None)
+    assert_trees_structurally_equal(bs, bd, 5, "host-dp")
+
+
+def test_feature_parallel_matches_serial_structurally():
     x, y = make_binary(1200, 10)
-    bs = _train(x, y, "serial")
-    bf = _train(x, y, "feature")
-    ps = bs.predict(x, raw_score=True)
-    pf = bf.predict(x, raw_score=True)
-    np.testing.assert_allclose(ps, pf, rtol=2e-2, atol=2e-2)
-    t_s, t_f = bs.models[0], bf.models[0]
-    assert t_s.split_feature[0] == t_f.split_feature[0]
+    bs = _train(x, y, "serial", rounds=5)
+    bf = _train(x, y, "feature", rounds=5)
+    assert_trees_structurally_equal(bs, bf, 5, "feature-parallel")
+    np.testing.assert_allclose(bs.predict(x, raw_score=True),
+                               bf.predict(x, raw_score=True),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_feature_parallel_binned_matrix_is_sharded():
+    """The feature-parallel mode only earns its name if the binned matrix
+    actually stays partitioned across devices (VERDICT r1 weak #4)."""
+    x, y = make_binary(800, 16)
+    bf = _train(x, y, "feature", rounds=1)
+    shardings = {d.device for d in bf.learner.binned.addressable_shards}
+    assert len(shardings) == 8, "binned matrix not spread over the mesh"
+    shard_cols = {s.data.shape[1] for s in bf.learner.binned.addressable_shards}
+    assert shard_cols == {2}, f"expected 2 features per shard, {shard_cols}"
 
 
 def test_voting_parallel_quality():
@@ -80,16 +135,36 @@ def test_data_parallel_with_bagging():
     assert _auc(y, bd.predict(x, raw_score=True)) > 0.9
 
 
-def test_data_parallel_leaf_counts_exact():
-    """Global leaf counts across shards must sum to the bagged row count."""
-    x, y = make_binary(1000, 6)
+def test_data_parallel_no_per_split_host_sync():
+    """The device DP learner must run a whole tree as one program: the
+    number of device executions per training iteration stays O(1), not
+    O(num_leaves) (VERDICT r1 weak #6)."""
+    x, y = make_binary(1200, 6)
     params = {"objective": "binary", "tree_learner": "data",
-              "verbosity": -1, "num_leaves": 8}
+              "verbosity": -1, "num_leaves": 31, "min_data_in_leaf": 2}
     cfg = Config(params)
     ds = InnerDataset(x, config=cfg, label=y)
     b = create_boosting(cfg, ds)
+    b.train_one_iter()          # compile + warm
+
+    fused = b._fused_step
+    calls = {"n": 0}
+
+    def wrapped(*a, **k):
+        calls["n"] += 1
+        return fused(*a, **k)
+    b._fused_step = wrapped
     b.train_one_iter()
-    learner = b.learner
-    total = sum(int(c.sum()) for leaf, c in learner._leaf_count.items()
-                if leaf in learner.leaves)
-    assert total == 1000
+    assert calls["n"] == 1, "fused DP step must run exactly once per iter"
+
+
+def test_data_parallel_empty_shard_bagging():
+    """A shard that holds only padding rows must contribute nothing to the
+    histograms (regression: the exact-count bag sampler used to select all
+    pad rows on an empty shard)."""
+    x, y = make_binary(49, 4)
+    bd = _train(x, y, "data", rounds=3, num_leaves=4, min_data_in_leaf=2,
+                bagging_fraction=0.8, bagging_freq=1)
+    t = bd.models[0]
+    assert t.num_leaves > 1
+    assert int(t.internal_count[0]) <= 49
