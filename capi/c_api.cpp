@@ -18,6 +18,7 @@
 #include <cstdio>
 #include <cstring>
 #include <cstdarg>
+#include <functional>
 #include <mutex>
 #include <string>
 #include <vector>
@@ -494,6 +495,502 @@ LGBM_API int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out) {
   return 0;
 }
 
+namespace {
+
+// Copy a Python bytes result (float64 array) into out_result/out_len.
+int BytesToDoubles(PyObject* r, int64_t* out_len, double* out_result) {
+  if (!r) return -1;
+  char* buf;
+  Py_ssize_t nbytes;
+  if (PyBytes_AsStringAndSize(r, &buf, &nbytes) != 0) {
+    Py_DECREF(r);
+    CheckPyErr();
+    return -1;
+  }
+  std::memcpy(out_result, buf, nbytes);
+  *out_len = nbytes / 8;
+  Py_DECREF(r);
+  return 0;
+}
+
+Py_ssize_t DtypeSize(int t) { return (t == 0 || t == 2) ? 4 : 8; }
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Extended dataset constructors (reference: c_api.h:62-380)
+// ---------------------------------------------------------------------------
+
+LGBM_API int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t ncol_ptr, int64_t nelem,
+                                       int64_t num_row,
+                                       const char* parameters,
+                                       const DatasetHandle reference,
+                                       DatasetHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(NiNNiLLLsL)", MemView(col_ptr, ncol_ptr * DtypeSize(col_ptr_type)),
+      col_ptr_type, MemView(indices, nelem * 4),
+      MemView(data, nelem * DtypeSize(data_type)), data_type,
+      (long long)ncol_ptr, (long long)nelem, (long long)num_row,
+      parameters ? parameters : "", (long long)(intptr_t)reference);
+  PyObject* r = Call("dataset_create_from_csc", args);
+  if (!r) return -1;
+  *out = (DatasetHandle)(intptr_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                                        int data_type, int32_t* nrow,
+                                        int32_t ncol, int is_row_major,
+                                        const char* parameters,
+                                        const DatasetHandle reference,
+                                        DatasetHandle* out) {
+  Gil gil;
+  PyObject* mats = PyList_New(nmat);
+  PyObject* rows = PyList_New(nmat);
+  for (int32_t i = 0; i < nmat; ++i) {
+    PyList_SetItem(mats, i, MemView(data[i], (Py_ssize_t)nrow[i] * ncol *
+                                                 DtypeSize(data_type)));
+    PyList_SetItem(rows, i, PyLong_FromLong(nrow[i]));
+  }
+  PyObject* args = Py_BuildValue("(NiNiisL)", mats, data_type, rows, ncol,
+                                 is_row_major, parameters ? parameters : "",
+                                 (long long)(intptr_t)reference);
+  PyObject* r = Call("dataset_create_from_mats", args);
+  if (!r) return -1;
+  *out = (DatasetHandle)(intptr_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// The funptr is a std::function<void(int, std::vector<std::pair<int,double>>&)>*
+// (reference c_api.cpp RowFunctionFromCSRFunc usage) — call it row by row to
+// densify, then construct normally.
+LGBM_API int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                           int64_t num_col,
+                                           const char* parameters,
+                                           const DatasetHandle reference,
+                                           DatasetHandle* out) {
+  using RowFn =
+      std::function<void(int, std::vector<std::pair<int, double>>&)>;
+  auto* fn = reinterpret_cast<RowFn*>(get_row_funptr);
+  std::vector<double> dense((size_t)num_rows * num_col, 0.0);
+  std::vector<std::pair<int, double>> row;
+  for (int i = 0; i < num_rows; ++i) {
+    row.clear();
+    (*fn)(i, row);
+    for (auto& kv : row) {
+      if (kv.first >= 0 && kv.first < num_col)
+        dense[(size_t)i * num_col + kv.first] = kv.second;
+    }
+  }
+  return LGBM_DatasetCreateFromMat(dense.data(), /*data_type=*/1, num_rows,
+                                   (int32_t)num_col, /*is_row_major=*/1,
+                                   parameters, reference, out);
+}
+
+LGBM_API int LGBM_DatasetCreateFromSampledColumn(
+    double** sample_data, int** sample_indices, int32_t ncol,
+    const int* num_per_col, int32_t num_sample_row, int32_t num_total_row,
+    const char* parameters, DatasetHandle* out) {
+  (void)sample_data;
+  (void)sample_indices;
+  (void)num_per_col;
+  (void)num_sample_row;
+  Gil gil;
+  // bin mappers are fit lazily from the full pushed data (superset of the
+  // reference's sample-based FindBin)
+  PyObject* r = Call("dataset_create_from_sampled_column",
+                     Py_BuildValue("(iis)", num_total_row, ncol,
+                                   parameters ? parameters : ""));
+  if (!r) return -1;
+  *out = (DatasetHandle)(intptr_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                           int64_t num_total_row,
+                                           DatasetHandle* out) {
+  Gil gil;
+  PyObject* r = Call("dataset_create_by_reference",
+                     Py_BuildValue("(LL)", (long long)(intptr_t)reference,
+                                   (long long)num_total_row));
+  if (!r) return -1;
+  *out = (DatasetHandle)(intptr_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                                  int data_type, int32_t nrow, int32_t ncol,
+                                  int32_t start_row) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNiiii)", (long long)(intptr_t)dataset,
+      MemView(data, (Py_ssize_t)nrow * ncol * DtypeSize(data_type)),
+      data_type, nrow, ncol, start_row);
+  PyObject* r = Call("dataset_push_rows", args);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset,
+                                       const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int64_t start_row) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNiNNiLLLi)", (long long)(intptr_t)dataset,
+      MemView(indptr, nindptr * DtypeSize(indptr_type)), indptr_type,
+      MemView(indices, nelem * 4),
+      MemView(data, nelem * DtypeSize(data_type)), data_type,
+      (long long)nindptr, (long long)nelem, (long long)num_col,
+      (int)start_row);
+  PyObject* r = Call("dataset_push_rows_by_csr", args);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                                   const int32_t* used_row_indices,
+                                   int32_t num_used_row_indices,
+                                   const char* parameters,
+                                   DatasetHandle* out) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNis)", (long long)(intptr_t)handle,
+      MemView(used_row_indices, (Py_ssize_t)num_used_row_indices * 4),
+      num_used_row_indices, parameters ? parameters : "");
+  PyObject* r = Call("dataset_get_subset", args);
+  if (!r) return -1;
+  *out = (DatasetHandle)(intptr_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetSaveBinary(DatasetHandle handle,
+                                    const char* filename) {
+  return CallVoidV("dataset_save_binary", "(Ls)",
+                   (long long)(intptr_t)handle, filename);
+}
+
+LGBM_API int LGBM_DatasetDumpText(DatasetHandle handle,
+                                  const char* filename) {
+  return CallVoidV("dataset_dump_text", "(Ls)", (long long)(intptr_t)handle,
+                   filename);
+}
+
+LGBM_API int LGBM_DatasetUpdateParam(DatasetHandle handle,
+                                     const char* parameters) {
+  return CallVoidV("dataset_update_param", "(Ls)",
+                   (long long)(intptr_t)handle, parameters ? parameters : "");
+}
+
+LGBM_API int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                         const char** feature_names,
+                                         int num_feature_names) {
+  Gil gil;
+  PyObject* names = PyList_New(num_feature_names);
+  for (int i = 0; i < num_feature_names; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(feature_names[i]));
+  }
+  PyObject* r = Call("dataset_set_feature_names",
+                     Py_BuildValue("(LN)", (long long)(intptr_t)handle,
+                                   names));
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
+                                         char** feature_names, int* num) {
+  Gil gil;
+  PyObject* r = Call("dataset_get_feature_names",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    std::snprintf(feature_names[i], 128, "%s", s ? s : "");
+  }
+  *num = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
+                                         DatasetHandle source) {
+  return CallVoidV("dataset_add_features_from", "(LL)",
+                   (long long)(intptr_t)target, (long long)(intptr_t)source);
+}
+
+// ---------------------------------------------------------------------------
+// Extended booster entry points (reference: c_api.h:427-1018)
+// ---------------------------------------------------------------------------
+
+LGBM_API int LGBM_BoosterMerge(BoosterHandle handle,
+                               BoosterHandle other_handle) {
+  return CallVoidV("booster_merge", "(LL)", (long long)(intptr_t)handle,
+                   (long long)(intptr_t)other_handle);
+}
+
+LGBM_API int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                                       int end_iter) {
+  return CallVoidV("booster_shuffle_models", "(Lii)",
+                   (long long)(intptr_t)handle, start_iter, end_iter);
+}
+
+LGBM_API int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                           const DatasetHandle train_data) {
+  return CallVoidV("booster_reset_training_data", "(LL)",
+                   (long long)(intptr_t)handle,
+                   (long long)(intptr_t)train_data);
+}
+
+LGBM_API int LGBM_BoosterResetParameter(BoosterHandle handle,
+                                        const char* parameters) {
+  return CallVoidV("booster_reset_parameter", "(Ls)",
+                   (long long)(intptr_t)handle, parameters ? parameters : "");
+}
+
+LGBM_API int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                               int32_t nrow, int32_t ncol) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNii)", (long long)(intptr_t)handle,
+      MemView(leaf_preds, (Py_ssize_t)nrow * ncol * 4), nrow, ncol);
+  PyObject* r = Call("booster_refit", args);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                              int* out_tree_per_iteration) {
+  Gil gil;
+  PyObject* r = Call("booster_num_model_per_iteration",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  *out_tree_per_iteration = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle,
+                                            int* out_models) {
+  Gil gil;
+  PyObject* r = Call("booster_number_of_total_model",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  *out_models = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                         char** out_strs) {
+  Gil gil;
+  PyObject* r = Call("booster_get_feature_names",
+                     Py_BuildValue("(L)", (long long)(intptr_t)handle));
+  if (!r) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    std::snprintf(out_strs[i], 128, "%s", s ? s : "");
+  }
+  *out_len = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                                      int leaf_idx, double* out_val) {
+  Gil gil;
+  PyObject* r = Call("booster_get_leaf_value",
+                     Py_BuildValue("(Lii)", (long long)(intptr_t)handle,
+                                   tree_idx, leaf_idx));
+  if (!r) return -1;
+  *out_val = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                                      int leaf_idx, double val) {
+  return CallVoidV("booster_set_leaf_value", "(Liid)",
+                   (long long)(intptr_t)handle, tree_idx, leaf_idx, val);
+}
+
+LGBM_API int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                                       int64_t* out_len) {
+  Gil gil;
+  PyObject* r = Call("booster_get_num_predict",
+                     Py_BuildValue("(Li)", (long long)(intptr_t)handle,
+                                   data_idx));
+  if (!r) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                                    int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* r = Call("booster_get_predict",
+                     Py_BuildValue("(Li)", (long long)(intptr_t)handle,
+                                   data_idx));
+  return BytesToDoubles(r, out_len, out_result);
+}
+
+LGBM_API int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                                        int predict_type, int num_iteration,
+                                        int64_t* out_len) {
+  Gil gil;
+  PyObject* r = Call("booster_calc_num_predict",
+                     Py_BuildValue("(Liii)", (long long)(intptr_t)handle,
+                                   num_row, predict_type, num_iteration));
+  if (!r) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                                        const char* data_filename,
+                                        int data_has_header, int predict_type,
+                                        int num_iteration,
+                                        const char* parameter,
+                                        const char* result_filename) {
+  return CallVoidV("booster_predict_for_file", "(Lsiiiss)",
+                   (long long)(intptr_t)handle, data_filename,
+                   data_has_header, predict_type, num_iteration,
+                   parameter ? parameter : "", result_filename);
+}
+
+LGBM_API int LGBM_BoosterPredictForCSR(BoosterHandle handle,
+                                       const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNiNNiLLLiis)", (long long)(intptr_t)handle,
+      MemView(indptr, nindptr * DtypeSize(indptr_type)), indptr_type,
+      MemView(indices, nelem * 4),
+      MemView(data, nelem * DtypeSize(data_type)), data_type,
+      (long long)nindptr, (long long)nelem, (long long)num_col, predict_type,
+      num_iteration, parameter ? parameter : "");
+  return BytesToDoubles(Call("booster_predict_for_csr", args), out_len,
+                        out_result);
+}
+
+LGBM_API int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNiNNiLLLiis)", (long long)(intptr_t)handle,
+      MemView(indptr, nindptr * DtypeSize(indptr_type)), indptr_type,
+      MemView(indices, nelem * 4),
+      MemView(data, nelem * DtypeSize(data_type)), data_type,
+      (long long)nindptr, (long long)nelem, (long long)num_col, predict_type,
+      num_iteration, parameter ? parameter : "");
+  return BytesToDoubles(Call("booster_predict_for_csr_single_row", args),
+                        out_len, out_result);
+}
+
+LGBM_API int LGBM_BoosterPredictForCSC(BoosterHandle handle,
+                                       const void* col_ptr, int col_ptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t ncol_ptr, int64_t nelem,
+                                       int64_t num_row, int predict_type,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNiNNiLLLiis)", (long long)(intptr_t)handle,
+      MemView(col_ptr, ncol_ptr * DtypeSize(col_ptr_type)), col_ptr_type,
+      MemView(indices, nelem * 4),
+      MemView(data, nelem * DtypeSize(data_type)), data_type,
+      (long long)ncol_ptr, (long long)nelem, (long long)num_row, predict_type,
+      num_iteration, parameter ? parameter : "");
+  return BytesToDoubles(Call("booster_predict_for_csc", args), out_len,
+                        out_result);
+}
+
+LGBM_API int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNiiiiis)", (long long)(intptr_t)handle,
+      MemView(data, (Py_ssize_t)ncol * DtypeSize(data_type)), data_type,
+      ncol, is_row_major, predict_type, num_iteration,
+      parameter ? parameter : "");
+  return BytesToDoubles(Call("booster_predict_for_mat_single_row", args),
+                        out_len, out_result);
+}
+
+LGBM_API int LGBM_BoosterPredictForMats(BoosterHandle handle,
+                                        const void** data, int data_type,
+                                        int32_t nrow, int32_t ncol,
+                                        int predict_type, int num_iteration,
+                                        const char* parameter,
+                                        int64_t* out_len,
+                                        double* out_result) {
+  // array of nrow row-pointers -> one contiguous buffer
+  Py_ssize_t isz = DtypeSize(data_type);
+  std::vector<char> dense((size_t)nrow * ncol * isz);
+  for (int32_t i = 0; i < nrow; ++i) {
+    std::memcpy(dense.data() + (size_t)i * ncol * isz, data[i],
+                (size_t)ncol * isz);
+  }
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNiiiiiis)", (long long)(intptr_t)handle,
+      MemView(dense.data(), (Py_ssize_t)nrow * ncol * isz), data_type, nrow,
+      ncol, /*is_row_major=*/1, predict_type, num_iteration,
+      parameter ? parameter : "");
+  PyObject* r = Call("booster_predict_for_mat", args);
+  return BytesToDoubles(r, out_len, out_result);
+}
+
+LGBM_API int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                                   int num_iteration, int64_t buffer_len,
+                                   int64_t* out_len, char* out_str) {
+  Gil gil;
+  PyObject* r = Call("booster_dump_model",
+                     Py_BuildValue("(Lii)", (long long)(intptr_t)handle,
+                                   start_iteration, num_iteration));
+  if (!r) return -1;
+  Py_ssize_t n;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &n);
+  *out_len = n + 1;
+  if (buffer_len >= n + 1) {
+    std::memcpy(out_str, s, n + 1);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
 // ---------------------------------------------------------------------------
 // Network
 // ---------------------------------------------------------------------------
@@ -502,6 +999,16 @@ LGBM_API int LGBM_NetworkInit(const char* machines, int local_listen_port,
                               int listen_time_out, int num_machines) {
   return CallVoidV("network_init", "(siii)", machines, local_listen_port,
                    listen_time_out, num_machines);
+}
+
+// The injected host collectives are not used by the XLA-collective backend;
+// identity is recorded (see capi_impl.network_init_with_functions)
+LGBM_API int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                           void* reduce_scatter_ext_fun,
+                                           void* allgather_ext_fun) {
+  (void)reduce_scatter_ext_fun;
+  (void)allgather_ext_fun;
+  return CallVoidV("network_init_with_functions", "(ii)", num_machines, rank);
 }
 
 LGBM_API int LGBM_NetworkFree() {

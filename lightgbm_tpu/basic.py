@@ -217,6 +217,31 @@ class Dataset:
         self._inner.save_binary(filename)
         return self
 
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Column-concatenate another dataset at the binned level
+        (reference: Dataset::addFeaturesFrom, src/io/dataset.cpp merges
+        feature groups without re-binning); EFB bundles are re-planned
+        over the combined features."""
+        self.construct()
+        other.construct()
+        if self.num_data() != other.num_data():
+            raise ValueError("datasets must have the same number of rows")
+        a, b = self._inner, other._inner
+        offset = a.num_total_features
+        a.bin_mappers = list(a.bin_mappers) + list(b.bin_mappers)
+        a.used_features = list(a.used_features) + [
+            offset + f for f in b.used_features]
+        a.max_num_bins = max(a.max_num_bins, b.max_num_bins)
+        dt = (np.uint16 if max(a.binned.dtype.itemsize,
+                               b.binned.dtype.itemsize) == 2 else np.uint8)
+        a.binned = np.hstack([a.binned.astype(dt), b.binned.astype(dt)])
+        a.num_total_features += b.num_total_features
+        a.feature_names = list(a.feature_names) + list(b.feature_names)
+        a.columns = a._plan_bundles()
+        a.bundled = a._encode_bundles() if a.columns else None
+        a._device_cache = {}
+        return self
+
     def set_categorical_feature(self, categorical_feature) -> "Dataset":
         self.categorical_feature = categorical_feature
         return self

@@ -62,14 +62,8 @@ def dataset_create_from_mat(mv, data_type, nrow, ncol, is_row_major,
 def dataset_create_from_csr(indptr_mv, indptr_type, indices_mv, data_mv,
                             data_type, nindptr, nelem, num_col, parameters,
                             reference):
-    indptr = np.frombuffer(indptr_mv, dtype=C_DTYPE[indptr_type])[:nindptr]
-    indices = np.frombuffer(indices_mv, dtype=np.int32)[:nelem]
-    data = np.frombuffer(data_mv, dtype=C_DTYPE[data_type])[:nelem]
-    nrow = nindptr - 1
-    mat = np.zeros((nrow, num_col))
-    for i in range(nrow):
-        lo, hi = indptr[i], indptr[i + 1]
-        mat[i, indices[lo:hi]] = data[lo:hi]
+    mat = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
+                        data_type, nindptr, nelem, num_col)
     params = parse_config_str(parameters or "")
     ref = _get(reference) if reference else None
     ds = Dataset(mat, reference=ref, params=params)
@@ -120,9 +114,16 @@ def dataset_get_field(h, field_name):
 # Booster
 # ---------------------------------------------------------------------------
 
+def _as_dataset(obj):
+    """Materialize streaming datasets into real Dataset instances."""
+    if isinstance(obj, _StreamingDataset):
+        return obj._materialize()
+    return obj
+
+
 def booster_create(train_h, parameters):
     params = parse_config_str(parameters or "")
-    bst = Booster(params=params, train_set=_get(train_h))
+    bst = Booster(params=params, train_set=_as_dataset(_get(train_h)))
     return _new_handle(bst)
 
 
@@ -257,3 +258,411 @@ def network_init(machines, local_listen_port, listen_time_out, num_machines):
 def network_free():
     from .parallel import network
     network.free()
+
+
+# ---------------------------------------------------------------------------
+# Extended dataset constructors (reference: src/c_api.cpp dataset section)
+# ---------------------------------------------------------------------------
+
+def dataset_create_from_csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                            data_type, ncol_ptr, nelem, num_row, parameters,
+                            reference):
+    """reference: LGBM_DatasetCreateFromCSC (c_api.h:191)."""
+    col_ptr = np.frombuffer(col_ptr_mv, dtype=C_DTYPE[col_ptr_type])[:ncol_ptr]
+    indices = np.frombuffer(indices_mv, dtype=np.int32)[:nelem]
+    data = np.frombuffer(data_mv, dtype=C_DTYPE[data_type])[:nelem]
+    ncol = ncol_ptr - 1
+    mat = np.zeros((num_row, ncol))
+    for j in range(ncol):
+        lo, hi = int(col_ptr[j]), int(col_ptr[j + 1])
+        mat[indices[lo:hi], j] = data[lo:hi]
+    params = parse_config_str(parameters or "")
+    ref = _get(reference) if reference else None
+    ds = Dataset(mat, reference=ref, params=params)
+    ds.construct()
+    return _new_handle(ds)
+
+
+def dataset_create_from_mats(mats, data_type, nrows, ncol, is_row_major,
+                             parameters, reference):
+    """reference: LGBM_DatasetCreateFromMats — vertically stacked chunks."""
+    parts = []
+    for mv, nrow in zip(mats, nrows):
+        arr = np.frombuffer(mv, dtype=C_DTYPE[data_type])
+        parts.append(arr.reshape(nrow, ncol) if is_row_major
+                     else arr.reshape(ncol, nrow).T)
+    params = parse_config_str(parameters or "")
+    ref = _get(reference) if reference else None
+    ds = Dataset(np.vstack(parts).astype(np.float64), reference=ref,
+                 params=params)
+    ds.construct()
+    return _new_handle(ds)
+
+
+class _StreamingDataset:
+    """Pre-allocated dataset filled by PushRows (reference streaming path:
+    LGBM_DatasetCreateFromSampledColumn / CreateByReference + PushRows,
+    c_api.cpp). Constructs lazily on first real use; fields set before or
+    between pushes are buffered and re-applied on every materialization
+    (the reference allows SetField and PushRows in any order)."""
+
+    def __init__(self, num_row: int, num_col: int, params: str,
+                 reference=None):
+        self.buf = np.zeros((num_row, num_col), dtype=np.float64)
+        self.params = parse_config_str(params or "")
+        self.reference = reference
+        self.filled = 0
+        self._ds = None
+        self._pending_fields: Dict[str, np.ndarray] = {}
+
+    def push_rows(self, arr: np.ndarray, start_row: int) -> None:
+        self.buf[start_row:start_row + arr.shape[0], :] = arr
+        self.filled = max(self.filled, start_row + arr.shape[0])
+        self._ds = None
+
+    def set_field(self, name, data):
+        self._pending_fields[name] = np.asarray(data)
+        if self._ds is not None:
+            self._ds.set_field(name, data)
+        return self
+
+    def set_group(self, data):
+        self._pending_fields["group"] = np.asarray(data)
+        if self._ds is not None:
+            self._ds.set_group(data)
+        return self
+
+    def _materialize(self) -> Dataset:
+        if self._ds is None:
+            ds = Dataset(self.buf, reference=self.reference,
+                         params=self.params)
+            for name, data in self._pending_fields.items():
+                if name == "group":
+                    ds.set_group(data)
+                else:
+                    ds.set_field(name, data)
+            ds.construct()
+            self._ds = ds
+        return self._ds
+
+    # duck-typed Dataset surface used by the other entry points
+    def construct(self):
+        return self._materialize().construct()
+
+    def __getattr__(self, name):
+        return getattr(self._materialize(), name)
+
+
+def dataset_create_from_sampled_column(num_row, num_col, parameters):
+    return _new_handle(_StreamingDataset(num_row, num_col, parameters))
+
+
+def dataset_create_by_reference(ref_h, num_row):
+    ref = _get(ref_h)
+    return _new_handle(_StreamingDataset(
+        num_row, ref.num_feature(), "", reference=ref))
+
+
+def dataset_push_rows(h, mv, data_type, nrow, ncol, start_row):
+    ds = _get(h)
+    arr = np.frombuffer(mv, dtype=C_DTYPE[data_type]).reshape(nrow, ncol)
+    if not isinstance(ds, _StreamingDataset):
+        raise ValueError("PushRows requires a dataset created by "
+                         "CreateFromSampledColumn/CreateByReference")
+    ds.push_rows(np.asarray(arr, dtype=np.float64), start_row)
+    return 0
+
+
+def dataset_push_rows_by_csr(h, indptr_mv, indptr_type, indices_mv, data_mv,
+                             data_type, nindptr, nelem, num_col, start_row):
+    ds = _get(h)
+    mat = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
+                        data_type, nindptr, nelem, num_col)
+    if not isinstance(ds, _StreamingDataset):
+        raise ValueError("PushRowsByCSR requires a streaming dataset")
+    ds.push_rows(mat, start_row)
+    return 0
+
+
+def dataset_get_subset(h, indices_mv, num_indices, parameters):
+    ds = _as_dataset(_get(h))
+    idx = np.frombuffer(indices_mv, dtype=np.int32)[:num_indices]
+    sub = ds.subset(idx.astype(np.int64),
+                    parse_config_str(parameters or "") or None)
+    sub.construct()
+    return _new_handle(sub)
+
+
+def dataset_save_binary(h, filename):
+    _get(h).save_binary(filename)
+    return 0
+
+
+def dataset_dump_text(h, filename):
+    ds = _get(h)
+    ds.construct()
+    inner = ds._inner
+    with open(filename, "w") as fh:
+        fh.write("num_data: %d\n" % inner.num_data)
+        fh.write("num_feature: %d\n" % inner.num_features)
+        for fi, m in enumerate(inner.bin_mappers):
+            fh.write("feature %d: num_bin=%d missing=%d\n"
+                     % (fi, m.num_bin, m.missing_type))
+        binned = np.asarray(inner.binned)
+        for i in range(min(inner.num_data, 1000)):
+            fh.write(" ".join(str(int(v)) for v in binned[i]) + "\n")
+    return 0
+
+
+def dataset_set_feature_names(h, names):
+    ds = _get(h)
+    names = list(names)
+    ds.set_feature_name(names)
+    # C-API datasets are already constructed; rename in place
+    inner = getattr(ds, "_inner", None)
+    if inner is not None:
+        inner.feature_names = list(names)
+    return 0
+
+
+def dataset_get_feature_names(h):
+    return [str(n) for n in _get(h).get_feature_name()]
+
+
+def dataset_update_param(h, parameters):
+    ds = _get(h)
+    ds._update_params(parse_config_str(parameters or ""))
+    return 0
+
+
+def dataset_add_features_from(h, other_h):
+    ds, other = _as_dataset(_get(h)), _as_dataset(_get(other_h))
+    ds.construct()
+    other.construct()
+    ds.add_features_from(other)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Extended booster entry points
+# ---------------------------------------------------------------------------
+
+def booster_merge(h, other_h):
+    """reference: LGBM_BoosterMerge (c_api.h:437) — append the other
+    booster's models."""
+    import copy as _copy
+    bst, other = _get(h), _get(other_h)
+    bst._gbdt.models.extend(_copy.deepcopy(t) for t in other._gbdt.models)
+    return 0
+
+
+def booster_reset_parameter(h, parameters):
+    _get(h).reset_parameter(parse_config_str(parameters or ""))
+    return 0
+
+
+def booster_reset_training_data(h, train_h):
+    """reference: LGBM_BoosterResetTrainingData — swap the train set,
+    keeping the model."""
+    bst = _get(h)
+    new_set = _as_dataset(_get(train_h))
+    new_set.construct()
+    old = bst._gbdt
+    import copy as _copy
+    from .models.gbdt import create_boosting
+    cfg = _copy.deepcopy(new_set._inner.config)
+    cfg.update(bst.params)
+    g = create_boosting(cfg, new_set._inner)
+    g.models = old.models
+    g.iter = old.iter
+    # registered validation sets survive the train-set swap (reference
+    # ResetTrainingData keeps valid data)
+    g.valid_sets = old.valid_sets
+    g.valid_names = old.valid_names
+    g.valid_updaters = old.valid_updaters
+    g.valid_metrics = old.valid_metrics
+    # rebuild training scores from the carried model over the new binned
+    # data (the reference re-scores via the score updater the same way)
+    k = max(g.num_tree_per_iteration, 1)
+    for i, tree in enumerate(g.models):
+        g.score_updater.add_tree(tree, i % k)
+    bst._gbdt = g
+    bst.train_set = new_set
+    return 0
+
+
+def booster_shuffle_models(h, start_iter, end_iter):
+    _get(h).shuffle_models(start_iter, end_iter)
+    return 0
+
+
+def booster_refit(h, leaf_preds_mv, nrow, ncol):
+    """reference: LGBM_BoosterRefit — refit leaf values with the given
+    leaf predictions over the CURRENT training data."""
+    bst = _get(h)
+    leaf = np.frombuffer(leaf_preds_mv, dtype=np.int32).reshape(nrow, ncol)
+    decay = float(bst.params.get("refit_decay_rate", 0.9))
+    bst._gbdt.refit_leaves(leaf, decay)
+    return 0
+
+
+def booster_get_leaf_value(h, tree_idx, leaf_idx):
+    return float(_get(h)._gbdt.models[tree_idx].leaf_value[leaf_idx])
+
+
+def booster_set_leaf_value(h, tree_idx, leaf_idx, val):
+    _get(h)._gbdt.models[tree_idx].set_leaf_output(leaf_idx, float(val))
+    return 0
+
+
+def booster_number_of_total_model(h):
+    return _get(h).num_trees()
+
+
+def booster_num_model_per_iteration(h):
+    return _get(h).num_model_per_iteration()
+
+
+def booster_get_num_predict(h, data_idx):
+    bst = _get(h)
+    g = bst._gbdt
+    n = (g.num_data if data_idx == 0
+         else g.valid_sets[data_idx - 1].num_data)
+    return n * g.num_class
+
+
+def booster_get_predict(h, data_idx):
+    """Raw converted predictions for train (0) / valid i (i>0) — the
+    reference's GetPredict over the internal score (c_api.cpp)."""
+    bst = _get(h)
+    g = bst._gbdt
+    updater = (g.score_updater if data_idx == 0
+               else g.valid_updaters[data_idx - 1])
+    scores = updater.host_scores()           # (K, N)
+    if g.objective is not None:
+        import jax.numpy as jnp
+        conv = np.asarray(g.objective.convert_output(jnp.asarray(scores.T)))
+    else:
+        conv = scores.T
+    return np.ascontiguousarray(conv, dtype=np.float64).tobytes()
+
+
+def booster_dump_model(h, start_iteration, num_iteration):
+    import json
+    d = _get(h).dump_model(
+        num_iteration if num_iteration > 0 else None, start_iteration)
+    return json.dumps(d)
+
+
+def booster_get_feature_names(h):
+    return [str(n) for n in _get(h).feature_name()]
+
+
+def booster_calc_num_predict(h, num_row, predict_type, num_iteration):
+    bst = _get(h)
+    g = bst._gbdt
+    iters = g.current_iteration
+    if num_iteration > 0:
+        iters = min(iters, num_iteration)
+    if predict_type == 2:        # leaf index
+        return num_row * g.num_tree_per_iteration * iters
+    if predict_type == 3:        # contrib
+        return num_row * g.num_class * (g.max_feature_idx + 2)
+    return num_row * g.num_class
+
+
+def booster_predict_for_file(h, data_filename, data_has_header,
+                             predict_type, num_iteration, parameter,
+                             result_filename):
+    bst = _get(h)
+    kwargs = {}
+    if predict_type == 1:
+        kwargs["raw_score"] = True
+    elif predict_type == 2:
+        kwargs["pred_leaf"] = True
+    elif predict_type == 3:
+        kwargs["pred_contrib"] = True
+    preds = bst.predict(
+        data_filename, num_iteration=num_iteration if num_iteration > 0
+        else None, data_has_header=bool(data_has_header), **kwargs)
+    preds = np.asarray(preds, dtype=np.float64)
+    rows = preds[:, None] if preds.ndim == 1 else preds
+    with open(result_filename, "w") as fh:
+        for row in rows:
+            fh.write("\t".join(repr(float(v)) for v in row) + "\n")
+    return 0
+
+
+def _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv, data_type,
+                  nindptr, nelem, num_col):
+    indptr = np.frombuffer(indptr_mv, dtype=C_DTYPE[indptr_type])[:nindptr]
+    indices = np.frombuffer(indices_mv, dtype=np.int32)[:nelem]
+    data = np.frombuffer(data_mv, dtype=C_DTYPE[data_type])[:nelem]
+    nrow = nindptr - 1
+    mat = np.zeros((nrow, num_col))
+    for i in range(nrow):
+        lo, hi = int(indptr[i]), int(indptr[i + 1])
+        mat[i, indices[lo:hi]] = data[lo:hi]
+    return mat
+
+
+def booster_predict_for_csr(h, indptr_mv, indptr_type, indices_mv, data_mv,
+                            data_type, nindptr, nelem, num_col,
+                            predict_type, num_iteration, parameter):
+    mat = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
+                        data_type, nindptr, nelem, num_col)
+    return _predict_dense(_get(h), mat, predict_type, num_iteration)
+
+
+def booster_predict_for_csc(h, col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                            data_type, ncol_ptr, nelem, num_row,
+                            predict_type, num_iteration, parameter):
+    col_ptr = np.frombuffer(col_ptr_mv, dtype=C_DTYPE[col_ptr_type])[:ncol_ptr]
+    indices = np.frombuffer(indices_mv, dtype=np.int32)[:nelem]
+    data = np.frombuffer(data_mv, dtype=C_DTYPE[data_type])[:nelem]
+    ncol = ncol_ptr - 1
+    mat = np.zeros((num_row, ncol))
+    for j in range(ncol):
+        lo, hi = int(col_ptr[j]), int(col_ptr[j + 1])
+        mat[indices[lo:hi], j] = data[lo:hi]
+    return _predict_dense(_get(h), mat, predict_type, num_iteration)
+
+
+def _predict_dense(bst, mat, predict_type, num_iteration):
+    kwargs = {}
+    if predict_type == 1:
+        kwargs["raw_score"] = True
+    elif predict_type == 2:
+        kwargs["pred_leaf"] = True
+    elif predict_type == 3:
+        kwargs["pred_contrib"] = True
+    preds = bst.predict(mat, num_iteration=num_iteration
+                        if num_iteration > 0 else None, **kwargs)
+    return np.ascontiguousarray(preds, dtype=np.float64).tobytes()
+
+
+def booster_predict_for_mat_single_row(h, mv, data_type, ncol, is_row_major,
+                                       predict_type, num_iteration,
+                                       parameter):
+    arr = np.frombuffer(mv, dtype=C_DTYPE[data_type])[:ncol]
+    return _predict_dense(_get(h), arr.reshape(1, ncol), predict_type,
+                          num_iteration)
+
+
+def booster_predict_for_csr_single_row(h, indptr_mv, indptr_type, indices_mv,
+                                       data_mv, data_type, nindptr, nelem,
+                                       num_col, predict_type, num_iteration,
+                                       parameter):
+    mat = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
+                        data_type, nindptr, nelem, num_col)
+    return _predict_dense(_get(h), mat, predict_type, num_iteration)
+
+
+def network_init_with_functions(num_machines, rank):
+    """reference: LGBM_NetworkInitWithFunctions (c_api.h:1018). The
+    reference lets hosts inject reduce-scatter/allgather callbacks; here
+    collectives are XLA ops over the mesh, so the injected functions are
+    recorded for the host-side metadata sync only."""
+    from .parallel import network
+    network.init_external(int(num_machines), int(rank))
+    return 0

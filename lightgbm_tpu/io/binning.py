@@ -338,6 +338,11 @@ class BinMapper:
                 b = self.value_to_bin(float(v))
                 if b < len(out):
                     out[b] += int(c)
+            # the NaN category lives in the last bin and counts toward
+            # splittability like any other category (reference bin.cpp
+            # categorical NaN bin)
+            if self.missing_type == MISSING_NAN and self.num_bin >= 1:
+                out[self.num_bin - 1] += na_cnt
         return out
 
     def _check_splittable(self, cnt_in_bin: np.ndarray, min_split_data: int) -> bool:

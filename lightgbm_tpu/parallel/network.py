@@ -67,6 +67,19 @@ def rank() -> int:
     return _rank
 
 
+def init_external(num_machines: int, rank: int) -> None:
+    """reference: LGBM_NetworkInitWithFunctions (c_api.h:1018) — hosts like
+    Spark/Dask inject collectives. Collectives here are XLA ops over the
+    mesh, so only the (num_machines, rank) identity is recorded for the
+    host-side coordination paths (rank-partitioned loading, logging)."""
+    global _initialized, _num_machines, _rank
+    _initialized = True
+    _num_machines = int(num_machines)
+    _rank = int(rank)
+    log.info("Network initialized externally: rank %d/%d", _rank,
+             _num_machines)
+
+
 def free() -> None:
     global _initialized, _num_machines, _rank
     if _initialized:

@@ -133,3 +133,202 @@ def test_c_api_custom_objective(lib):
     assert acc > 0.8
     _check(lib, lib.LGBM_BoosterFree(bst))
     _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+def test_c_api_csc_and_sparse_predict(lib):
+    """CSC construction + CSR/CSC prediction (reference c_api.h:191/:698)."""
+    x, y = make_binary(400, 6)
+    xf = np.ascontiguousarray(x, dtype=np.float64)
+    # CSC encode (dense values, all nonzero -> simple pointers)
+    col_ptr = np.arange(0, 401 * 6, 400, dtype=np.int32)[:7]
+    indices = np.tile(np.arange(400, dtype=np.int32), 6)
+    data = np.ascontiguousarray(xf.T.reshape(-1))
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromCSC(
+        col_ptr.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(7), ctypes.c_int64(2400), ctypes.c_int64(400),
+        b"", None, ctypes.byref(ds)))
+    yl = np.ascontiguousarray(y, dtype=np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yl.ctypes.data_as(ctypes.c_void_p), 400, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1", ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(5):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+
+    # dense reference predictions
+    out = (ctypes.c_double * 400)()
+    olen = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterPredictForMat(
+        bst, xf.ctypes.data_as(ctypes.c_void_p), 1, 400, 6, 1, 0, -1, b"",
+        ctypes.byref(olen), out))
+    dense_preds = np.array(out[:400])
+
+    # CSR predict must match
+    indptr = np.arange(0, 401 * 6, 6, dtype=np.int32)[:401]
+    csr_idx = np.tile(np.arange(6, dtype=np.int32), 400)
+    csr_data = np.ascontiguousarray(xf.reshape(-1))
+    out2 = (ctypes.c_double * 400)()
+    _check(lib, lib.LGBM_BoosterPredictForCSR(
+        bst, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+        csr_idx.ctypes.data_as(ctypes.c_void_p),
+        csr_data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(401), ctypes.c_int64(2400), ctypes.c_int64(6),
+        0, -1, b"", ctypes.byref(olen), out2))
+    np.testing.assert_allclose(np.array(out2[:400]), dense_preds, rtol=1e-9)
+
+    # single-row fast paths
+    out3 = (ctypes.c_double * 1)()
+    row = np.ascontiguousarray(xf[3])
+    _check(lib, lib.LGBM_BoosterPredictForMatSingleRow(
+        bst, row.ctypes.data_as(ctypes.c_void_p), 1, 6, 1, 0, -1, b"",
+        ctypes.byref(olen), out3))
+    assert abs(out3[0] - dense_preds[3]) < 1e-9
+
+
+def test_c_api_booster_admin_functions(lib, tmp_path):
+    """Merge, shuffle, leaf get/set, ResetParameter, CalcNumPredict,
+    GetPredict, NumberOfTotalModel, feature names, DumpModel."""
+    x, y = make_binary(500, 5)
+    xf = np.ascontiguousarray(x, dtype=np.float64)
+    yl = np.ascontiguousarray(y, dtype=np.float32)
+
+    def make_booster(iters):
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            xf.ctypes.data_as(ctypes.c_void_p), 1, 500, 5, 1, b"",
+            None, ctypes.byref(ds)))
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", yl.ctypes.data_as(ctypes.c_void_p), 500, 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=7 verbosity=-1",
+            ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(iters):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+        return bst
+
+    b1, b2 = make_booster(3), make_booster(2)
+    _check(lib, lib.LGBM_BoosterMerge(b1, b2))
+    total = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterNumberOfTotalModel(b1, ctypes.byref(total)))
+    assert total.value == 5
+    per = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterNumModelPerIteration(b1, ctypes.byref(per)))
+    assert per.value == 1
+
+    # leaf get/set round trip
+    lib.LGBM_BoosterGetLeafValue.restype = ctypes.c_int
+    val = ctypes.c_double()
+    _check(lib, lib.LGBM_BoosterGetLeafValue(b1, 0, 1, ctypes.byref(val)))
+    _check(lib, lib.LGBM_BoosterSetLeafValue(
+        b1, 0, 1, ctypes.c_double(val.value + 1.5)))
+    val2 = ctypes.c_double()
+    _check(lib, lib.LGBM_BoosterGetLeafValue(b1, 0, 1, ctypes.byref(val2)))
+    assert abs(val2.value - val.value - 1.5) < 1e-12
+
+    _check(lib, lib.LGBM_BoosterResetParameter(b1, b"learning_rate=0.05"))
+    _check(lib, lib.LGBM_BoosterShuffleModels(b1, 0, -1))
+
+    # CalcNumPredict / GetPredict
+    n64 = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterCalcNumPredict(b2, 500, 0, -1,
+                                               ctypes.byref(n64)))
+    assert n64.value == 500
+    _check(lib, lib.LGBM_BoosterGetNumPredict(b2, 0, ctypes.byref(n64)))
+    assert n64.value == 500
+    out = (ctypes.c_double * 500)()
+    _check(lib, lib.LGBM_BoosterGetPredict(b2, 0, ctypes.byref(n64), out))
+    assert n64.value == 500
+    assert 0.0 <= min(out) and max(out) <= 1.0
+
+    # feature names
+    bufs = [ctypes.create_string_buffer(128) for _ in range(5)]
+    arr = (ctypes.c_char_p * 5)(*[ctypes.addressof(b) for b in bufs])
+    cnt = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetFeatureNames(b2, ctypes.byref(cnt), arr))
+    assert cnt.value == 5 and bufs[0].value.decode().startswith("Column_")
+
+    # DumpModel JSON
+    out_len = ctypes.c_int64()
+    _check(lib, lib.LGBM_BoosterDumpModel(b2, 0, -1, 0,
+                                          ctypes.byref(out_len), None))
+    buf = ctypes.create_string_buffer(out_len.value)
+    _check(lib, lib.LGBM_BoosterDumpModel(b2, 0, -1, out_len.value,
+                                          ctypes.byref(out_len), buf))
+    import json
+    d = json.loads(buf.value.decode())
+    assert d["num_class"] == 1 and len(d["tree_info"]) == 2
+
+
+def test_c_api_streaming_dataset_and_subset(lib):
+    """CreateFromSampledColumn + PushRows + GetSubset + SaveBinary."""
+    x, y = make_binary(300, 4)
+    xf = np.ascontiguousarray(x, dtype=np.float64)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromSampledColumn(
+        None, None, 4, None, 0, 300, b"", ctypes.byref(ds)))
+    half = np.ascontiguousarray(xf[:150])
+    _check(lib, lib.LGBM_DatasetPushRows(
+        ds, half.ctypes.data_as(ctypes.c_void_p), 1, 150, 4, 0))
+    rest = np.ascontiguousarray(xf[150:])
+    _check(lib, lib.LGBM_DatasetPushRows(
+        ds, rest.ctypes.data_as(ctypes.c_void_p), 1, 150, 4, 150))
+    yl = np.ascontiguousarray(y, dtype=np.float32)
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yl.ctypes.data_as(ctypes.c_void_p), 300, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1", ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    it = ctypes.c_int()
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst, ctypes.byref(it)))
+    assert it.value == 1
+
+    # subset
+    idx = np.arange(0, 300, 2, dtype=np.int32)
+    sub = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetGetSubset(
+        ds, idx.ctypes.data_as(ctypes.c_void_p), 150, b"",
+        ctypes.byref(sub)))
+    n = ctypes.c_int32()
+    _check(lib, lib.LGBM_DatasetGetNumData(sub, ctypes.byref(n)))
+    assert n.value == 150
+
+
+def test_c_api_predict_for_file(lib, tmp_path):
+    x, y = make_binary(200, 4)
+    data_file = tmp_path / "pred_in.csv"
+    np.savetxt(data_file, np.column_stack([y, x]), delimiter=",", fmt="%.6f")
+    xf = np.ascontiguousarray(x, dtype=np.float64)
+    yl = np.ascontiguousarray(y, dtype=np.float32)
+    ds = ctypes.c_void_p()
+    _check(lib, lib.LGBM_DatasetCreateFromMat(
+        xf.ctypes.data_as(ctypes.c_void_p), 1, 200, 4, 1, b"", None,
+        ctypes.byref(ds)))
+    _check(lib, lib.LGBM_DatasetSetField(
+        ds, b"label", yl.ctypes.data_as(ctypes.c_void_p), 200, 0))
+    bst = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(
+        ds, b"objective=binary num_leaves=7 verbosity=-1", ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(3):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    out_file = tmp_path / "pred_out.txt"
+    _check(lib, lib.LGBM_BoosterPredictForFile(
+        bst, str(data_file).encode(), 0, 0, -1, b"label_column=0",
+        str(out_file).encode()))
+    preds = np.loadtxt(out_file)
+    assert preds.shape == (200,)
+    assert 0.0 <= preds.min() and preds.max() <= 1.0
+
+
+def test_c_api_network_init_with_functions(lib):
+    _check(lib, lib.LGBM_NetworkInitWithFunctions(2, 0, None, None))
+    _check(lib, lib.LGBM_NetworkFree())

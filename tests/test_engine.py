@@ -399,3 +399,83 @@ def test_fused_iteration_matches_generic_path():
     np.testing.assert_allclose(
         b1.predict(x[:500], raw_score=True),
         b2.predict(x[:500], raw_score=True), rtol=1e-5, atol=1e-6)
+
+
+def test_missing_value_handle_na_exact():
+    """reference: tests/python_package_test/test_engine.py:142
+    test_missing_value_handle_na — one split must isolate the NaN row."""
+    import lightgbm_tpu as lgb
+    x = np.array([0, 1, 2, 3, 4, 5, 6, 7, np.nan]).reshape(-1, 1)
+    y = np.array([1, 1, 1, 1, 0, 0, 0, 0, 1], dtype=float)
+    params = {"objective": "regression", "metric": "auc", "verbosity": -1,
+              "boost_from_average": False, "min_data_in_leaf": 1,
+              "num_leaves": 2, "learning_rate": 1, "min_data_in_bin": 1,
+              "zero_as_missing": False}
+    bst = lgb.train(params, lgb.Dataset(x, y), num_boost_round=1)
+    pred = bst.predict(x)
+    np.testing.assert_allclose(pred, y, atol=1e-6)
+
+
+def test_missing_value_handle_zero_exact():
+    """reference: test_engine.py:174 test_missing_value_handle_zero —
+    zero_as_missing=True routes both 0 and NaN with the missing bin."""
+    import lightgbm_tpu as lgb
+    x = np.array([0, 1, 2, 3, 4, 5, 6, 7, np.nan]).reshape(-1, 1)
+    y = np.array([0, 1, 1, 1, 0, 0, 0, 0, 0], dtype=float)
+    params = {"objective": "regression", "metric": "auc", "verbosity": -1,
+              "boost_from_average": False, "min_data_in_leaf": 1,
+              "num_leaves": 2, "learning_rate": 1, "min_data_in_bin": 1,
+              "zero_as_missing": True}
+    bst = lgb.train(params, lgb.Dataset(x, y), num_boost_round=1)
+    pred = bst.predict(x)
+    np.testing.assert_allclose(pred, y, atol=1e-6)
+
+
+def test_missing_value_handle_none_exact():
+    """reference: test_engine.py:206 test_missing_value_handle_none —
+    use_missing=False treats NaN like the smallest bin."""
+    import lightgbm_tpu as lgb
+    x = np.array([0, 1, 2, 3, 4, 5, 6, 7, np.nan]).reshape(-1, 1)
+    y = np.array([0, 1, 1, 1, 0, 0, 0, 0, 0], dtype=float)
+    params = {"objective": "regression", "metric": "auc", "verbosity": -1,
+              "boost_from_average": False, "min_data_in_leaf": 1,
+              "num_leaves": 2, "learning_rate": 1, "min_data_in_bin": 1,
+              "use_missing": False}
+    bst = lgb.train(params, lgb.Dataset(x, y), num_boost_round=1)
+    pred = bst.predict(x)
+    assert abs(pred[0] - pred[1]) < 1e-9
+    assert abs(pred[-1] - pred[0]) < 1e-9
+
+
+def test_categorical_handle_exact():
+    """reference: test_engine.py:239 test_categorical_handle — 8 distinct
+    categories, alternating labels, one one-hot split per round."""
+    import lightgbm_tpu as lgb
+    x = np.arange(8, dtype=float).reshape(-1, 1)
+    y = np.array([0, 1, 0, 1, 0, 1, 0, 1], dtype=float)
+    params = {"objective": "regression", "metric": "auc", "verbosity": -1,
+              "boost_from_average": False, "min_data_in_leaf": 1,
+              "num_leaves": 2, "learning_rate": 1, "min_data_in_bin": 1,
+              "min_data_per_group": 1, "cat_smooth": 1, "cat_l2": 0,
+              "max_cat_to_onehot": 1, "zero_as_missing": True}
+    bst = lgb.train(params, lgb.Dataset(x, y, categorical_feature=[0]),
+                    num_boost_round=8)
+    pred = bst.predict(x)
+    np.testing.assert_allclose(pred, y, atol=1e-5)
+
+
+def test_categorical_handle_na_exact():
+    """reference: test_engine.py:276 test_categorical_handle_na — NaN
+    category must separate cleanly from category 0."""
+    import lightgbm_tpu as lgb
+    x = np.array([0, np.nan, 0, np.nan, 0, np.nan]).reshape(-1, 1)
+    y = np.array([0, 1, 0, 1, 0, 1], dtype=float)
+    params = {"objective": "regression", "metric": "auc", "verbosity": -1,
+              "boost_from_average": False, "min_data_in_leaf": 1,
+              "num_leaves": 2, "learning_rate": 1, "min_data_in_bin": 1,
+              "min_data_per_group": 1, "cat_smooth": 1, "cat_l2": 0,
+              "max_cat_to_onehot": 1, "zero_as_missing": False}
+    bst = lgb.train(params, lgb.Dataset(x, y, categorical_feature=[0]),
+                    num_boost_round=1)
+    pred = bst.predict(x)
+    np.testing.assert_allclose(pred, y, atol=1e-6)
