@@ -228,13 +228,20 @@ class Dataset:
             raise ValueError("datasets must have the same number of rows")
         a, b = self._inner, other._inner
         offset = a.num_total_features
+        # _bin_data pads an all-trivial dataset with one dummy zero column;
+        # drop dummies so binned stays aligned with used_features
+        a_cols = a.binned if a.used_features else a.binned[:, :0]
+        b_cols = b.binned if b.used_features else b.binned[:, :0]
         a.bin_mappers = list(a.bin_mappers) + list(b.bin_mappers)
         a.used_features = list(a.used_features) + [
             offset + f for f in b.used_features]
         a.max_num_bins = max(a.max_num_bins, b.max_num_bins)
-        dt = (np.uint16 if max(a.binned.dtype.itemsize,
-                               b.binned.dtype.itemsize) == 2 else np.uint8)
-        a.binned = np.hstack([a.binned.astype(dt), b.binned.astype(dt)])
+        dt = (np.uint16 if max(a_cols.dtype.itemsize,
+                               b_cols.dtype.itemsize) == 2 else np.uint8)
+        merged = np.hstack([a_cols.astype(dt), b_cols.astype(dt)])
+        if merged.shape[1] == 0:
+            merged = np.zeros((a.num_data, 1), dtype=dt)
+        a.binned = merged
         a.num_total_features += b.num_total_features
         a.feature_names = list(a.feature_names) + list(b.feature_names)
         a.columns = a._plan_bundles()

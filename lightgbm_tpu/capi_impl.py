@@ -264,10 +264,8 @@ def network_free():
 # Extended dataset constructors (reference: src/c_api.cpp dataset section)
 # ---------------------------------------------------------------------------
 
-def dataset_create_from_csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
-                            data_type, ncol_ptr, nelem, num_row, parameters,
-                            reference):
-    """reference: LGBM_DatasetCreateFromCSC (c_api.h:191)."""
+def _csc_to_dense(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                  data_type, ncol_ptr, nelem, num_row):
     col_ptr = np.frombuffer(col_ptr_mv, dtype=C_DTYPE[col_ptr_type])[:ncol_ptr]
     indices = np.frombuffer(indices_mv, dtype=np.int32)[:nelem]
     data = np.frombuffer(data_mv, dtype=C_DTYPE[data_type])[:nelem]
@@ -276,6 +274,15 @@ def dataset_create_from_csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
     for j in range(ncol):
         lo, hi = int(col_ptr[j]), int(col_ptr[j + 1])
         mat[indices[lo:hi], j] = data[lo:hi]
+    return mat
+
+
+def dataset_create_from_csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                            data_type, ncol_ptr, nelem, num_row, parameters,
+                            reference):
+    """reference: LGBM_DatasetCreateFromCSC (c_api.h:191)."""
+    mat = _csc_to_dense(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                        data_type, ncol_ptr, nelem, num_row)
     params = parse_config_str(parameters or "")
     ref = _get(reference) if reference else None
     ds = Dataset(mat, reference=ref, params=params)
@@ -314,6 +321,7 @@ class _StreamingDataset:
         self.filled = 0
         self._ds = None
         self._pending_fields: Dict[str, np.ndarray] = {}
+        self._pending_names = None
 
     def push_rows(self, arr: np.ndarray, start_row: int) -> None:
         self.buf[start_row:start_row + arr.shape[0], :] = arr
@@ -332,16 +340,31 @@ class _StreamingDataset:
             self._ds.set_group(data)
         return self
 
+    def _update_params(self, params):
+        self.params.update(params or {})
+        self._ds = None
+        return self
+
+    def set_feature_name(self, names):
+        self._pending_names = list(names)
+        if self._ds is not None:
+            self._ds.set_feature_name(self._pending_names)
+        return self
+
     def _materialize(self) -> Dataset:
         if self._ds is None:
             ds = Dataset(self.buf, reference=self.reference,
                          params=self.params)
+            if getattr(self, "_pending_names", None):
+                ds.set_feature_name(self._pending_names)
             for name, data in self._pending_fields.items():
                 if name == "group":
                     ds.set_group(data)
                 else:
                     ds.set_field(name, data)
             ds.construct()
+            if getattr(self, "_pending_names", None):
+                ds._inner.feature_names = list(self._pending_names)
             self._ds = ds
         return self._ds
 
@@ -350,6 +373,8 @@ class _StreamingDataset:
         return self._materialize().construct()
 
     def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
         return getattr(self._materialize(), name)
 
 
@@ -399,7 +424,7 @@ def dataset_save_binary(h, filename):
 
 
 def dataset_dump_text(h, filename):
-    ds = _get(h)
+    ds = _as_dataset(_get(h))
     ds.construct()
     inner = ds._inner
     with open(filename, "w") as fh:
@@ -430,8 +455,9 @@ def dataset_get_feature_names(h):
 
 
 def dataset_update_param(h, parameters):
-    ds = _get(h)
-    ds._update_params(parse_config_str(parameters or ""))
+    # note: a constructed (non-streaming) dataset is already binned; like
+    # the reference, updates then only affect params consumed later
+    _get(h)._update_params(parse_config_str(parameters or ""))
     return 0
 
 
@@ -468,6 +494,19 @@ def booster_reset_training_data(h, train_h):
     new_set = _as_dataset(_get(train_h))
     new_set.construct()
     old = bst._gbdt
+    # trees store bin-space thresholds: the new data must be binned with
+    # the same mappers (reference fatals on misaligned bin mappers)
+    old_m = old.train_set.bin_mappers
+    new_m = new_set._inner.bin_mappers
+    same = (new_m is old_m) or (
+        len(new_m) == len(old_m)
+        and all(a.num_bin == b.num_bin and a.bin_type == b.bin_type
+                and list(a.bin_upper_bound) == list(b.bin_upper_bound)
+                for a, b in zip(new_m, old_m)))
+    if not same:
+        raise ValueError(
+            "ResetTrainingData requires a dataset binned against the "
+            "booster's training data (create it with reference=)")
     import copy as _copy
     from .models.gbdt import create_boosting
     cfg = _copy.deepcopy(new_set._inner.config)
@@ -582,9 +621,18 @@ def booster_predict_for_file(h, data_filename, data_has_header,
         kwargs["pred_leaf"] = True
     elif predict_type == 3:
         kwargs["pred_contrib"] = True
+    # honor parser overrides from the parameter string (reference passes
+    # them into the Predictor's parser config)
+    pconf = parse_config_str(parameter or "")
+    label_col = pconf.get("label_column", 0)
+    if isinstance(label_col, str):
+        label_col = int(label_col.split(":")[-1])
+    from .io.parser import parse_file
+    x, _, _ = parse_file(data_filename, label_column=int(label_col),
+                         has_header=bool(data_has_header) or None)
     preds = bst.predict(
-        data_filename, num_iteration=num_iteration if num_iteration > 0
-        else None, data_has_header=bool(data_has_header), **kwargs)
+        x, num_iteration=num_iteration if num_iteration > 0 else None,
+        **kwargs)
     preds = np.asarray(preds, dtype=np.float64)
     rows = preds[:, None] if preds.ndim == 1 else preds
     with open(result_filename, "w") as fh:
@@ -617,14 +665,8 @@ def booster_predict_for_csr(h, indptr_mv, indptr_type, indices_mv, data_mv,
 def booster_predict_for_csc(h, col_ptr_mv, col_ptr_type, indices_mv, data_mv,
                             data_type, ncol_ptr, nelem, num_row,
                             predict_type, num_iteration, parameter):
-    col_ptr = np.frombuffer(col_ptr_mv, dtype=C_DTYPE[col_ptr_type])[:ncol_ptr]
-    indices = np.frombuffer(indices_mv, dtype=np.int32)[:nelem]
-    data = np.frombuffer(data_mv, dtype=C_DTYPE[data_type])[:nelem]
-    ncol = ncol_ptr - 1
-    mat = np.zeros((num_row, ncol))
-    for j in range(ncol):
-        lo, hi = int(col_ptr[j]), int(col_ptr[j + 1])
-        mat[indices[lo:hi], j] = data[lo:hi]
+    mat = _csc_to_dense(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                        data_type, ncol_ptr, nelem, num_row)
     return _predict_dense(_get(h), mat, predict_type, num_iteration)
 
 

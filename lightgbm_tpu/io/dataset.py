@@ -80,7 +80,8 @@ class Dataset:
                  feature_names: Optional[List[str]] = None,
                  categorical_feature: Optional[Sequence] = None,
                  reference: Optional["Dataset"] = None,
-                 params: Optional[Dict[str, Any]] = None):
+                 params: Optional[Dict[str, Any]] = None,
+                 bin_mappers=None):
         self.config = config or Config(params or {})
         data = self._to_numpy(data)
         self.num_data, self.num_total_features = data.shape
@@ -99,6 +100,15 @@ class Dataset:
             self.used_features = reference.used_features
             self.max_num_bins = reference.max_num_bins
             self.feature_names = reference.feature_names
+        elif bin_mappers is not None:
+            # precomputed mappers (distributed bin finding,
+            # io/distributed.py): bin the local partition directly
+            self.bin_mappers = list(bin_mappers)
+            self.used_features = [i for i, m in enumerate(self.bin_mappers)
+                                  if not m.is_trivial]
+            self.max_num_bins = max(
+                [self.bin_mappers[i].num_bin for i in self.used_features],
+                default=1)
         else:
             cat_idx = self._resolve_categorical(categorical_feature)
             self.bin_mappers = self._build_mappers(data, cat_idx)
