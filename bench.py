@@ -147,7 +147,11 @@ if __name__ == "__main__":
         signal.alarm(deadline)
     try:
         main()
+        if deadline > 0:
+            signal.alarm(0)
     except Exception as exc:  # emit a parseable diagnostic, never a bare rc=1
+        if deadline > 0:
+            signal.alarm(0)
         import traceback
         traceback.print_exc()
         print(json.dumps({

@@ -349,6 +349,11 @@ class _StreamingDataset:
         self._pending_names = list(names)
         if self._ds is not None:
             self._ds.set_feature_name(self._pending_names)
+            # already constructed: the wrapper attr alone won't reach the
+            # binned dataset, rename it in place like the C API does
+            inner = getattr(self._ds, "_inner", None)
+            if inner is not None:
+                inner.feature_names = list(names)
         return self
 
     def _materialize(self) -> Dataset:
@@ -498,11 +503,20 @@ def booster_reset_training_data(h, train_h):
     # the same mappers (reference fatals on misaligned bin mappers)
     old_m = old.train_set.bin_mappers
     new_m = new_set._inner.bin_mappers
+    def _mappers_equal(a, b):
+        if a.num_bin != b.num_bin or a.bin_type != b.bin_type:
+            return False
+        ua, ub = np.asarray(a.bin_upper_bound, np.float64), \
+            np.asarray(b.bin_upper_bound, np.float64)
+        if ua.shape != ub.shape or not np.array_equal(ua, ub,
+                                                      equal_nan=True):
+            return False
+        return getattr(a, "categorical_2_bin", None) == \
+            getattr(b, "categorical_2_bin", None)
+
     same = (new_m is old_m) or (
         len(new_m) == len(old_m)
-        and all(a.num_bin == b.num_bin and a.bin_type == b.bin_type
-                and list(a.bin_upper_bound) == list(b.bin_upper_bound)
-                for a, b in zip(new_m, old_m)))
+        and all(_mappers_equal(a, b) for a, b in zip(new_m, old_m)))
     if not same:
         raise ValueError(
             "ResetTrainingData requires a dataset binned against the "
@@ -626,7 +640,25 @@ def booster_predict_for_file(h, data_filename, data_has_header,
     pconf = parse_config_str(parameter or "")
     label_col = pconf.get("label_column", 0)
     if isinstance(label_col, str):
-        label_col = int(label_col.split(":")[-1])
+        if label_col.startswith("name:"):
+            # name: form resolves against the file header (reference
+            # config.h label_column doc: names require has_header)
+            name = label_col[5:]
+            # same first-line rule as parse_file: skip comments/blanks
+            with open(data_filename) as fh:
+                first = fh.readline()
+                while first.startswith("#") or not first.strip():
+                    first = fh.readline()
+            first = first.strip()
+            delim = "," if "," in first else "\t" if "\t" in first else None
+            cols = [c.strip() for c in first.split(delim)]
+            if name not in cols:
+                raise ValueError(
+                    f"label_column name '{name}' not in file header")
+            label_col = cols.index(name)
+            data_has_header = 1
+        else:
+            label_col = int(label_col.split(":")[-1])
     from .io.parser import parse_file
     x, _, _ = parse_file(data_filename, label_column=int(label_col),
                          has_header=bool(data_has_header) or None)

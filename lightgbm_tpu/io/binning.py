@@ -459,3 +459,59 @@ class BinMapper:
         m.is_trivial = d["is_trivial"]
         m.sparse_rate = d.get("sparse_rate", 0.0)
         return m
+
+
+def load_forced_bounds(forcedbins_filename: Optional[str]) -> Dict[int, list]:
+    """Parse the forced-bins JSON file (reference: DatasetLoader reads
+    forcedbins_filename; entries {"feature": i, "bin_upper_bound": [...]})."""
+    bounds: Dict[int, list] = {}
+    if forcedbins_filename:
+        import json
+        with open(forcedbins_filename) as fh:
+            for entry in json.load(fh):
+                bounds[int(entry["feature"])] = [
+                    float(v) for v in entry["bin_upper_bound"]]
+    return bounds
+
+
+def resolve_ignore_set(ignore_column, feature_names=None) -> set:
+    """ignore_column entries -> feature index set. name: forms resolve
+    against feature_names when available, silently drop otherwise."""
+    ignore = set()
+    for c in ignore_column or []:
+        if isinstance(c, str) and c.startswith("name:"):
+            name = c[5:]
+            if feature_names and name in feature_names:
+                ignore.add(list(feature_names).index(name))
+        else:
+            try:
+                ignore.add(int(c))
+            except (TypeError, ValueError):
+                pass
+    return ignore
+
+
+def mapper_from_sample_column(col: np.ndarray, total_sample_cnt: int,
+                              cfg, feature_index: int, cat_idx: set,
+                              forced_bounds: Optional[Dict[int, list]] = None
+                              ) -> "BinMapper":
+    """One feature's BinMapper from its sampled column — the single
+    find-bin recipe shared by the in-process path
+    (io/dataset.py Dataset._build_mappers) and the distributed path
+    (io/distributed.py distributed_find_bins)."""
+    m = BinMapper()
+    # the sampling contract: pass non-zero values, zeros implied
+    nonzero = col[(np.abs(col) > ZERO_THRESHOLD) | np.isnan(col)]
+    mbf = cfg.max_bin_by_feature
+    max_bin = (mbf[feature_index] if mbf and feature_index < len(mbf)
+               else cfg.max_bin)
+    m.find_bin(
+        nonzero, total_sample_cnt=total_sample_cnt, max_bin=max_bin,
+        min_data_in_bin=cfg.min_data_in_bin,
+        min_split_data=cfg.min_data_in_leaf,
+        bin_type=(BIN_CATEGORICAL if feature_index in cat_idx
+                  else BIN_NUMERICAL),
+        use_missing=cfg.use_missing,
+        zero_as_missing=cfg.zero_as_missing,
+        forced_bounds=(forced_bounds or {}).get(feature_index))
+    return m

@@ -18,7 +18,9 @@ import numpy as np
 from ..config import Config
 from ..utils import log
 from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
-                      MISSING_NONE, MISSING_ZERO, BinMapper)
+                      MISSING_NONE, MISSING_ZERO, BinMapper,
+                      load_forced_bounds, mapper_from_sample_column,
+                      resolve_ignore_set)
 
 
 class Metadata:
@@ -167,50 +169,19 @@ class Dataset:
             sample_rows = np.sort(rng.choice(n, sample_cnt, replace=False))
         else:
             sample_rows = np.arange(n)
-        max_bin_by_feature = cfg.max_bin_by_feature
-        # forced bin bounds (reference: dataset_loader + bin.cpp
-        # FindBinWithPredefinedBin; JSON: [{"feature": i, "bin_upper_bound": [...]}])
-        forced_bounds: Dict[int, list] = {}
-        if cfg.forcedbins_filename:
-            import json
-            with open(cfg.forcedbins_filename) as fh:
-                for entry in json.load(fh):
-                    forced_bounds[int(entry["feature"])] = [
-                        float(v) for v in entry["bin_upper_bound"]]
-        ignore = set()
-        for c in cfg.ignore_column or []:
-            if isinstance(c, str) and c.startswith("name:"):
-                name = c[5:]
-                if name in self.feature_names:
-                    ignore.add(self.feature_names.index(name))
-            else:
-                try:
-                    ignore.add(int(c))
-                except (TypeError, ValueError):
-                    pass
+        forced_bounds = load_forced_bounds(cfg.forcedbins_filename)
+        ignore = resolve_ignore_set(cfg.ignore_column, self.feature_names)
         mappers = []
         for f in range(self.num_total_features):
-            m = BinMapper()
             if f in ignore:
+                m = BinMapper()
                 m.is_trivial = True
                 m.num_bin = 1
                 mappers.append(m)
                 continue
-            col = data[sample_rows, f]
-            # the sampling contract: pass non-zero values, zeros implied
-            nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
-            max_bin = (max_bin_by_feature[f]
-                       if max_bin_by_feature and f < len(max_bin_by_feature)
-                       else cfg.max_bin)
-            m.find_bin(
-                nonzero, total_sample_cnt=len(sample_rows), max_bin=max_bin,
-                min_data_in_bin=cfg.min_data_in_bin,
-                min_split_data=cfg.min_data_in_leaf,
-                bin_type=BIN_CATEGORICAL if f in cat_idx else BIN_NUMERICAL,
-                use_missing=cfg.use_missing,
-                zero_as_missing=cfg.zero_as_missing,
-                forced_bounds=forced_bounds.get(f))
-            mappers.append(m)
+            mappers.append(mapper_from_sample_column(
+                data[sample_rows, f], len(sample_rows), cfg, f, cat_idx,
+                forced_bounds))
         return mappers
 
     def _bin_data(self, data: np.ndarray) -> np.ndarray:

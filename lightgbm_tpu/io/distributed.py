@@ -10,10 +10,13 @@ design:
 
 * Sample exchange happens FIRST (each process contributes its local sample
   of every feature; each rank receives the union sample for its feature
-  slice), so the resulting BinMappers are bit-identical to a
-  single-process run over the same data — stronger than the reference,
-  whose mappers drift with the row partition because each machine bins
-  from its local sample only.
+  slice), so every process ends with the SAME mapper list. When the data
+  is small enough that no sampling triggers, that list is bit-identical
+  to a single-process run; with sampling active, the union of per-rank
+  samples differs from the single-process draw, so mappers are
+  cross-rank-consistent but not single-process-identical (the reference
+  has the same property — each machine bins from local samples,
+  dataset_loader.cpp:592-616).
 * The transport is `jax.experimental.multihost_utils.process_allgather`
   (device collectives over ICI/DCN under `jax.distributed`), not a
   userspace socket mesh.
@@ -30,7 +33,9 @@ import numpy as np
 
 from ..config import Config
 from ..utils import log
-from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper,
+                      load_forced_bounds, mapper_from_sample_column,
+                      resolve_ignore_set)
 
 
 def rank_row_range(num_total_rows: int, rank: int, num_processes: int
@@ -62,9 +67,14 @@ def _allgather_host_bytes(payload: bytes) -> List[bytes]:
     from jax.experimental import multihost_utils
 
     arr = np.frombuffer(payload, dtype=np.uint8)
-    n_local = np.int64(arr.size)
-    sizes = np.asarray(multihost_utils.process_allgather(
-        jnp.asarray([n_local])))
+    # split the 64-bit size into two int32 words: with jax x64 disabled,
+    # a single int64 would silently truncate for >=2GiB payloads
+    n_local = arr.size
+    size_words = np.asarray([n_local & 0x7FFFFFFF, n_local >> 31],
+                            dtype=np.int32)
+    words = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(size_words))).reshape(-1, 2).astype(np.int64)
+    sizes = words[:, 0] + (words[:, 1] << 31)
     max_len = int(sizes.max())
     padded = np.zeros(max_len, dtype=np.uint8)
     padded[: arr.size] = arr
@@ -111,25 +121,25 @@ def distributed_find_bins(local_data: np.ndarray, config: Config,
     total_sample = union.shape[0]
 
     # --- 3. find bins for OUR feature slice ----------------------------
+    # same config preprocessing as the single-process path
+    # (io/dataset.py _build_mappers, via the shared binning helpers);
+    # name: ignore_column forms need feature names, which live in Dataset,
+    # so only numeric indices resolve here
+    if not forced_bounds:
+        forced_bounds = load_forced_bounds(cfg.forcedbins_filename)
+    ignore = resolve_ignore_set(cfg.ignore_column)
+
     f_begin, f_end = feature_slice(num_f, rank, nproc)
-    max_bin_by_feature = cfg.max_bin_by_feature
     my_mappers: List[BinMapper] = []
     for f in range(f_begin, f_end):
-        m = BinMapper()
-        col = union[:, f]
-        nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
-        max_bin = (max_bin_by_feature[f]
-                   if max_bin_by_feature and f < len(max_bin_by_feature)
-                   else cfg.max_bin)
-        m.find_bin(
-            nonzero, total_sample_cnt=total_sample, max_bin=max_bin,
-            min_data_in_bin=cfg.min_data_in_bin,
-            min_split_data=cfg.min_data_in_leaf,
-            bin_type=BIN_CATEGORICAL if f in cat_idx else BIN_NUMERICAL,
-            use_missing=cfg.use_missing,
-            zero_as_missing=cfg.zero_as_missing,
-            forced_bounds=forced_bounds.get(f))
-        my_mappers.append(m)
+        if f in ignore:
+            m = BinMapper()
+            m.is_trivial = True
+            m.num_bin = 1
+            my_mappers.append(m)
+            continue
+        my_mappers.append(mapper_from_sample_column(
+            union[:, f], total_sample, cfg, f, cat_idx, forced_bounds))
 
     # --- 4. all-gather the serialized mapper slices --------------------
     slices = _allgather_host_bytes(pickle.dumps(my_mappers, protocol=4))

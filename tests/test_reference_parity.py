@@ -20,7 +20,9 @@ import pytest
 
 import lightgbm_tpu as lgb
 
-ORACLE = "/tmp/refsrc/lightgbm"
+_VENDORED = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "oracle", "lightgbm")
+ORACLE = _VENDORED if os.path.exists(_VENDORED) else "/tmp/refsrc/lightgbm"
 REF_EXAMPLES = "/root/reference/examples"
 BINARY_TRAIN = os.path.join(REF_EXAMPLES, "binary_classification", "binary.train")
 BINARY_TEST = os.path.join(REF_EXAMPLES, "binary_classification", "binary.test")

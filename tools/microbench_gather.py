@@ -1,0 +1,58 @@
+"""Gather/scatter throughput vs row width and index pattern.
+
+Decides the partition design of the compact tree learner: if row gathers
+reach HBM bandwidth at some row width, physically reordering wide packed
+rows is cheap; if they stay latency-bound (~ns/row), partitioning must be
+restructured (block compaction) or avoided (masked streaming histograms).
+
+Usage: python tools/microbench_gather.py [rows] [reps]
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+
+r = np.random.RandomState(0)
+perm = jnp.asarray(r.permutation(N).astype(np.int32))
+# partition-pattern indices: two interleaved monotonic runs (what a stable
+# left/right split produces)
+half_ids = np.arange(N)
+left = half_ids[half_ids % 3 != 0]
+right = half_ids[half_ids % 3 == 0]
+part = jnp.asarray(np.concatenate([left, right]).astype(np.int32))
+
+
+def timed(name, fn, *args, reps=REPS):
+    @jax.jit
+    def run(*a):
+        def body(i, acc):
+            out = fn(i, a)
+            return acc + out.ravel()[0].astype(jnp.float32)
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+    out = run(*args)
+    np.asarray(jax.device_get(out))
+    t0 = time.time()
+    np.asarray(jax.device_get(run(*args)))
+    dt = (time.time() - t0) / reps * 1e3
+    print(f"{name:44s} {dt:8.3f} ms")
+    return dt
+
+
+print(f"backend={jax.default_backend()} N={N} reps={REPS}")
+for width_u32 in (8, 11, 16, 32, 64):
+    data = jnp.asarray(
+        r.randint(0, 2**31, (N, width_u32), dtype=np.int64).astype(np.uint32))
+    nb = width_u32 * 4
+    t = timed(f"take rows {nb:3d}B random perm", lambda i, a: jnp.take(
+        a[0], jnp.roll(a[1], i), axis=0).astype(jnp.float32)[:1, :1],
+        data, perm)
+    print(f"    -> {N * nb / t / 1e6:8.1f} GB/s")
+    t = timed(f"take rows {nb:3d}B partition runs", lambda i, a: jnp.take(
+        a[0], a[1], axis=0).astype(jnp.float32)[:1, :1], data, part)
+    print(f"    -> {N * nb / t / 1e6:8.1f} GB/s")
