@@ -14,9 +14,16 @@ Booster <- R6::R6Class(
                              lgb.params2str(params))
       } else if (!is.null(modelfile)) {
         self$handle <- .Call(LGBMTPU_BoosterCreateFromModelfile_R, modelfile)
+      } else if (!is.null(model_str)) {
+        self$handle <- .Call(LGBMTPU_BoosterLoadModelFromString_R, model_str)
       } else {
-        stop("lgb.Booster: need train_set or modelfile")
+        stop("lgb.Booster: need train_set, modelfile or model_str")
       }
+    },
+
+    dump_model = function(num_iteration = -1L) {
+      .Call(LGBMTPU_BoosterDumpModel_R, self$handle,
+            as.integer(num_iteration))
     },
 
     add_valid = function(valid_set, name) {

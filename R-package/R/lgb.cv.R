@@ -1,0 +1,73 @@
+# k-fold cross-validation (role of reference R-package/R/lgb.cv.R).
+
+#' Cross validation for lightgbm.tpu
+#'
+#' Trains `nfold` boosters on stratified-free contiguous folds and reports
+#' the per-iteration mean/sd of the first validation metric.
+#' @param params list of training parameters
+#' @param data an lgb.Dataset-producing matrix (raw matrix + label), since
+#'   fold subsetting needs the raw rows
+#' @param label label vector when `data` is a matrix
+#' @param nrounds number of boosting rounds
+#' @param nfold number of folds
+#' @param early_stopping_rounds stop when the mean metric stops improving
+#' @return list with fields `record` (iter x c(mean, sd)), `best_iter`,
+#'   `boosters`
+#' @export
+lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
+                   nfold = 5L, early_stopping_rounds = NULL, verbose = 1L,
+                   folds = NULL) {
+  data <- as.matrix(data)
+  storage.mode(data) <- "double"
+  n <- nrow(data)
+  if (is.null(folds)) {
+    idx <- sample.int(n)
+    folds <- split(idx, rep_len(seq_len(nfold), n))
+  }
+  boosters <- list()
+  for (k in seq_along(folds)) {
+    test_idx <- folds[[k]]
+    train_idx <- setdiff(seq_len(n), test_idx)
+    dtrain <- lgb.Dataset(data[train_idx, , drop = FALSE],
+                          label = label[train_idx])
+    dtest <- lgb.Dataset(data[test_idx, , drop = FALSE],
+                         label = label[test_idx], reference = dtrain)
+    bst <- Booster$new(params, train_set = dtrain)
+    bst$add_valid(dtest, "valid")
+    boosters[[k]] <- bst
+  }
+  higher_better <- FALSE
+  record <- matrix(NA_real_, nrow = nrounds, ncol = 2L,
+                   dimnames = list(NULL, c("mean", "sd")))
+  best_iter <- -1L
+  best_score <- Inf
+  for (i in seq_len(nrounds)) {
+    scores <- vapply(boosters, function(b) {
+      b$update()
+      ev <- b$eval(1L)
+      if (length(ev) > 0) ev[[1]] else NA_real_
+    }, numeric(1))
+    if (i == 1L) {
+      hb <- tryCatch(boosters[[1]]$eval_higher_better(),
+                     error = function(e) logical(0))
+      higher_better <- length(hb) > 0 && isTRUE(hb[[1]])
+    }
+    record[i, ] <- c(mean(scores), stats::sd(scores))
+    if (verbose > 0) {
+      message(sprintf("[%d] cv: %.6f + %.6f", i, record[i, 1], record[i, 2]))
+    }
+    score <- if (higher_better) -record[i, 1] else record[i, 1]
+    if (score < best_score) {
+      best_score <- score
+      best_iter <- i
+    } else if (!is.null(early_stopping_rounds) &&
+               i - best_iter >= early_stopping_rounds) {
+      if (verbose > 0) {
+        message(sprintf("Early stopping, best iteration is: %d", best_iter))
+      }
+      record <- record[seq_len(i), , drop = FALSE]
+      break
+    }
+  }
+  list(record = record, best_iter = best_iter, boosters = boosters)
+}

@@ -1,0 +1,29 @@
+# Per-prediction interpretation (role of reference R-package/R/lgb.interprete.R).
+
+#' Feature contributions for individual predictions
+#'
+#' Uses the TreeSHAP contribution predictor from the C ABI (predcontrib),
+#' returning one data.frame per requested row with features ranked by
+#' absolute contribution (the upstream lgb.interprete output shape).
+#' @param booster lgb.Booster
+#' @param data matrix of raw feature rows
+#' @param idxset which rows of `data` to explain (1-based)
+#' @export
+lgb.interprete <- function(booster, data, idxset = 1L) {
+  data <- as.matrix(data)
+  storage.mode(data) <- "double"
+  sub <- data[idxset, , drop = FALSE]
+  contrib <- booster$predict(sub, predcontrib = TRUE)
+  if (is.null(dim(contrib))) {
+    contrib <- matrix(contrib, nrow = nrow(sub), byrow = TRUE)
+  }
+  nfeat <- ncol(contrib) - 1L
+  fnames <- colnames(data)
+  if (is.null(fnames)) fnames <- paste0("Column_", seq_len(nfeat) - 1L)
+  lapply(seq_len(nrow(sub)), function(i) {
+    vals <- contrib[i, seq_len(nfeat)]
+    ord <- order(abs(vals), decreasing = TRUE)
+    data.frame(Feature = fnames[ord], Contribution = vals[ord],
+               stringsAsFactors = FALSE)
+  })
+}

@@ -1,0 +1,62 @@
+# Model-structure table (role of reference R-package/R/lgb.model.dt.tree.R).
+
+#' Parse a Booster's trees into a flat data.frame
+#'
+#' One row per node (split or leaf), mirroring the upstream column set:
+#' tree_index, split_index/leaf_index, split_feature, split_gain, threshold,
+#' decision_type, default_left, internal_value/leaf_value, count.
+#' Uses the JSON dump from the C ABI; needs the `jsonlite` package.
+#' @export
+lgb.model.dt.tree <- function(booster, num_iteration = -1L) {
+  if (!requireNamespace("jsonlite", quietly = TRUE)) {
+    stop("lgb.model.dt.tree requires the 'jsonlite' package")
+  }
+  dump <- .Call(LGBMTPU_BoosterDumpModel_R, booster$handle,
+                as.integer(num_iteration))
+  model <- jsonlite::fromJSON(dump, simplifyVector = FALSE)
+  rows <- list()
+  walk <- function(node, tree_idx, depth, parent) {
+    if (!is.null(node$split_index)) {
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_idx,
+        depth = depth,
+        split_index = node$split_index,
+        leaf_index = NA_integer_,
+        split_feature = node$split_feature,
+        node_parent = parent,
+        split_gain = node$split_gain,
+        threshold = node$threshold,
+        decision_type = as.character(node$decision_type),
+        default_left = isTRUE(node$default_left),
+        internal_value = node$internal_value,
+        internal_count = node$internal_count,
+        leaf_value = NA_real_,
+        leaf_count = NA_integer_,
+        stringsAsFactors = FALSE)
+      walk(node$left_child, tree_idx, depth + 1L, node$split_index)
+      walk(node$right_child, tree_idx, depth + 1L, node$split_index)
+    } else {
+      rows[[length(rows) + 1L]] <<- data.frame(
+        tree_index = tree_idx,
+        depth = depth,
+        split_index = NA_integer_,
+        leaf_index = node$leaf_index,
+        split_feature = NA_integer_,
+        node_parent = parent,
+        split_gain = NA_real_,
+        threshold = NA_real_,
+        decision_type = NA_character_,
+        default_left = NA,
+        internal_value = NA_real_,
+        internal_count = NA_integer_,
+        leaf_value = node$leaf_value,
+        leaf_count = if (is.null(node$leaf_count)) NA_integer_
+                     else node$leaf_count,
+        stringsAsFactors = FALSE)
+    }
+  }
+  for (i in seq_along(model$tree_info)) {
+    walk(model$tree_info[[i]]$tree_structure, i - 1L, 0L, NA_integer_)
+  }
+  do.call(rbind, rows)
+}

@@ -1,0 +1,26 @@
+# Importance barplot (role of reference R-package/R/lgb.plot.importance.R).
+
+#' Plot feature importance as a horizontal barplot
+#' @param tree_imp output of lgb.importance (vector or data.frame)
+#' @param top_n number of features to show
+#' @param measure column to plot when tree_imp is a data.frame
+#' @export
+lgb.plot.importance <- function(tree_imp, top_n = 10L, measure = "Gain",
+                                left_margin = 10L, cex = NULL) {
+  if (is.data.frame(tree_imp)) {
+    vals <- tree_imp[[measure]]
+    names(vals) <- tree_imp$Feature
+  } else {
+    vals <- tree_imp
+    if (is.null(names(vals))) {
+      names(vals) <- paste0("Column_", seq_along(vals) - 1L)
+    }
+  }
+  vals <- sort(vals, decreasing = TRUE)
+  vals <- utils::head(vals, top_n)
+  op <- graphics::par(mar = c(3, left_margin, 2, 1))
+  on.exit(graphics::par(op))
+  graphics::barplot(rev(vals), horiz = TRUE, las = 1, cex.names = cex,
+                    main = "Feature importance")
+  invisible(vals)
+}

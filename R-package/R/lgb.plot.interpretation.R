@@ -1,0 +1,19 @@
+# Interpretation barplot (role of reference
+# R-package/R/lgb.plot.interpretation.R).
+
+#' Plot one prediction's feature contributions
+#' @param tree_interpretation one element of lgb.interprete's output
+#' @param top_n number of features to show
+#' @export
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
+                                    left_margin = 10L, cex = NULL) {
+  df <- utils::head(tree_interpretation, top_n)
+  vals <- df$Contribution
+  names(vals) <- df$Feature
+  cols <- ifelse(vals >= 0, "steelblue", "firebrick")
+  op <- graphics::par(mar = c(3, left_margin, 2, 1))
+  on.exit(graphics::par(op))
+  graphics::barplot(rev(vals), horiz = TRUE, las = 1, cex.names = cex,
+                    col = rev(cols), main = "Feature contribution")
+  invisible(vals)
+}

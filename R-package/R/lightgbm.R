@@ -1,0 +1,29 @@
+# Top-level convenience trainer (role of reference R-package/R/lightgbm.R).
+
+#' Simple interface: train from a matrix + label in one call
+#'
+#' @param data feature matrix
+#' @param label target vector
+#' @param params named list of parameters
+#' @param nrounds boosting rounds
+#' @param objective shortcut for params$objective
+#' @export
+lightgbm <- function(data, label = NULL, params = list(), nrounds = 100L,
+                     objective = NULL, verbose = 1L, ...) {
+  if (!is.null(objective)) params$objective <- objective
+  dtrain <- lgb.Dataset(data, label = label)
+  lgb.train(params = params, data = dtrain, nrounds = nrounds,
+            verbose = verbose, ...)
+}
+
+#' Dump a model to its JSON representation
+#' @export
+lgb.dump <- function(booster, num_iteration = -1L) {
+  booster$dump_model(num_iteration)
+}
+
+#' Extract the model string (text format, v2.3.1-compatible)
+#' @export
+lgb.model.string <- function(booster, num_iteration = -1L) {
+  booster$save_model_to_string(num_iteration)
+}

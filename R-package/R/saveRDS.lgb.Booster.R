@@ -1,0 +1,33 @@
+# RDS round-trip (role of reference R-package/R/saveRDS.lgb.Booster.R +
+# readRDS.lgb.Booster.R). Booster handles are external pointers into the
+# embedded runtime and do not survive R serialization; the model travels
+# as its text form instead.
+
+#' Save a Booster to an RDS file
+#'
+#' Captures the model string alongside any R-side metadata so the object
+#' can be restored in a fresh session with readRDS.lgb.Booster.
+#' @export
+saveRDS.lgb.Booster <- function(object, file, num_iteration = -1L,
+                                compress = TRUE) {
+  payload <- list(
+    model_str = object$save_model_to_string(num_iteration),
+    best_iter = object$best_iter,
+    record_evals = object$record_evals,
+    class = "lgb.Booster.rds")
+  saveRDS(payload, file = file, compress = compress)
+  invisible(object)
+}
+
+#' Restore a Booster saved with saveRDS.lgb.Booster
+#' @export
+readRDS.lgb.Booster <- function(file) {
+  payload <- readRDS(file)
+  if (!identical(payload$class, "lgb.Booster.rds")) {
+    stop("file was not written by saveRDS.lgb.Booster")
+  }
+  bst <- Booster$new(model_str = payload$model_str)
+  bst$best_iter <- payload$best_iter
+  bst$record_evals <- payload$record_evals
+  bst
+}

@@ -54,6 +54,9 @@ int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
 int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
                                   int num_iteration, int64_t buffer_len,
                                   int64_t* out_len, char* out_str);
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int64_t buffer_len,
+                          int64_t* out_len, char* out_str);
 int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
                               int data_type, int32_t nrow, int32_t ncol,
                               int is_row_major, int predict_type,
@@ -281,6 +284,28 @@ SEXP LGBMTPU_BoosterSaveModelToString_R(SEXP handle, SEXP num_iteration) {
   return Rf_mkString(buf.data());
 }
 
+SEXP LGBMTPU_BoosterLoadModelFromString_R(SEXP model_str) {
+  BoosterHandle h = nullptr;
+  int n_iters = 0;
+  CheckCall(LGBM_BoosterLoadModelFromString(CHAR(Rf_asChar(model_str)),
+                                            &n_iters, &h),
+            "BoosterLoadModelFromString");
+  return WrapHandle(h, BoosterFinalizer);
+}
+
+SEXP LGBMTPU_BoosterDumpModel_R(SEXP handle, SEXP num_iteration) {
+  int64_t out_len = 0;
+  // first call sizes the buffer
+  LGBM_BoosterDumpModel(R_ExternalPtrAddr(handle), 0,
+                        Rf_asInteger(num_iteration), 0, &out_len, nullptr);
+  std::vector<char> buf((size_t)out_len + 1);
+  CheckCall(LGBM_BoosterDumpModel(R_ExternalPtrAddr(handle), 0,
+                                  Rf_asInteger(num_iteration), out_len + 1,
+                                  &out_len, buf.data()),
+            "BoosterDumpModel");
+  return Rf_mkString(buf.data());
+}
+
 SEXP LGBMTPU_BoosterPredictForMat_R(SEXP handle, SEXP mat, SEXP nrow,
                                     SEXP ncol, SEXP predict_type,
                                     SEXP num_iteration) {
@@ -347,6 +372,8 @@ static const R_CallMethodDef CallEntries[] = {
     {"LGBMTPU_BoosterGetEvalHigherBetter_R", (DL_FUNC)&LGBMTPU_BoosterGetEvalHigherBetter_R, 1},
     {"LGBMTPU_BoosterSaveModel_R", (DL_FUNC)&LGBMTPU_BoosterSaveModel_R, 3},
     {"LGBMTPU_BoosterSaveModelToString_R", (DL_FUNC)&LGBMTPU_BoosterSaveModelToString_R, 2},
+    {"LGBMTPU_BoosterLoadModelFromString_R", (DL_FUNC)&LGBMTPU_BoosterLoadModelFromString_R, 1},
+    {"LGBMTPU_BoosterDumpModel_R", (DL_FUNC)&LGBMTPU_BoosterDumpModel_R, 2},
     {"LGBMTPU_BoosterPredictForMat_R", (DL_FUNC)&LGBMTPU_BoosterPredictForMat_R, 6},
     {"LGBMTPU_BoosterFeatureImportance_R", (DL_FUNC)&LGBMTPU_BoosterFeatureImportance_R, 3},
     {NULL, NULL, 0}};

@@ -647,8 +647,11 @@ def booster_predict_for_file(h, data_filename, data_has_header,
             # same first-line rule as parse_file: skip comments/blanks
             with open(data_filename) as fh:
                 first = fh.readline()
-                while first.startswith("#") or not first.strip():
+                while first and (first.startswith("#")
+                                 or not first.strip()):
                     first = fh.readline()
+            if not first:
+                raise ValueError(f"data file is empty: {data_filename}")
             first = first.strip()
             delim = "," if "," in first else "\t" if "\t" in first else None
             cols = [c.strip() for c in first.split(delim)]

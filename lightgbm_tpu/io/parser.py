@@ -37,16 +37,21 @@ def _is_number(tok: str) -> bool:
 def parse_file(path: str, label_column: int = 0,
                has_header: Optional[bool] = None):
     """Returns (X, y, query_boundaries|None)."""
-    try:
-        from . import native
-        if native.available():
-            return native.parse_file(path, label_column)
-    except Exception:  # pragma: no cover - fall back to numpy path
-        pass
-    with open(path) as f:
+    from .file_io import _scheme_of, open_file
+    is_remote = bool(_scheme_of(path))
+    if not is_remote:
+        try:
+            from . import native
+            if native.available():
+                return native.parse_file(path, label_column)
+        except Exception:  # pragma: no cover - fall back to numpy path
+            pass
+    with open_file(path) as f:
         first = f.readline()
-        while first.startswith("#") or not first.strip():
+        while first and (first.startswith("#") or not first.strip()):
             first = f.readline()
+    if not first:
+        raise ValueError(f"data file is empty: {path}")
     fmt = _detect_format(first)
     if fmt == "libsvm":
         return _parse_libsvm(path)
@@ -55,8 +60,10 @@ def parse_file(path: str, label_column: int = 0,
     toks = first.strip().split(delim)
     header = has_header if has_header is not None else not all(
         _is_number(t) for t in toks if t)
-    data = np.genfromtxt(path, delimiter=delim,
-                         skip_header=1 if header else 0, dtype=np.float64)
+    with open_file(path) as f:
+        data = np.genfromtxt(f, delimiter=delim,
+                             skip_header=1 if header else 0,
+                             dtype=np.float64)
     if data.ndim == 1:
         data = data.reshape(-1, 1)
     if data.shape[1] == 1:
@@ -67,10 +74,11 @@ def parse_file(path: str, label_column: int = 0,
 
 
 def _parse_libsvm(path: str):
+    from .file_io import open_file
     labels = []
     rows = []
     max_feat = -1
-    with open(path) as f:
+    with open_file(path) as f:
         for line in f:
             line = line.strip()
             if not line or line.startswith("#"):

@@ -153,9 +153,7 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "col_bins", "max_depth",
-                     "l1", "l2",
-                     "max_delta_step", "min_data_in_leaf", "min_sum_hessian",
-                     "min_gain_to_split", "bynode_k", "use_pallas"))
+                     "bynode_k", "use_pallas"))
 def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
               grad: jax.Array, hess: jax.Array,   # (N,)
               w: jax.Array,               # (N,) bagging weight (0/1)
@@ -272,7 +270,10 @@ class _CarryC(NamedTuple):
     pos_leaf: jax.Array      # (N + Wmax,) leaf id per physical POSITION
     leaf_begin: jax.Array    # (L,)
     leaf_phys: jax.Array     # (L,) physical rows in the window
-    pool: jax.Array
+    pool: jax.Array          # (K, C, B, 3) — K == L unless slot-capped
+    slot_of: jax.Array       # (L,) pool slot of each leaf, -1 = evicted
+    slot_owner: jax.Array    # (K,) leaf owning each slot, -1 = free
+    slot_last: jax.Array     # (K,) last-use step per slot (LRU clock)
     depth: jax.Array
     leaf_min: jax.Array
     leaf_max: jax.Array
@@ -304,9 +305,7 @@ def _unpack_codes(words: jax.Array, c_cols: int, item_bits: int) -> jax.Array:
     jax.jit,
     static_argnames=("c_cols", "item_bits",
                      "num_leaves", "num_bins", "col_bins", "max_depth",
-                     "l1", "l2", "max_delta_step", "min_data_in_leaf",
-                     "min_sum_hessian", "min_gain_to_split", "bynode_k",
-                     "use_pallas"))
+                     "bynode_k", "use_pallas", "pool_slots"))
 def grow_tree_compact(
         codes_pack: jax.Array,       # (N, CW) u32: packed column codes
         codes_row: jax.Array,        # (N, C) u8/u16 for the root pass
@@ -318,7 +317,8 @@ def grow_tree_compact(
         num_leaves: int, num_bins: int, col_bins: int, max_depth: int,
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
-        min_gain_to_split: float, bynode_k: int, use_pallas: bool):
+        min_gain_to_split: float, bynode_k: int, use_pallas: bool,
+        pool_slots: int = 0):
     return grow_tree_compact_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -328,7 +328,7 @@ def grow_tree_compact(
         l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
-        use_pallas=use_pallas, axis_name=None)
+        use_pallas=use_pallas, axis_name=None, pool_slots=pool_slots)
 
 
 def grow_tree_compact_core(
@@ -342,7 +342,7 @@ def grow_tree_compact_core(
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
-        axis_name=None):
+        axis_name=None, pool_slots: int = 0):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
@@ -359,10 +359,22 @@ def grow_tree_compact_core(
     parent - smaller, FeatureHistogram::Subtract). Dynamic leaf sizes meet
     XLA's static shapes through a small ladder of padded window classes
     (x4 steps) dispatched with lax.switch — each class is traced once.
+
+    pool_slots caps the histogram pool at K slots with on-device LRU
+    eviction — the role of the reference's HistogramPool
+    (src/treelearner/feature_histogram.hpp:654-831), which lets
+    num_leaves scale far past pool memory. On a parent-histogram miss
+    the sibling is rebuilt by a direct masked pass over the larger
+    child's window instead of the subtraction trick. 0 = dense (one
+    slot per leaf, no evictions ever).
     """
     n = grad.shape[0]
     cw = codes_pack.shape[1]
     L = num_leaves
+    # K=1 cannot hold both children of a split (the second allocation
+    # would evict the first and corrupt the sibling subtraction)
+    K = max(2, pool_slots) if 0 < pool_slots < L else L
+    pooled = K < L
     gh = jnp.stack([grad * w, hess * w, w], axis=1)
     node_mask, scan, store_best, scan2, store_best2 = _tree_helpers(
         base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -400,14 +412,18 @@ def grow_tree_compact_core(
     zi = functools.partial(jnp.zeros, dtype=jnp.int32)
     best = jnp.full((L, 12), NEG_INF, jnp.float32).at[:, B_FEAT:].set(0.0)
     best = store_best(best, 0, root_res, jnp.int32(0))
-    pool = jnp.zeros((L, c_cols, col_bins, 3), jnp.float32).at[0].set(hist0)
+    pool = jnp.zeros((K, c_cols, col_bins, 3), jnp.float32).at[0].set(hist0)
     rec = jnp.zeros((L - 1, 13), jnp.float32)
     carry = _CarryC(
         k=jnp.int32(0),
         data=data0,
         pos_leaf=jnp.zeros(n + wmax, jnp.int32),
         leaf_begin=zi(L), leaf_phys=zi(L).at[0].set(n),
-        pool=pool, depth=zi(L),
+        pool=pool,
+        slot_of=jnp.full((L,), -1, jnp.int32).at[0].set(0),
+        slot_owner=jnp.full((K,), -1, jnp.int32).at[0].set(0),
+        slot_last=zi(K),
+        depth=zi(L),
         leaf_min=jnp.full((L,), -np.inf, jnp.float32),
         leaf_max=jnp.full((L,), np.inf, jnp.float32),
         best=best, rec=rec, key=loop_key)
@@ -419,7 +435,7 @@ def grow_tree_compact_core(
         half = (wsz + 1) // 2
 
         def branch(op):
-            c, l, row, new_id = op
+            c, l, row, new_id, need_other = op
             feat = row[B_FEAT].astype(jnp.int32)
             begin = c.leaf_begin[l]
             pcount = c.leaf_phys[l]
@@ -496,7 +512,34 @@ def grow_tree_compact_core(
 
             hist_small = jax.lax.cond(s_count <= half, hist_half, hist_full,
                                       operand=None)
-            return data, pos_leaf, leaf_begin, leaf_phys, hist_small
+
+            # pooled mode, parent-histogram miss: the sibling cannot come
+            # from subtraction, so build the LARGER child's histogram
+            # directly with a masked pass over the window (reference
+            # HistogramPool miss -> ConstructHistograms re-run)
+            if pooled:
+                o_begin = jnp.where(left_small, lphys, 0)
+                o_count = pcount - s_count
+
+                def hist_other_fn(_):
+                    s_codes = _unpack_codes(win_sorted[:, :cw], c_cols,
+                                            item_bits)
+                    j = jnp.arange(wsz, dtype=jnp.int32)
+                    sv = ((j >= o_begin)
+                          & (j < o_begin + o_count)).astype(jnp.float32)
+                    s_gh = jax.lax.bitcast_convert_type(
+                        win_sorted[:, cw:cw + 3], jnp.float32) * sv[:, None]
+                    return build_histogram(s_codes, s_gh, col_bins,
+                                           use_pallas=use_pallas)
+
+                hist_other = jax.lax.cond(
+                    need_other, hist_other_fn,
+                    lambda _: jnp.zeros((c_cols, col_bins, 3), jnp.float32),
+                    operand=None)
+            else:
+                hist_other = jnp.zeros((c_cols, col_bins, 3), jnp.float32)
+            return data, pos_leaf, leaf_begin, leaf_phys, hist_small, \
+                hist_other
         return branch
 
     branches = [make_branch(wsz) for wsz in classes]
@@ -508,21 +551,67 @@ def grow_tree_compact_core(
         new_id = c.k + 1
         feat = row[B_FEAT].astype(jnp.int32)
         pcount = c.leaf_phys[l]
+        slot_l = c.slot_of[l]
+        have_parent = slot_l >= 0
         j = jnp.sum((pcount > thresholds).astype(jnp.int32))
-        data, pos_leaf, leaf_begin, leaf_phys, hist_small = jax.lax.switch(
-            j, branches, (c, l, row, new_id))
+        data, pos_leaf, leaf_begin, leaf_phys, hist_small, hist_other = \
+            jax.lax.switch(j, branches, (c, l, row, new_id, ~have_parent))
         if axis_name is not None:
             # the reference reduce-scatters per-machine histograms
             # (data_parallel_tree_learner.cpp:149-164); psum over ICI is
             # the dense equivalent and leaves the sums replicated for the
-            # identical best-split scan on every shard
-            hist_small = jax.lax.psum(hist_small, axis_name)
+            # identical best-split scan on every shard. The miss-path
+            # histogram reduces in the same psum so no shard ever takes
+            # a collective the others skip.
+            if pooled:
+                hist_small, hist_other = jax.lax.psum(
+                    (hist_small, hist_other), axis_name)
+            else:
+                hist_small = jax.lax.psum(hist_small, axis_name)
 
         left_small = row[B_LCNT] <= row[B_RCNT]
-        parent = c.pool[l]
-        hist_l = jnp.where(left_small, hist_small, parent - hist_small)
-        hist_r = jnp.where(left_small, parent - hist_small, hist_small)
-        pool = c.pool.at[l].set(hist_l).at[new_id].set(hist_r)
+        parent = (c.pool[jnp.clip(slot_l, 0, K - 1)] if pooled
+                  else c.pool[l])
+        sibling = jnp.where(have_parent, parent - hist_small, hist_other) \
+            if pooled else parent - hist_small
+        hist_l = jnp.where(left_small, hist_small, sibling)
+        hist_r = jnp.where(left_small, sibling, hist_small)
+
+        # pool slot bookkeeping: l reuses its parent slot when cached,
+        # otherwise allocates; new_id always allocates. Allocation takes
+        # a free slot first, else evicts the least-recently-used (the
+        # reference HistogramPool's Get/Move semantics).
+        step = new_id
+        if pooled:
+            iarangeK = jnp.arange(K, dtype=jnp.int32)
+
+            def alloc(slot_of, slot_owner, slot_last, forbid, want):
+                score = jnp.where(slot_owner < 0, jnp.int32(-1), slot_last)
+                score = jnp.where(iarangeK == forbid,
+                                  jnp.iinfo(jnp.int32).max, score)
+                s = jnp.argmin(score).astype(jnp.int32)
+                old = slot_owner[s]
+                safe_old = jnp.clip(old, 0, L - 1)
+                slot_of = slot_of.at[safe_old].set(
+                    jnp.where(want & (old >= 0), -1, slot_of[safe_old]))
+                return s, slot_of
+
+            s_l_new, slot_of = alloc(c.slot_of, c.slot_owner, c.slot_last,
+                                     jnp.int32(-1), ~have_parent)
+            s_l = jnp.where(have_parent, slot_l, s_l_new)
+            slot_of = slot_of.at[l].set(s_l)
+            slot_owner = c.slot_owner.at[s_l].set(l)
+            slot_last = c.slot_last.at[s_l].set(step)
+            s_r, slot_of = alloc(slot_of, slot_owner, slot_last, s_l,
+                                 jnp.bool_(True))
+            slot_of = slot_of.at[new_id].set(s_r)
+            slot_owner = slot_owner.at[s_r].set(new_id)
+            slot_last = slot_last.at[s_r].set(step)
+        else:
+            s_l, s_r = l, new_id
+            slot_of = c.slot_of
+            slot_owner, slot_last = c.slot_owner, c.slot_last
+        pool = c.pool.at[s_l].set(hist_l).at[s_r].set(hist_r)
 
         # monotone propagation + depth (same as masked strategy)
         mono_f = f_monotone[feat]
@@ -552,7 +641,8 @@ def grow_tree_compact_core(
                      jnp.stack([kl, kr]))
         best2 = store_best2(b, jnp.stack([l, new_id]), res2, child_depth)
         return _CarryC(new_id, data, pos_leaf, leaf_begin, leaf_phys,
-                       pool, depth, leaf_min, leaf_max, best2, rec2, key)
+                       pool, slot_of, slot_owner, slot_last,
+                       depth, leaf_min, leaf_max, best2, rec2, key)
 
     out = jax.lax.while_loop(cond, body, carry)
     # final row -> leaf map: scatter physical-position leaves onto row ids
@@ -645,20 +735,60 @@ class DeviceTreeLearner:
         if strat == "auto":
             strat = "compact" if dataset.num_data >= 65536 else "masked"
         self.strategy = strat
+        # LRU-capped histogram pool (reference HistogramPool,
+        # feature_histogram.hpp:654-831): when the dense (L,C,B,3) pool
+        # would exceed the budget, the compact strategy runs with K LRU
+        # slots and rebuilds sibling histograms on miss
+        ncols_pool = (len(dataset.columns) if dataset.columns
+                      else self.num_features)
+        slot_bytes = max(1, ncols_pool) * self.col_device_bins * 12
+        # histogram_pool_size is the reference's knob (MB, < 0 = no
+        # explicit limit); without it we default to a 1 GiB HBM budget
+        if config.histogram_pool_size and config.histogram_pool_size > 0:
+            budget = int(config.histogram_pool_size * (1 << 20))
+        else:
+            budget = 1 << 30
+        k_cap = max(8, budget // slot_bytes)
+        L = int(config.num_leaves)
+        self.pool_slots = k_cap if L > k_cap else 0
         if self.strategy == "compact":
             host_codes = (dataset.bundled if dataset.bundled is not None
                           else dataset.binned)
             host_codes = np.asarray(host_codes)
             # bit-pack column codes into u32 words for the physically
-            # reordered working buffer (4 u8 or 2 u16 codes per word)
-            self.item_bits = 16 if host_codes.dtype.itemsize == 2 else 8
-            per = 32 // self.item_bits
+            # reordered working buffer (8 4-bit, 4 u8, or 2 u16 codes per
+            # word). The 4-bit form is the reference's Dense4bitsBin
+            # (src/io/dense_nbits_bin.hpp) — usable whenever every
+            # column's codes fit a nibble (max_bin <= 16), halving HBM
+            # traffic per partition pass.
+            # decide from DECLARED per-column bin counts, not the data:
+            # a data-dependent choice would let rank-partitioned shards
+            # disagree on the packed layout (divergent traced programs)
+            if dataset.columns:
+                declared_bins = max(c.num_bins for c in dataset.columns)
+            else:
+                declared_bins = int(dataset.max_num_bins)
+            if host_codes.dtype.itemsize == 2:
+                self.item_bits = 16
+            elif declared_bins <= 16:
+                self.item_bits = 4
+            else:
+                self.item_bits = 8
             nrow, ncol = host_codes.shape
-            padded = np.zeros((nrow, ((ncol + per - 1) // per) * per),
-                              dtype=np.uint8 if self.item_bits == 8
-                              else np.uint16)
-            padded[:, :ncol] = host_codes
-            packed = np.ascontiguousarray(padded).view(np.uint32)
+            if self.item_bits == 4:
+                npairs = ((ncol + 7) // 8) * 4      # byte pairs per row
+                byte_arr = np.zeros((nrow, npairs * 2), dtype=np.uint8)
+                byte_arr[:, :ncol] = host_codes
+                packed_bytes = (byte_arr[:, 0::2]
+                                | (byte_arr[:, 1::2] << 4)).astype(np.uint8)
+                packed = np.ascontiguousarray(packed_bytes).view(np.uint32)
+            else:
+                per = 32 // self.item_bits
+                padded = np.zeros((nrow, ((ncol + per - 1) // per) * per),
+                                  dtype=np.uint8 if self.item_bits == 8
+                                  else np.uint16)
+                padded[:, :ncol] = host_codes
+                packed = np.ascontiguousarray(padded).view(np.uint32)
             self.c_cols = ncol
             if device_place:
                 self.codes_row = jnp.asarray(host_codes)      # (N, C)
@@ -763,7 +893,7 @@ class DeviceTreeLearner:
                 self.f_monotone, self.f_penalty, self.f_col, self.f_base,
                 self.f_elide, self.hist_idx, key,
                 c_cols=self.c_cols, item_bits=self.item_bits,
-                **self._statics())
+                pool_slots=self.pool_slots, **self._statics())
         else:
             rec, leaf_id, n_splits, _ = grow_tree(
                 self.codes_t, grad, hess, w, base_mask,
@@ -840,7 +970,8 @@ class DeviceTreeLearner:
                 rec, leaf_id, k, _ = grow(
                     self.codes_pack, self.codes_row, g, h, w, base_mask,
                     *meta, tree_key, c_cols=self.c_cols,
-                    item_bits=self.item_bits, **statics)
+                    item_bits=self.item_bits,
+                    pool_slots=self.pool_slots, **statics)
             else:
                 rec, leaf_id, k, _ = grow(
                     self.codes_t, g, h, w, base_mask, *meta, tree_key,

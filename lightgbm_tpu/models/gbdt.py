@@ -591,7 +591,8 @@ class GBDT:
 
     def save_model(self, filename: str, num_iteration: int = -1,
                    start_iteration: int = 0) -> None:
-        with open(filename, "w") as f:
+        from ..io.file_io import open_file
+        with open_file(filename, "w") as f:
             f.write(self.save_model_to_string(start_iteration, num_iteration))
 
     @classmethod
@@ -634,7 +635,8 @@ class GBDT:
     @classmethod
     def load_model(cls, filename: str,
                    config: Optional[Config] = None) -> "GBDT":
-        with open(filename) as f:
+        from ..io.file_io import open_file
+        with open_file(filename) as f:
             return cls.load_model_from_string(f.read(), config)
 
     def dump_model(self, num_iteration: Optional[int] = None,

@@ -45,9 +45,7 @@ def _scan(hist, sg, sh, cnt, meta, min_c, max_c, scan_kwargs, cost=None):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("bucket", "num_bins", "l1", "l2", "max_delta_step",
-                     "min_data_in_leaf", "min_sum_hessian",
-                     "min_gain_to_split", "use_pallas"),
+    static_argnames=("bucket", "num_bins", "use_pallas"),
     donate_argnames=("indices_buf",))
 def fused_split_step(
     indices_buf: jax.Array,      # (N + max_bucket,) partition permutation
@@ -124,9 +122,7 @@ def fused_split_step(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("bucket", "num_bins", "l1", "l2", "max_delta_step",
-                     "min_data_in_leaf", "min_sum_hessian",
-                     "min_gain_to_split", "use_pallas"))
+    static_argnames=("bucket", "num_bins", "use_pallas"))
 def fused_root_step(
     indices_buf: jax.Array, binned: jax.Array,
     grad: jax.Array, hess: jax.Array, count: jax.Array,

@@ -53,9 +53,9 @@ def _threshold_l1(s, l1):
 
 def _leaf_output(sum_grad, sum_hess, l1, l2, max_delta_step):
     out = -_threshold_l1(sum_grad, l1) / (sum_hess + l2)
-    if max_delta_step > 0.0:
-        out = jnp.clip(out, -max_delta_step, max_delta_step)
-    return out
+    # max_delta_step <= 0 means unbounded (traced-scalar-safe clip)
+    limit = jnp.where(max_delta_step > 0.0, max_delta_step, jnp.inf)
+    return jnp.clip(out, -limit, limit)
 
 
 def _leaf_output_constrained(sum_grad, sum_hess, l1, l2, max_delta_step,
@@ -233,8 +233,7 @@ def materialize_split(feat, per_feature_rel, per_feature_t, use_m1, prefix,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_bins", "l1", "l2", "max_delta_step",
-                     "min_data_in_leaf", "min_sum_hessian", "min_gain_to_split"))
+    static_argnames=("num_bins",))
 def find_best_split(
     hist: jax.Array, sum_grad: jax.Array, sum_hess: jax.Array,
     num_data: jax.Array, feature_num_bins: jax.Array,
@@ -280,10 +279,7 @@ class CatSplitResult(NamedTuple):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_bins", "l1", "l2", "cat_l2", "cat_smooth",
-                     "max_delta_step", "min_data_in_leaf", "min_sum_hessian",
-                     "min_gain_to_split", "max_cat_threshold",
-                     "max_cat_to_onehot", "min_data_per_group"))
+    static_argnames=("num_bins",))
 def find_best_split_categorical(
     hist: jax.Array, sum_grad: jax.Array, sum_hess: jax.Array,
     num_data: jax.Array, feature_num_bins: jax.Array,
@@ -415,9 +411,9 @@ def find_best_split_categorical(
     w_l2 = jnp.where(use_onehot[feat], l2, eff_l2)
     lo = jnp.clip(-_threshold_l1(lg, l1) / (lh + w_l2), min_constraint, max_constraint)
     ro = jnp.clip(-_threshold_l1(rg, l1) / (rh + w_l2), min_constraint, max_constraint)
-    if max_delta_step > 0:
-        lo = jnp.clip(lo, -max_delta_step, max_delta_step)
-        ro = jnp.clip(ro, -max_delta_step, max_delta_step)
+    limit = jnp.where(max_delta_step > 0, max_delta_step, jnp.inf)
+    lo = jnp.clip(lo, -limit, limit)
+    ro = jnp.clip(ro, -limit, limit)
     rel_gain = jnp.where(gain > NEG_INF / 2, gain - min_gain_shift, NEG_INF)
     return CatSplitResult(rel_gain, feat, left_mask, lg, lh, lc,
                           rg, rh, rc, lo, ro)

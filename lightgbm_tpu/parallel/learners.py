@@ -499,7 +499,7 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     # ------------------------------------------------------------------
     def _grow_statics(self):
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
-                    **self._statics())
+                    pool_slots=self.pool_slots, **self._statics())
 
     def _sharded_tree_fn(self, with_bag_key: bool):
         """shard_map'd whole-tree program. with_bag_key=True computes the

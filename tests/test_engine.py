@@ -372,6 +372,82 @@ def test_device_strategies_agree_exactly():
         bc.predict(x[:300], raw_score=True), rtol=1e-5, atol=1e-6)
 
 
+def test_device_strategies_agree_4bit_packing():
+    """max_bin <= 16 switches the compact buffer to 4-bit nibble packing
+    (reference: src/io/dense_nbits_bin.hpp Dense4bitsBin); the packed
+    program must agree with the masked strategy exactly."""
+    import os
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models.device_learner import DeviceTreeLearner
+    r = np.random.RandomState(11)
+    x = r.randn(2500, 9).astype(np.float32)
+    x[r.rand(2500, 9) < 0.08] = np.nan
+    y = (np.nan_to_num(x[:, 0]) - np.nan_to_num(x[:, 2]) > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 14,
+              "verbosity": -1, "min_data_in_leaf": 5}
+
+    def run(strategy):
+        os.environ["LGBM_TPU_STRATEGY"] = strategy
+        try:
+            b = lgb.Booster(params=params, train_set=lgb.Dataset(x, y))
+            for _ in range(3):
+                b.update()
+            return b
+        finally:
+            os.environ.pop("LGBM_TPU_STRATEGY", None)
+
+    bm, bc = run("masked"), run("compact")
+    lrn = bc._gbdt.learner
+    assert isinstance(lrn, DeviceTreeLearner) and lrn.item_bits == 4, \
+        "max_bin=14 must select nibble packing"
+    for tm, tc in zip(bm._gbdt.models, bc._gbdt.models):
+        assert tm.num_leaves == tc.num_leaves
+        for i in range(tm.num_leaves - 1):
+            assert int(tm.split_feature[i]) == int(tc.split_feature[i])
+            assert int(tm.threshold_in_bin[i]) == int(tc.threshold_in_bin[i])
+    np.testing.assert_allclose(
+        bm.predict(x[:300], raw_score=True),
+        bc.predict(x[:300], raw_score=True), rtol=1e-5, atol=1e-6)
+
+
+def test_lru_histogram_pool_matches_dense():
+    """The slot-capped LRU histogram pool (role of the reference's
+    HistogramPool, feature_histogram.hpp:654-831) must grow identical
+    trees to the dense one-slot-per-leaf pool, even under heavy eviction
+    (6 slots for 31 leaves -> constant misses + direct sibling rebuilds)."""
+    import os
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models.device_learner import DeviceTreeLearner
+    r = np.random.RandomState(21)
+    x = r.randn(2500, 6).astype(np.float32)
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 31,
+              "verbosity": -1, "min_data_in_leaf": 5}
+
+    def run(pool_slots):
+        os.environ["LGBM_TPU_STRATEGY"] = "compact"
+        try:
+            b = lgb.Booster(params=params, train_set=lgb.Dataset(x, y))
+            lrn = b._gbdt.learner
+            assert isinstance(lrn, DeviceTreeLearner)
+            lrn.pool_slots = pool_slots
+            for _ in range(3):
+                b.update()
+            return b
+        finally:
+            os.environ.pop("LGBM_TPU_STRATEGY", None)
+
+    bd, bp = run(0), run(6)
+    for td, tp in zip(bd._gbdt.models, bp._gbdt.models):
+        assert td.num_leaves == tp.num_leaves
+        for i in range(td.num_leaves - 1):
+            assert int(td.split_feature[i]) == int(tp.split_feature[i])
+            assert int(td.threshold_in_bin[i]) == int(tp.threshold_in_bin[i])
+    np.testing.assert_allclose(
+        bd.predict(x[:200], raw_score=True),
+        bp.predict(x[:200], raw_score=True), rtol=1e-4, atol=1e-5)
+
+
 def test_fused_iteration_matches_generic_path():
     """The single-program fused device iteration must equal the generic
     (multi-dispatch) path tree-for-tree."""
