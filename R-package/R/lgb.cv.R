@@ -47,6 +47,11 @@ lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
       ev <- b$eval(1L)
       if (length(ev) > 0) ev[[1]] else NA_real_
     }, numeric(1))
+    if (anyNA(scores)) {
+      # metric="none" / objective without a default metric: nothing to
+      # record or stop on, just keep boosting
+      next
+    }
     if (i == 1L) {
       hb <- tryCatch(boosters[[1]]$eval_higher_better(),
                      error = function(e) logical(0))

@@ -17,13 +17,24 @@ lgb.interprete <- function(booster, data, idxset = 1L) {
   if (is.null(dim(contrib))) {
     contrib <- matrix(contrib, nrow = nrow(sub), byrow = TRUE)
   }
-  nfeat <- ncol(contrib) - 1L
+  # multiclass contrib rows are (nfeat + 1) * nclass wide: one
+  # (contributions..., bias) block per class
+  nfeat <- ncol(data)
+  nclass <- ncol(contrib) %/% (nfeat + 1L)
   fnames <- colnames(data)
   if (is.null(fnames)) fnames <- paste0("Column_", seq_len(nfeat) - 1L)
   lapply(seq_len(nrow(sub)), function(i) {
-    vals <- contrib[i, seq_len(nfeat)]
-    ord <- order(abs(vals), decreasing = TRUE)
-    data.frame(Feature = fnames[ord], Contribution = vals[ord],
-               stringsAsFactors = FALSE)
+    per_class <- lapply(seq_len(nclass), function(k) {
+      off <- (k - 1L) * (nfeat + 1L)
+      vals <- contrib[i, off + seq_len(nfeat)]
+      ord <- order(abs(vals), decreasing = TRUE)
+      df <- data.frame(Feature = fnames[ord], Contribution = vals[ord],
+                       stringsAsFactors = FALSE)
+      if (nclass > 1L) names(df)[2L] <- paste0("Class_", k - 1L)
+      df
+    })
+    if (nclass == 1L) per_class[[1L]] else Reduce(function(a, b) {
+      cbind(a, b[match(a$Feature, b$Feature), 2L, drop = FALSE])
+    }, per_class)
   })
 }

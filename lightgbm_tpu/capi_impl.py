@@ -645,7 +645,8 @@ def booster_predict_for_file(h, data_filename, data_has_header,
             # config.h label_column doc: names require has_header)
             name = label_col[5:]
             # same first-line rule as parse_file: skip comments/blanks
-            with open(data_filename) as fh:
+            from .io.file_io import open_file
+            with open_file(data_filename) as fh:
                 first = fh.readline()
                 while first and (first.startswith("#")
                                  or not first.strip()):
@@ -670,7 +671,8 @@ def booster_predict_for_file(h, data_filename, data_has_header,
         **kwargs)
     preds = np.asarray(preds, dtype=np.float64)
     rows = preds[:, None] if preds.ndim == 1 else preds
-    with open(result_filename, "w") as fh:
+    from .io.file_io import open_file
+    with open_file(result_filename, "w") as fh:
         for row in rows:
             fh.write("\t".join(repr(float(v)) for v in row) + "\n")
     return 0

@@ -147,7 +147,7 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
                                           child_depth=child_depth))(res2)
         return best.at[i2].set(rows)
 
-    return node_mask, scan, store_best, scan2, store_best2
+    return node_mask, scan, store_best, scan2, store_best2, _best_row
 
 
 @functools.partial(
@@ -172,7 +172,7 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
     f = f_numbins.shape[0]
     L = num_leaves
     gh = jnp.stack([grad * w, hess * w, w], axis=1)     # (N, 3)
-    node_mask, scan, store_best, scan2, store_best2 = _tree_helpers(
+    node_mask, scan, store_best, scan2, store_best2, _ = _tree_helpers(
         base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
         f_elide, hist_idx,
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
@@ -342,7 +342,7 @@ def grow_tree_compact_core(
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
-        axis_name=None, pool_slots: int = 0):
+        axis_name=None, pool_slots: int = 0, scatter_cols: int = 0):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
@@ -367,6 +367,16 @@ def grow_tree_compact_core(
     the sibling is rebuilt by a direct masked pass over the larger
     child's window instead of the subtraction trick. 0 = dense (one
     slot per leaf, no evictions ever).
+
+    scatter_cols (= shard count, 0 = off) switches the data-parallel
+    histogram reduction from replicating psum to the reference's comm
+    pattern (data_parallel_tree_learner.cpp:149-200): lax.psum_scatter
+    tiles the column axis so each shard owns C/D columns of every
+    histogram (pool memory /D, reduce traffic ~halved), runs the split
+    scan on its slice only, and the global winner is elected from a
+    tiny (D, 12) all_gather of per-shard candidates — the analog of
+    SyncUpGlobalBestSplit. Requires identity column mapping (no EFB
+    bundles) and no by-node feature sampling; callers gate on that.
     """
     n = grad.shape[0]
     cw = codes_pack.shape[1]
@@ -376,13 +386,93 @@ def grow_tree_compact_core(
     K = max(2, pool_slots) if 0 < pool_slots < L else L
     pooled = K < L
     gh = jnp.stack([grad * w, hess * w, w], axis=1)
-    node_mask, scan, store_best, scan2, store_best2 = _tree_helpers(
-        base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
-        f_elide, hist_idx,
+    helper_kwargs = dict(
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
         max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
         bynode_k=bynode_k)
+    scatter = scatter_cols > 1 and axis_name is not None
+
+    if not scatter:
+        (node_mask, scan, store_best, scan2, store_best2,
+         best_row) = _tree_helpers(
+            base_mask, f_numbins, f_missing, f_default, f_monotone,
+            f_penalty, f_elide, hist_idx, **helper_kwargs)
+
+        def reduce_hist(h):
+            return jax.lax.psum(h, axis_name) if axis_name is not None else h
+
+        def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
+            res = scan(col_hist, sg, sh, cnt, mn, mx, node_mask(key))
+            return best_row(res, child_depth)
+
+        def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
+                         child_depth):
+            res2 = scan2(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2)
+            return jax.vmap(
+                functools.partial(best_row, child_depth=child_depth))(res2)
+    else:
+        # feature-sliced scan: every shard searches only the columns it
+        # owns after the reduce-scatter, then candidates are elected
+        D = scatter_cols
+        f_all = int(f_numbins.shape[0])
+        assert f_all == c_cols, \
+            "scatter_cols requires identity feature->column mapping"
+        cs = -(-c_cols // D)                # columns per shard (padded)
+        c_pad = cs * D
+        shard = jax.lax.axis_index(axis_name)
+        start = (shard * cs).astype(jnp.int32)
+
+        def pad1(a, fill):
+            return jnp.pad(a, (0, c_pad - f_all), constant_values=fill)
+
+        def sl(a):
+            return jax.lax.dynamic_slice_in_dim(a, start, cs)
+
+        mask_sl = sl(pad1(base_mask, False))
+        nb_sl = sl(pad1(f_numbins, 1))
+        miss_sl = sl(pad1(f_missing, 0))
+        def_sl = sl(pad1(f_default, 0))
+        mono_sl = sl(pad1(f_monotone, 0))
+        pen_sl = sl(pad1(f_penalty, 1.0))
+        elide_sl = sl(pad1(f_elide, 0))
+        # local expansion gather for the slice's flattened (cs*B + 1)
+        # column histogram (identity mapping: feature j bin b -> j*B + b)
+        hi_local = (jnp.arange(cs, dtype=jnp.int32)[:, None] * col_bins
+                    + jnp.arange(col_bins, dtype=jnp.int32)[None, :])
+        hi_local = jnp.where(
+            jnp.arange(col_bins, dtype=jnp.int32)[None, :] < nb_sl[:, None],
+            hi_local, cs * col_bins)
+        (_, scan_sl, _, _, _, best_row) = _tree_helpers(
+            mask_sl, nb_sl, miss_sl, def_sl, mono_sl, pen_sl, elide_sl,
+            hi_local, **helper_kwargs)
+
+        def reduce_hist(h):
+            h = jnp.pad(h, ((0, c_pad - c_cols), (0, 0), (0, 0)))
+            return jax.lax.psum_scatter(h, axis_name, scatter_dimension=0,
+                                        tiled=True)
+
+        def _elect(row):
+            rows = jax.lax.all_gather(row, axis_name)        # (D, 12)
+            return rows[jnp.argmax(rows[:, B_GAIN])]
+
+        def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
+            res = scan_sl(col_hist, sg, sh, cnt, mn, mx, mask_sl)
+            row = best_row(res, child_depth)
+            row = row.at[B_FEAT].add(start.astype(jnp.float32))
+            return _elect(row)
+
+        def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
+                         child_depth):
+            res2 = jax.vmap(scan_sl)(
+                col_hist2, sg2, sh2, cnt2, mn2, mx2,
+                jnp.broadcast_to(mask_sl, (2,) + mask_sl.shape))
+            rows = jax.vmap(
+                functools.partial(best_row, child_depth=child_depth))(res2)
+            rows = rows.at[:, B_FEAT].add(start.astype(jnp.float32))
+            g = jax.lax.all_gather(rows, axis_name)          # (D, 2, 12)
+            win = jnp.argmax(g[:, :, B_GAIN], axis=0)        # (2,)
+            return g[win, jnp.arange(2)]
 
     classes = _size_classes(n)
     wmax = classes[-1]
@@ -401,18 +491,24 @@ def grow_tree_compact_core(
     # ---- root ------------------------------------------------------------
     from ..ops.histogram import build_histogram
     hist0 = build_histogram(codes_row, gh, col_bins, use_pallas=use_pallas)
-    if axis_name is not None:
-        hist0 = jax.lax.psum(hist0, axis_name)
-    totals = hist0[0].sum(axis=0)
+    if scatter:
+        # global totals first (the slice no longer carries column 0
+        # everywhere), then tile the columns across shards
+        totals = jax.lax.psum(hist0[0].sum(axis=0), axis_name)
+        hist0 = reduce_hist(hist0)
+    else:
+        hist0 = reduce_hist(hist0)
+        totals = hist0[0].sum(axis=0)
+    pool_c = hist0.shape[0]
     root_key, loop_key = jax.random.split(rng_key)
-    root_res = scan(hist0, totals[0], totals[1], totals[2],
-                    jnp.float32(-np.inf), jnp.float32(np.inf),
-                    node_mask(root_key))
+    row0 = search_row(hist0, totals[0], totals[1], totals[2],
+                      jnp.float32(-np.inf), jnp.float32(np.inf),
+                      root_key, jnp.int32(0))
 
     zi = functools.partial(jnp.zeros, dtype=jnp.int32)
     best = jnp.full((L, 12), NEG_INF, jnp.float32).at[:, B_FEAT:].set(0.0)
-    best = store_best(best, 0, root_res, jnp.int32(0))
-    pool = jnp.zeros((K, c_cols, col_bins, 3), jnp.float32).at[0].set(hist0)
+    best = best.at[0].set(row0)
+    pool = jnp.zeros((K, pool_c, col_bins, 3), jnp.float32).at[0].set(hist0)
     rec = jnp.zeros((L - 1, 13), jnp.float32)
     carry = _CarryC(
         k=jnp.int32(0),
@@ -557,17 +653,15 @@ def grow_tree_compact_core(
         data, pos_leaf, leaf_begin, leaf_phys, hist_small, hist_other = \
             jax.lax.switch(j, branches, (c, l, row, new_id, ~have_parent))
         if axis_name is not None:
-            # the reference reduce-scatters per-machine histograms
-            # (data_parallel_tree_learner.cpp:149-164); psum over ICI is
-            # the dense equivalent and leaves the sums replicated for the
-            # identical best-split scan on every shard. The miss-path
-            # histogram reduces in the same psum so no shard ever takes
-            # a collective the others skip.
+            # cross-shard histogram reduction: psum replicates (dense
+            # equivalent of the reference's reduce-scatter, scan runs
+            # identically everywhere); scatter mode IS the reference's
+            # pattern (each shard owns its column tile). The miss-path
+            # histogram reduces alongside so no shard ever takes a
+            # collective the others skip.
+            hist_small = reduce_hist(hist_small)
             if pooled:
-                hist_small, hist_other = jax.lax.psum(
-                    (hist_small, hist_other), axis_name)
-            else:
-                hist_small = jax.lax.psum(hist_small, axis_name)
+                hist_other = reduce_hist(hist_other)
 
         left_small = row[B_LCNT] <= row[B_RCNT]
         parent = (c.pool[jnp.clip(slot_l, 0, K - 1)] if pooled
@@ -633,13 +727,14 @@ def grow_tree_compact_core(
         rec2 = c.rec.at[c.k].set(rec_row)
 
         key, kl, kr = jax.random.split(c.key, 3)
-        res2 = scan2(jnp.stack([hist_l, hist_r]),
-                     jnp.stack([row[B_LSG], row[B_RSG]]),
-                     jnp.stack([row[B_LSH], row[B_RSH]]),
-                     jnp.stack([row[B_LCNT], row[B_RCNT]]),
-                     jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
-                     jnp.stack([kl, kr]))
-        best2 = store_best2(b, jnp.stack([l, new_id]), res2, child_depth)
+        rows2 = search2_rows(jnp.stack([hist_l, hist_r]),
+                             jnp.stack([row[B_LSG], row[B_RSG]]),
+                             jnp.stack([row[B_LSH], row[B_RSH]]),
+                             jnp.stack([row[B_LCNT], row[B_RCNT]]),
+                             jnp.stack([lmin, rmin]),
+                             jnp.stack([lmax, rmax]),
+                             jnp.stack([kl, kr]), child_depth)
+        best2 = b.at[jnp.stack([l, new_id])].set(rows2)
         return _CarryC(new_id, data, pos_leaf, leaf_begin, leaf_phys,
                        pool, slot_of, slot_owner, slot_last,
                        depth, leaf_min, leaf_max, best2, rec2, key)
@@ -832,8 +927,22 @@ class DeviceTreeLearner:
             raw_bins = int(dataset.max_num_bins)
         nb = 1 << max(4, (raw_bins - 1).bit_length())
         device_bins = min(nb, 256) if raw_bins <= 256 else nb
-        pool_bytes = config.num_leaves * ncols * device_bins * 3 * 4
-        if pool_bytes > _POOL_BYTE_LIMIT:
+        slot_bytes = ncols * device_bins * 3 * 4
+        # the compact strategy caps the pool at K LRU slots (__init__
+        # pool_slots math), so its footprint never exceeds the budget;
+        # only the masked strategy's dense (L, C, B, 3) pool can blow up
+        strat = _env("LGBM_TPU_STRATEGY", "auto")
+        if strat == "auto":
+            strat = "compact" if dataset.num_data >= 65536 else "masked"
+        if strat == "compact":
+            if config.histogram_pool_size and config.histogram_pool_size > 0:
+                budget = int(config.histogram_pool_size * (1 << 20))
+            else:
+                budget = 1 << 30
+            slots = min(int(config.num_leaves), max(8, budget // slot_bytes))
+        else:
+            slots = int(config.num_leaves)
+        if slots * slot_bytes > _POOL_BYTE_LIMIT:
             return False
         return True
 

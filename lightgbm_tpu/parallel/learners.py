@@ -46,6 +46,7 @@ from ..models.tree import Tree
 from ..ops import histogram as hist_ops
 from ..ops import split as split_ops
 from ..utils import log
+from ..utils.envs import dp_reduce_mode_env
 from .mesh import make_mesh
 
 
@@ -462,12 +463,22 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     The reference's per-split communication — ReduceScatter of all local
     histograms plus an Allreduce of the best split (reference:
     src/treelearner/data_parallel_tree_learner.cpp:149-164, :246
-    SyncUpGlobalBestSplit) — collapses into ONE psum of the smaller
-    child's (C, B, 3) histogram per split, after which every shard runs
-    the identical replicated argmax/scan, so the global-best sync costs
-    nothing extra. Each shard physically partitions only its own rows
-    (local DataPartition semantics, :256-262 global leaf counts come from
-    the summed histograms). No host round-trips inside a tree.
+    SyncUpGlobalBestSplit) — maps to ONE collective over the smaller
+    child's (C, B, 3) histogram per split. Two reduction modes:
+
+    * psum (fallback): the histogram is summed and replicated; every
+      shard runs the identical argmax/scan, so the global-best sync
+      costs nothing extra.
+    * reduce-scatter (default when the dataset has no EFB bundles and
+      no by-node sampling): lax.psum_scatter tiles the histogram's
+      column axis across shards — each shard owns C/D columns of every
+      pool slot (pool memory /D, ~half the reduce traffic), scans its
+      slice, and the winner is elected from a (D, 12) all_gather of
+      candidate rows, exactly the reference's comm pattern.
+
+    Each shard physically partitions only its own rows (local
+    DataPartition semantics, :256-262 global leaf counts come from the
+    reduced histograms). No host round-trips inside a tree.
     """
 
     def __init__(self, config: Config, dataset: Dataset,
@@ -476,6 +487,16 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                          device_place=False)
         self.mesh = mesh or make_mesh(axis_name="data")
         self.shards = int(self.mesh.devices.size)
+        # reduce-scatter mode needs the identity feature->column mapping
+        # and shard-independent feature masks (see grow_tree_compact_core)
+        mode = dp_reduce_mode_env()
+        self.scatter_cols = (
+            self.shards if (mode != "psum"
+                            and dataset.bundle_arrays() is None
+                            and not (0.0 < config.feature_fraction_bynode
+                                     < 1.0)
+                            and self.shards > 1)
+            else 0)
         n = dataset.num_data
         self.local_n = -(-n // self.shards)
         self.n_pad = self.local_n * self.shards
@@ -499,7 +520,8 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     # ------------------------------------------------------------------
     def _grow_statics(self):
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
-                    pool_slots=self.pool_slots, **self._statics())
+                    pool_slots=self.pool_slots,
+                    scatter_cols=self.scatter_cols, **self._statics())
 
     def _sharded_tree_fn(self, with_bag_key: bool):
         """shard_map'd whole-tree program. with_bag_key=True computes the

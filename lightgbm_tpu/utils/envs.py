@@ -14,3 +14,9 @@ def use_pallas_env() -> bool:
     """Opt-in to the Pallas histogram kernel (both learners honor both
     spellings; the XLA one-hot path measured faster on v5e so default off)."""
     return flag("LGBM_TPU_PALLAS") or flag("LGBM_TPU_PALLAS_HIST")
+
+
+def dp_reduce_mode_env() -> str:
+    """LGBM_TPU_DP_REDUCE: 'scatter' (reference comm pattern, default) or
+    'psum' (replicated histograms) for the data-parallel device learner."""
+    return os.environ.get("LGBM_TPU_DP_REDUCE", "scatter").strip().lower()
