@@ -477,8 +477,6 @@ def grow_tree_compact_core(
     classes = _size_classes(n)
     wmax = classes[-1]
     thresholds = jnp.asarray(np.array(classes[:-1], np.int32))
-    item_mask = jnp.uint32((1 << item_bits) - 1)
-    per = 32 // item_bits
     d_cols = cw + 4
 
     # packed working buffer: codes | gh (bitcast) | row id, padded by wmax
@@ -538,17 +536,10 @@ def grow_tree_compact_core(
 
             win = jax.lax.dynamic_slice(c.data, (begin, 0), (wsz, d_cols))
             valid = jnp.arange(wsz, dtype=jnp.int32) < pcount
-            word = (f_col[feat] // per).astype(jnp.int32)
-            sub = (f_col[feat] % per).astype(jnp.uint32)
-            col32 = jax.lax.dynamic_slice(win, (0, word), (wsz, 1))[:, 0]
-            col = ((col32 >> (sub * item_bits)) & item_mask).astype(jnp.int32)
-            fbins = bundle_ops.logical_bins_for_feature(
-                col, f_base[feat], f_default[feat], f_numbins[feat],
-                f_elide[feat])
-            go_left = decide_left(fbins, row[B_THR].astype(jnp.int32),
-                                  row[B_DLEFT] > 0.5,
-                                  f_missing[feat], f_default[feat],
-                                  f_numbins[feat]) & valid
+            go_left = packed_go_left(
+                win, feat, row[B_THR].astype(jnp.int32),
+                row[B_DLEFT] > 0.5, f_numbins, f_missing, f_default,
+                f_col, f_base, f_elide, item_bits=item_bits) & valid
 
             # stable partition of the window (reference DataPartition::
             # Split): overrun rows past pcount get key 2, so the stable
@@ -747,6 +738,54 @@ def grow_tree_compact_core(
     return out.rec, leaf_id, out.k, totals
 
 
+def packed_go_left(win: jax.Array, feat, thr, dleft,
+                   f_numbins, f_missing, f_default, f_col, f_base, f_elide,
+                   *, item_bits: int) -> jax.Array:
+    """Decode feature `feat`'s codes from a packed u32 row window and
+    apply the split decision — the one copy of the unpack + logical-bin +
+    decide_left sequence shared by the partition branches and the
+    out-of-bag router (any drift between them would silently mis-route)."""
+    per = 32 // item_bits
+    mask = jnp.uint32((1 << item_bits) - 1)
+    n_r = win.shape[0]
+    word = (f_col[feat] // per).astype(jnp.int32)
+    sub = (f_col[feat] % per).astype(jnp.uint32)
+    col32 = jax.lax.dynamic_slice(win, (0, word), (n_r, 1))[:, 0]
+    col = ((col32 >> (sub * item_bits)) & mask).astype(jnp.int32)
+    fbins = bundle_ops.logical_bins_for_feature(
+        col, f_base[feat], f_default[feat], f_numbins[feat], f_elide[feat])
+    return decide_left(fbins, thr, dleft, f_missing[feat], f_default[feat],
+                       f_numbins[feat])
+
+
+def route_rows_by_rec(codes_pack_rows: jax.Array, rec: jax.Array,
+                      k: jax.Array, f_numbins, f_missing, f_default,
+                      f_col, f_base, f_elide, *, item_bits: int,
+                      num_leaves: int) -> jax.Array:
+    """Assign rows to leaves by replaying the (L-1, 13) split records.
+
+    The role of the reference's out-of-bag AddPredictionToScore: rows that
+    did not participate in training still need their leaf. Each replayed
+    split streams ONE packed code column over the rows (no gathers), so
+    the whole pass costs O(rows * splits) sequential-bandwidth work —
+    cheap next to growing the tree itself."""
+    n_r = codes_pack_rows.shape[0]
+
+    def body(i, leaf):
+        r = rec[i]
+        do = i < k
+        go_left = packed_go_left(
+            codes_pack_rows, r[R_FEAT].astype(jnp.int32),
+            r[R_THR].astype(jnp.int32), r[R_DLEFT] > 0.5,
+            f_numbins, f_missing, f_default, f_col, f_base, f_elide,
+            item_bits=item_bits)
+        at = leaf == r[R_LEAF].astype(jnp.int32)
+        return jnp.where(do & at & ~go_left, i + 1, leaf)
+
+    return jax.lax.fori_loop(0, num_leaves - 1, body,
+                             jnp.zeros(n_r, jnp.int32))
+
+
 def leaf_values_from_rec(rec: jax.Array, k: jax.Array, L: int) -> jax.Array:
     """On-device replay of the (L-1, 13) split records into the final (L,)
     leaf-value vector: split i rewrites its leaf with lout and writes rout
@@ -758,6 +797,42 @@ def leaf_values_from_rec(rec: jax.Array, k: jax.Array, L: int) -> jax.Array:
         lv = lv.at[i + 1].set(jnp.where(do, rec[i, R_ROUT], lv[i + 1]))
         return lv
     return jax.lax.fori_loop(0, L - 1, body, jnp.zeros((L,), jnp.float32))
+
+
+def resolve_strategy(config: Config, dataset: Dataset,
+                     forced: Optional[str] = None) -> str:
+    """Growth-strategy selection shared by __init__ and supports():
+    compaction pays off once O(N)-per-split masked passes dominate;
+    small data stays on the simpler masked program."""
+    strat = forced or _env("LGBM_TPU_STRATEGY", "auto")
+    if strat == "auto":
+        strat = "compact" if dataset.num_data >= 65536 else "masked"
+    return strat
+
+
+def plan_histogram_pool(config: Config, dataset: Dataset):
+    """(slot_bytes, pool_slots): the LRU histogram-pool budget math
+    (reference HistogramPool, feature_histogram.hpp:654-831) — the ONE
+    copy used by both __init__ and the supports() capability check.
+    histogram_pool_size is the reference's knob (MB, < 0 = no explicit
+    limit); without it we default to a 1 GiB HBM budget. pool_slots == 0
+    means the dense one-slot-per-leaf pool fits."""
+    if dataset.columns:
+        ncols = max(1, len(dataset.columns))
+        raw_bins = max(c.num_bins for c in dataset.columns)
+    else:
+        ncols = max(1, dataset.num_features)
+        raw_bins = int(dataset.max_num_bins)
+    nb = 1 << max(4, (raw_bins - 1).bit_length())
+    device_bins = min(nb, 256) if raw_bins <= 256 else nb
+    slot_bytes = ncols * device_bins * 12
+    if config.histogram_pool_size and config.histogram_pool_size > 0:
+        budget = int(config.histogram_pool_size * (1 << 20))
+    else:
+        budget = 1 << 30
+    k_cap = max(8, budget // slot_bytes)
+    L = int(config.num_leaves)
+    return slot_bytes, (k_cap if L > k_cap else 0)
 
 
 class DeviceTreeLearner:
@@ -824,28 +899,11 @@ class DeviceTreeLearner:
         # build into the matmul pipeline better than Mosaic schedules it),
         # so the fused XLA path is the default even on TPU.
         self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
-        # strategy: compaction pays off once O(N)-per-split masked passes
-        # dominate; small data stays on the simpler masked program
-        strat = strategy or _env("LGBM_TPU_STRATEGY", "auto")
-        if strat == "auto":
-            strat = "compact" if dataset.num_data >= 65536 else "masked"
-        self.strategy = strat
-        # LRU-capped histogram pool (reference HistogramPool,
-        # feature_histogram.hpp:654-831): when the dense (L,C,B,3) pool
-        # would exceed the budget, the compact strategy runs with K LRU
-        # slots and rebuilds sibling histograms on miss
-        ncols_pool = (len(dataset.columns) if dataset.columns
-                      else self.num_features)
-        slot_bytes = max(1, ncols_pool) * self.col_device_bins * 12
-        # histogram_pool_size is the reference's knob (MB, < 0 = no
-        # explicit limit); without it we default to a 1 GiB HBM budget
-        if config.histogram_pool_size and config.histogram_pool_size > 0:
-            budget = int(config.histogram_pool_size * (1 << 20))
-        else:
-            budget = 1 << 30
-        k_cap = max(8, budget // slot_bytes)
-        L = int(config.num_leaves)
-        self.pool_slots = k_cap if L > k_cap else 0
+        self.strategy = resolve_strategy(config, dataset, strategy)
+        # LRU-capped histogram pool: when the dense (L,C,B,3) pool would
+        # exceed the budget, the compact strategy runs with K LRU slots
+        # and rebuilds sibling histograms on miss
+        _, self.pool_slots = plan_histogram_pool(config, dataset)
         if self.strategy == "compact":
             host_codes = (dataset.bundled if dataset.bundled is not None
                           else dataset.binned)
@@ -903,7 +961,8 @@ class DeviceTreeLearner:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def supports(config: Config, dataset: Dataset) -> bool:
+    def supports(config: Config, dataset: Dataset,
+                 strategy: Optional[str] = None) -> bool:
         """Static capability check; unsupported configs use the host-loop
         learner (create_tree_learner falls back)."""
         if any(dataset.bin_mappers[fr].bin_type == BIN_CATEGORICAL
@@ -916,30 +975,15 @@ class DeviceTreeLearner:
                 or bool(config.cegb_penalty_feature_coupled)
                 or bool(config.cegb_penalty_feature_lazy)):
             return False
-        # mirror __init__'s pool sizing exactly: bundled column count when
-        # EFB is active, and the same pow2 bin padding (only clamped to 256
-        # when the logical bin count itself is <= 256)
-        if dataset.columns:
-            ncols = max(1, len(dataset.columns))
-            raw_bins = max(c.num_bins for c in dataset.columns)
-        else:
-            ncols = max(1, dataset.num_features)
-            raw_bins = int(dataset.max_num_bins)
-        nb = 1 << max(4, (raw_bins - 1).bit_length())
-        device_bins = min(nb, 256) if raw_bins <= 256 else nb
-        slot_bytes = ncols * device_bins * 3 * 4
-        # the compact strategy caps the pool at K LRU slots (__init__
-        # pool_slots math), so its footprint never exceeds the budget;
-        # only the masked strategy's dense (L, C, B, 3) pool can blow up
-        strat = _env("LGBM_TPU_STRATEGY", "auto")
-        if strat == "auto":
-            strat = "compact" if dataset.num_data >= 65536 else "masked"
-        if strat == "compact":
-            if config.histogram_pool_size and config.histogram_pool_size > 0:
-                budget = int(config.histogram_pool_size * (1 << 20))
-            else:
-                budget = 1 << 30
-            slots = min(int(config.num_leaves), max(8, budget // slot_bytes))
+        # pool footprint via the same plan __init__ uses: the compact
+        # strategy caps at K LRU slots, only the masked strategy's dense
+        # (L, C, B, 3) pool can blow up. `strategy` lets callers that
+        # force a strategy (DeviceDataParallelTreeLearner forces compact)
+        # check the learner they will actually build.
+        slot_bytes, pool_slots = plan_histogram_pool(config, dataset)
+        strat = resolve_strategy(config, dataset, strategy)
+        if strat == "compact" and pool_slots > 0:
+            slots = pool_slots
         else:
             slots = int(config.num_leaves)
         if slots * slot_bytes > _POOL_BYTE_LIMIT:
@@ -1063,6 +1107,13 @@ class DeviceTreeLearner:
         bag_on = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
         bag_k = max(1, int(n * cfg.bagging_fraction))
         L = statics["num_leaves"]
+        # bag compaction (reference subset-copy bagging, gbdt.cpp:727-792):
+        # physically gather the bag once per iteration so every per-split
+        # window scales with the bag, not N; out-of-bag rows get their
+        # leaf from a rec-replay routing pass
+        from ..utils.envs import flag
+        bag_compact = (use_compact and bag_on and bag_k < n
+                       and not flag("LGBM_TPU_NO_BAG_COMPACT"))
 
         @jax.jit
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
@@ -1072,10 +1123,31 @@ class DeviceTreeLearner:
                 # (reference Bagging, gbdt.cpp:210-276)
                 u = jax.random.uniform(bag_key, (n,))
                 cut = jnp.sort(u)[bag_k - 1]
-                w = (u <= cut).astype(jnp.float32)
+                inbag = u <= cut
+                w = inbag.astype(jnp.float32)
             else:
                 w = jnp.ones((n,), jnp.float32)
-            if use_compact:
+            if bag_compact:
+                order = jnp.argsort(
+                    jnp.where(inbag, 0, 1).astype(jnp.int8), stable=True)
+                bag_idx, oob_idx = order[:bag_k], order[bag_k:]
+                rec, leaf_b, k, _ = grow(
+                    jnp.take(self.codes_pack, bag_idx, axis=0),
+                    jnp.take(self.codes_row, bag_idx, axis=0),
+                    jnp.take(g, bag_idx), jnp.take(h, bag_idx),
+                    jnp.ones((bag_k,), jnp.float32), base_mask,
+                    *meta, tree_key, c_cols=self.c_cols,
+                    item_bits=self.item_bits,
+                    pool_slots=self.pool_slots, **statics)
+                leaf_o = route_rows_by_rec(
+                    jnp.take(self.codes_pack, oob_idx, axis=0), rec, k,
+                    self.f_numbins, self.f_missing, self.f_default,
+                    self.f_col, self.f_base, self.f_elide,
+                    item_bits=self.item_bits, num_leaves=L)
+                leaf_id = jnp.zeros(n, jnp.int32) \
+                    .at[bag_idx].set(leaf_b, unique_indices=True) \
+                    .at[oob_idx].set(leaf_o, unique_indices=True)
+            elif use_compact:
                 rec, leaf_id, k, _ = grow(
                     self.codes_pack, self.codes_row, g, h, w, base_mask,
                     *meta, tree_key, c_cols=self.c_cols,

@@ -662,7 +662,10 @@ def create_tree_learner(config: Config, dataset: Dataset,
     if name in ("feature", "feature_parallel"):
         return FeatureParallelTreeLearner(config, dataset, mesh)
     if name in ("data", "data_parallel"):
-        if not host_only and DeviceTreeLearner.supports(config, dataset):
+        # the DP device learner always runs the compact strategy; check
+        # the learner that will actually be built
+        if not host_only and DeviceTreeLearner.supports(config, dataset,
+                                                        strategy="compact"):
             return DeviceDataParallelTreeLearner(config, dataset, mesh)
         return DataParallelTreeLearner(config, dataset, mesh)
     if name in ("voting", "voting_parallel"):

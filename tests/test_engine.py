@@ -410,6 +410,51 @@ def test_device_strategies_agree_4bit_packing():
         bc.predict(x[:300], raw_score=True), rtol=1e-5, atol=1e-6)
 
 
+def test_bag_compaction_routing_and_quality():
+    """Fused bagging with subset compaction (reference subset-copy mode,
+    gbdt.cpp:727-792): the tree trains on a physically gathered bag and
+    out-of-bag rows get leaves from the rec-replay router. Invariants:
+    the internal score vector must equal tree-traversal predictions
+    exactly (routing correctness), and quality must match the
+    non-compacted weight-mode path (fp-tie plateaus make structural
+    equality too strict across the two summation orders)."""
+    import os
+    import jax
+    import lightgbm_tpu as lgb
+    r = np.random.RandomState(5)
+    x = r.randn(4000, 7).astype(np.float32)
+    y = (x[:, 0] + 0.4 * x[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+              "bagging_fraction": 0.4, "bagging_freq": 1,
+              "min_data_in_leaf": 5}
+
+    def run():
+        os.environ["LGBM_TPU_STRATEGY"] = "compact"
+        try:
+            b = lgb.Booster(params=params, train_set=lgb.Dataset(x, y))
+            for _ in range(5):
+                b.update()
+            return b
+        finally:
+            os.environ.pop("LGBM_TPU_STRATEGY", None)
+
+    b1 = run()
+    score = np.asarray(jax.device_get(b1._gbdt.score_updater.score[0]))
+    pred = b1.predict(x, raw_score=True)
+    np.testing.assert_allclose(score, pred, rtol=0, atol=1e-5)
+
+    os.environ["LGBM_TPU_NO_BAG_COMPACT"] = "1"
+    try:
+        b2 = run()
+    finally:
+        os.environ.pop("LGBM_TPU_NO_BAG_COMPACT", None)
+    auc1 = _auc(y, pred)
+    auc2 = _auc(y, b2.predict(x, raw_score=True))
+    assert auc1 > 0.9 and abs(auc1 - auc2) < 0.02, (auc1, auc2)
+    for t1, t2 in zip(b1._gbdt.models, b2._gbdt.models):
+        assert t1.num_leaves == t2.num_leaves
+
+
 def test_lru_histogram_pool_matches_dense():
     """The slot-capped LRU histogram pool (role of the reference's
     HistogramPool, feature_histogram.hpp:654-831) must grow identical
