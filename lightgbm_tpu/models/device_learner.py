@@ -799,6 +799,14 @@ def leaf_values_from_rec(rec: jax.Array, k: jax.Array, L: int) -> jax.Array:
     return jax.lax.fori_loop(0, L - 1, body, jnp.zeros((L,), jnp.float32))
 
 
+def padded_device_bins(raw_bins: int) -> int:
+    """Pow2-padded on-device bin count (min 16, clamped to 256 when the
+    logical bin count itself fits u8) — the one copy of the padding rule
+    used for device_bins, col_device_bins and the pool plan."""
+    nb = 1 << max(4, (int(raw_bins) - 1).bit_length())
+    return min(nb, 256) if raw_bins <= 256 else nb
+
+
 def resolve_strategy(config: Config, dataset: Dataset,
                      forced: Optional[str] = None) -> str:
     """Growth-strategy selection shared by __init__ and supports():
@@ -823,9 +831,7 @@ def plan_histogram_pool(config: Config, dataset: Dataset):
     else:
         ncols = max(1, dataset.num_features)
         raw_bins = int(dataset.max_num_bins)
-    nb = 1 << max(4, (raw_bins - 1).bit_length())
-    device_bins = min(nb, 256) if raw_bins <= 256 else nb
-    slot_bytes = ncols * device_bins * 12
+    slot_bytes = ncols * padded_device_bins(raw_bins) * 12
     if config.histogram_pool_size and config.histogram_pool_size > 0:
         budget = int(config.histogram_pool_size * (1 << 20))
     else:
@@ -849,15 +855,13 @@ class DeviceTreeLearner:
          self.f_categorical, self.f_monotone) = dataset.feature_meta_arrays()
         self.num_features = dataset.num_features
         self.num_bins = int(dataset.max_num_bins)
-        b = 1 << max(4, (self.num_bins - 1).bit_length())
-        self.device_bins = min(b, 256) if self.num_bins <= 256 else b
+        self.device_bins = padded_device_bins(self.num_bins)
         bundle = dataset.bundle_arrays()
         if bundle is not None:
             codes, f_col, f_base, f_elide, hist_idx, col_bins = bundle
             self.codes_t = jnp.asarray(jnp.swapaxes(codes, 0, 1))  # (C, N)
             self.f_col, self.f_base, self.f_elide = f_col, f_base, f_elide
-            cb = 1 << max(4, (int(col_bins) - 1).bit_length())
-            self.col_device_bins = min(cb, 256) if col_bins <= 256 else cb
+            self.col_device_bins = padded_device_bins(int(col_bins))
             # pad hist_idx bin axis to device_bins; pad slots hit the
             # trailing zero entry of the flattened column histogram
             zero_slot = len(dataset.columns) * self.col_device_bins
@@ -1086,12 +1090,19 @@ class DeviceTreeLearner:
         return tree
 
     # ------------------------------------------------------------------
-    def make_fused_step(self, objective):
+    def make_fused_step(self, objective, goss=None):
         """One boosting iteration as a single device program: gradients ->
-        bag sampling -> whole-tree growth -> on-device leaf-value replay ->
-        score update. Through a tunneled TPU every extra dispatch costs
-        ~10ms and every H2D ~130ms/4MB, so the fused step leaves exactly
-        one small D2H fetch (the split records) per iteration.
+        bag/GOSS sampling -> whole-tree growth -> on-device leaf-value
+        replay -> score update. Through a tunneled TPU every extra
+        dispatch costs ~10ms and every H2D ~130ms/4MB, so the fused step
+        leaves exactly one small D2H fetch (the split records) per
+        iteration.
+
+        goss = (top_k, other_k, multiply): gradient-based one-side
+        sampling on device (reference src/boosting/goss.hpp) — keep the
+        top_k rows by |g*h|, sample other_k of the rest uniformly and
+        amplify their gradients by `multiply`; the tree then trains on
+        the compacted (top_k + other_k)-row subset.
 
         Returns step(score_row, base_mask, tree_key, bag_key, shrinkage)
         -> (new_score_row, rec, leaf_id, num_splits).
@@ -1104,8 +1115,13 @@ class DeviceTreeLearner:
         meta = (self.f_numbins, self.f_missing, self.f_default,
                 self.f_monotone, self.f_penalty, self.f_col, self.f_base,
                 self.f_elide, self.hist_idx)
-        bag_on = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
-        bag_k = max(1, int(n * cfg.bagging_fraction))
+        if goss is not None:
+            top_k, other_k, multiply = goss
+            bag_on = True
+            bag_k = min(n, top_k + other_k)
+        else:
+            bag_on = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+            bag_k = max(1, int(n * cfg.bagging_fraction))
         L = statics["num_leaves"]
         # bag compaction (reference subset-copy bagging, gbdt.cpp:727-792):
         # physically gather the bag once per iteration so every per-split
@@ -1118,7 +1134,27 @@ class DeviceTreeLearner:
         @jax.jit
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
             g, h = objective.get_gradients(score_row)
-            if bag_on:
+            bag_idx = oob_idx = None
+            if goss is not None:
+                # exactly top_k rows by |g*h| always kept (rank-based, so
+                # gradient ties cannot change the subset size), exactly
+                # other_k of the rest sampled uniformly with gradient
+                # amplification (goss.hpp:91)
+                gmag = jnp.abs(g * h)
+                ridx = jnp.argsort(-gmag, stable=True)
+                top_idx, rest = ridx[:top_k], ridx[top_k:]
+                perm = jnp.argsort(
+                    jax.random.uniform(bag_key, (n - top_k,)))
+                other_idx = jnp.take(rest, perm[:other_k])
+                oob_idx = jnp.take(rest, perm[other_k:])
+                bag_idx = jnp.concatenate([top_idx, other_idx])
+                amp = jnp.ones((n,), jnp.float32).at[other_idx].set(
+                    jnp.float32(multiply), unique_indices=True)
+                g = g * amp
+                h = h * amp
+                w = jnp.zeros((n,), jnp.float32).at[bag_idx].set(
+                    1.0, unique_indices=True)
+            elif bag_on:
                 # exactly bag_k in-bag rows, deterministic per bag_key
                 # (reference Bagging, gbdt.cpp:210-276)
                 u = jax.random.uniform(bag_key, (n,))
@@ -1128,9 +1164,11 @@ class DeviceTreeLearner:
             else:
                 w = jnp.ones((n,), jnp.float32)
             if bag_compact:
-                order = jnp.argsort(
-                    jnp.where(inbag, 0, 1).astype(jnp.int8), stable=True)
-                bag_idx, oob_idx = order[:bag_k], order[bag_k:]
+                if bag_idx is None:
+                    order = jnp.argsort(
+                        jnp.where(inbag, 0, 1).astype(jnp.int8),
+                        stable=True)
+                    bag_idx, oob_idx = order[:bag_k], order[bag_k:]
                 rec, leaf_b, k, _ = grow(
                     jnp.take(self.codes_pack, bag_idx, axis=0),
                     jnp.take(self.codes_row, bag_idx, axis=0),

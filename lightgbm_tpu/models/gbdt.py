@@ -211,7 +211,12 @@ class GBDT:
         """Whether the single-program device iteration applies (plain GBDT,
         single-class jittable objective, device learner, plain bagging)."""
         from .device_learner import DeviceTreeLearner
-        return (self.__class__ is GBDT
+        if self.__class__ is GOSS and type(self.learner) is not \
+                DeviceTreeLearner:
+            # fused GOSS needs a global top-k; the sharded DP program
+            # does not implement it (falls back to the generic path)
+            return False
+        return (self.__class__ in (GBDT, GOSS)
                 and isinstance(self.learner, DeviceTreeLearner)
                 and self.objective is not None
                 and not self.objective.is_renew_tree_output
@@ -228,7 +233,8 @@ class GBDT:
         cfg = self.config
         init_score = self._boost_from_average(0, True)
         if self._fused_step is None:
-            self._fused_step = self.learner.make_fused_step(self.objective)
+            self._fused_step = self.learner.make_fused_step(
+                self.objective, goss=self._fused_goss())
         rng = np.random.RandomState(
             (cfg.feature_fraction_seed + self.iter) % (2**31 - 1))
         base_mask = jnp.asarray(
@@ -236,8 +242,9 @@ class GBDT:
             & np.asarray(self.learner.f_categorical == 0))
         tree_key = jax.random.PRNGKey(self.iter)
         # same bag key for bagging_freq consecutive iterations == reference
-        # re-bags only on iter % freq == 0 and reuses the bag otherwise
-        freq = max(cfg.bagging_freq, 1)
+        # re-bags only on iter % freq == 0 and reuses the bag otherwise;
+        # GOSS resamples EVERY iteration (goss.hpp has no freq notion)
+        freq = 1 if self._fused_goss() else max(cfg.bagging_freq, 1)
         bag_key = jax.random.PRNGKey(
             (cfg.bagging_seed + (self.iter // freq)) % (2**31 - 1))
         new_score, rec, leaf_id, k_dev = self._fused_step(
@@ -265,6 +272,11 @@ class GBDT:
         self.models.append(tree)
         self.iter += 1
         return False
+
+    def _fused_goss(self):
+        """GOSS sampling parameters for the fused step; None for plain
+        bagging (the GOSS subclass overrides)."""
+        return None
 
     # ------------------------------------------------------------------
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
@@ -771,7 +783,16 @@ class GOSS(GBDT):
         idx = np.sort(np.concatenate([top_idx, other_idx])).astype(np.int32)
         return idx
 
-    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+    def _fused_goss(self):
+        cfg = self.config
+        n = self.num_data
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        multiply = (n - top_k) / max(other_k, 1)
+        return (top_k, other_k, float(multiply))
+
+    def _train_one_iter_generic(self, gradients=None,
+                                hessians=None) -> bool:
         # compute gradients first so GOSS sampling can see them
         init_scores = [0.0] * self.num_tree_per_iteration
         if gradients is None or hessians is None:

@@ -618,11 +618,17 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         return self.replay_tree(rec_h, k)
 
     # ------------------------------------------------------------------
-    def make_fused_step(self, objective):
+    def make_fused_step(self, objective, goss=None):
         """Fused sharded boosting iteration (see DeviceTreeLearner
         .make_fused_step): gradients auto-shard over the score, the tree
         grows under shard_map with per-split psum, the score update is
         elementwise over the sharded leaf assignment."""
+        if goss is not None:
+            # device GOSS needs a GLOBAL top-k across shards; not wired
+            # into the sharded program yet (GBDT._fused_eligible gates
+            # GOSS to the single-chip learner, so this is a guard)
+            raise NotImplementedError(
+                "fused GOSS is not supported on the data-parallel learner")
         from ..models.device_learner import leaf_values_from_rec
         n = self.dataset.num_data
         npad = self.n_pad
