@@ -800,11 +800,10 @@ def leaf_values_from_rec(rec: jax.Array, k: jax.Array, L: int) -> jax.Array:
 
 
 def padded_device_bins(raw_bins: int) -> int:
-    """Pow2-padded on-device bin count (min 16, clamped to 256 when the
-    logical bin count itself fits u8) — the one copy of the padding rule
-    used for device_bins, col_device_bins and the pool plan."""
-    nb = 1 << max(4, (int(raw_bins) - 1).bit_length())
-    return min(nb, 256) if raw_bins <= 256 else nb
+    """Pow2-padded on-device bin count (min 16) — the one copy of the
+    padding rule used for device_bins, col_device_bins and the pool
+    plan. raw_bins <= 256 always pads to <= 256, so u8 storage holds."""
+    return 1 << max(4, (int(raw_bins) - 1).bit_length())
 
 
 def resolve_strategy(config: Config, dataset: Dataset,

@@ -232,9 +232,14 @@ class GBDT:
         (see DeviceTreeLearner.make_fused_step)."""
         cfg = self.config
         init_score = self._boost_from_average(0, True)
+        goss_params = self._fused_goss()
         if self._fused_step is None:
-            self._fused_step = self.learner.make_fused_step(
-                self.objective, goss=self._fused_goss())
+            self._fused_step = {}
+        fkey = goss_params is not None
+        if fkey not in self._fused_step:
+            self._fused_step[fkey] = self.learner.make_fused_step(
+                self.objective, goss=goss_params)
+        fused_step = self._fused_step[fkey]
         rng = np.random.RandomState(
             (cfg.feature_fraction_seed + self.iter) % (2**31 - 1))
         base_mask = jnp.asarray(
@@ -247,7 +252,7 @@ class GBDT:
         freq = 1 if self._fused_goss() else max(cfg.bagging_freq, 1)
         bag_key = jax.random.PRNGKey(
             (cfg.bagging_seed + (self.iter // freq)) % (2**31 - 1))
-        new_score, rec, leaf_id, k_dev = self._fused_step(
+        new_score, rec, leaf_id, k_dev = fused_step(
             self.score_updater.score[0], base_mask, tree_key, bag_key,
             jnp.float32(self.shrinkage_rate))
         rec_h, k = jax.device_get((rec, k_dev))
@@ -770,8 +775,7 @@ class GOSS(GBDT):
         g = np.abs(np.asarray(jax.device_get(grad)) *
                    np.asarray(jax.device_get(hess))).sum(axis=0)
         n = self.num_data
-        top_k = max(1, int(n * cfg.top_rate))
-        other_k = max(1, int(n * cfg.other_rate))
+        top_k, other_k, _ = self._goss_params()
         order = np.argsort(-g, kind="stable")
         top_idx = order[:top_k]
         rest = order[top_k:]
@@ -783,13 +787,20 @@ class GOSS(GBDT):
         idx = np.sort(np.concatenate([top_idx, other_idx])).astype(np.int32)
         return idx
 
-    def _fused_goss(self):
+    def _goss_params(self):
         cfg = self.config
         n = self.num_data
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
         multiply = (n - top_k) / max(other_k, 1)
         return (top_k, other_k, float(multiply))
+
+    def _fused_goss(self):
+        # the reference trains on ALL rows for the first 1/learning_rate
+        # iterations before sampling kicks in (goss.hpp:143-144)
+        if self.iter < int(1.0 / max(self.config.learning_rate, 1e-12)):
+            return None
+        return self._goss_params()
 
     def _train_one_iter_generic(self, gradients=None,
                                 hessians=None) -> bool:
@@ -805,12 +816,17 @@ class GOSS(GBDT):
             hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
                 self.num_tree_per_iteration, self.num_data)
         self._last_grad_hess = (grad, hess)
-        bag_indices = self._goss_sample()
-        other_idx, multiply = self._goss_amplify
-        amp = jnp.ones(self.num_data, dtype=jnp.float32).at[
-            jnp.asarray(other_idx)].set(float(multiply))
-        grad = grad * amp[None, :]
-        hess = hess * amp[None, :]
+        if self.iter < int(1.0 / max(self.config.learning_rate, 1e-12)):
+            # reference warmup: no subsampling for the first
+            # 1/learning_rate iterations (goss.hpp:143-144)
+            bag_indices = None
+        else:
+            bag_indices = self._goss_sample()
+            other_idx, multiply = self._goss_amplify
+            amp = jnp.ones(self.num_data, dtype=jnp.float32).at[
+                jnp.asarray(other_idx)].set(float(multiply))
+            grad = grad * amp[None, :]
+            hess = hess * amp[None, :]
 
         should_continue = False
         for k in range(self.num_tree_per_iteration):

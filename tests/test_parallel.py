@@ -169,13 +169,13 @@ def test_data_parallel_no_per_split_host_sync():
     b = create_boosting(cfg, ds)
     b.train_one_iter()          # compile + warm
 
-    fused = b._fused_step
+    fused = b._fused_step[False]     # keyed by goss-active
     calls = {"n": 0}
 
     def wrapped(*a, **k):
         calls["n"] += 1
         return fused(*a, **k)
-    b._fused_step = wrapped
+    b._fused_step[False] = wrapped
     b.train_one_iter()
     assert calls["n"] == 1, "fused DP step must run exactly once per iter"
 

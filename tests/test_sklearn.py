@@ -9,7 +9,7 @@ from conftest import make_binary, make_multiclass, make_ranking, make_regression
 
 def test_regressor():
     x, y = make_regression()
-    m = lgb.LGBMRegressor(n_estimators=30, verbosity=-1)
+    m = lgb.LGBMRegressor(n_estimators=15, verbosity=-1)
     m.fit(x, y, verbose=False)
     pred = m.predict(x)
     assert float(np.mean((y - pred) ** 2)) < 0.5
@@ -19,7 +19,7 @@ def test_regressor():
 
 def test_classifier_binary():
     x, y = make_binary()
-    m = lgb.LGBMClassifier(n_estimators=30, verbosity=-1)
+    m = lgb.LGBMClassifier(n_estimators=15, verbosity=-1)
     m.fit(x, y, verbose=False)
     pred = m.predict(x)
     assert set(np.unique(pred)) <= set(np.unique(y))
@@ -33,7 +33,7 @@ def test_classifier_binary():
 
 def test_classifier_multiclass():
     x, y = make_multiclass()
-    m = lgb.LGBMClassifier(n_estimators=20, verbosity=-1)
+    m = lgb.LGBMClassifier(n_estimators=10, verbosity=-1)
     m.fit(x, y, verbose=False)
     proba = m.predict_proba(x)
     assert proba.shape == (len(y), 4)
@@ -62,7 +62,7 @@ def test_ranker():
 
 def test_early_stopping_sklearn():
     x, y = make_binary(3000)
-    m = lgb.LGBMClassifier(n_estimators=200, verbosity=-1)
+    m = lgb.LGBMClassifier(n_estimators=80, verbosity=-1)
     m.fit(x[:2000], y[:2000], eval_set=[(x[2000:], y[2000:])],
           early_stopping_rounds=5, verbose=False)
     assert m.best_iteration_ > 0
