@@ -1089,7 +1089,7 @@ class DeviceTreeLearner:
         return tree
 
     # ------------------------------------------------------------------
-    def make_fused_step(self, objective, goss=None):
+    def make_fused_step(self, objective, goss=None, bagging=True):
         """One boosting iteration as a single device program: gradients ->
         bag/GOSS sampling -> whole-tree growth -> on-device leaf-value
         replay -> score update. Through a tunneled TPU every extra
@@ -1118,6 +1118,11 @@ class DeviceTreeLearner:
             top_k, other_k, multiply = goss
             bag_on = True
             bag_k = min(n, top_k + other_k)
+        elif not bagging:
+            # GOSS warmup: train on ALL rows even if bagging params are
+            # set (reference GOSS replaces bagging outright)
+            bag_on = False
+            bag_k = n
         else:
             bag_on = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
             bag_k = max(1, int(n * cfg.bagging_fraction))

@@ -233,12 +233,16 @@ class GBDT:
         cfg = self.config
         init_score = self._boost_from_average(0, True)
         goss_params = self._fused_goss()
+        # GOSS replaces bagging outright (goss.hpp overrides Bagging):
+        # its warmup step must train on ALL rows even when bagging
+        # params are set
+        bagging = not self._is_goss()
         if self._fused_step is None:
             self._fused_step = {}
         fkey = goss_params is not None
         if fkey not in self._fused_step:
             self._fused_step[fkey] = self.learner.make_fused_step(
-                self.objective, goss=goss_params)
+                self.objective, goss=goss_params, bagging=bagging)
         fused_step = self._fused_step[fkey]
         rng = np.random.RandomState(
             (cfg.feature_fraction_seed + self.iter) % (2**31 - 1))
@@ -282,6 +286,9 @@ class GBDT:
         """GOSS sampling parameters for the fused step; None for plain
         bagging (the GOSS subclass overrides)."""
         return None
+
+    def _is_goss(self) -> bool:
+        return False
 
     # ------------------------------------------------------------------
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
@@ -775,17 +782,19 @@ class GOSS(GBDT):
         g = np.abs(np.asarray(jax.device_get(grad)) *
                    np.asarray(jax.device_get(hess))).sum(axis=0)
         n = self.num_data
-        top_k, other_k, _ = self._goss_params()
+        top_k, other_k, multiply = self._goss_params()
         order = np.argsort(-g, kind="stable")
         top_idx = order[:top_k]
         rest = order[top_k:]
         sampled = self._bag_rng.choice(
             len(rest), min(other_k, len(rest)), replace=False)
         other_idx = rest[sampled]
-        multiply = (n - top_k) / max(other_k, 1)
         self._goss_amplify = (other_idx, multiply)
         idx = np.sort(np.concatenate([top_idx, other_idx])).astype(np.int32)
         return idx
+
+    def _is_goss(self) -> bool:
+        return True
 
     def _goss_params(self):
         cfg = self.config
@@ -816,7 +825,7 @@ class GOSS(GBDT):
             hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
                 self.num_tree_per_iteration, self.num_data)
         self._last_grad_hess = (grad, hess)
-        if self.iter < int(1.0 / max(self.config.learning_rate, 1e-12)):
+        if self._fused_goss() is None:
             # reference warmup: no subsampling for the first
             # 1/learning_rate iterations (goss.hpp:143-144)
             bag_indices = None

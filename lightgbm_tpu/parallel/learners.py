@@ -618,7 +618,7 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         return self.replay_tree(rec_h, k)
 
     # ------------------------------------------------------------------
-    def make_fused_step(self, objective, goss=None):
+    def make_fused_step(self, objective, goss=None, bagging=True):
         """Fused sharded boosting iteration (see DeviceTreeLearner
         .make_fused_step): gradients auto-shard over the score, the tree
         grows under shard_map with per-split psum, the score update is

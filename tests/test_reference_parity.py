@@ -136,3 +136,109 @@ def test_first_tree_structure_agreement(ref_model, tmp_path):
     t_ours = bst._gbdt.models[0]
     t_ref = ref._gbdt.models[0]
     assert t_ours.split_feature[0] == t_ref.split_feature[0]
+
+
+def _write_csv(path, x, y):
+    with open(path, "w") as fh:
+        for xi, yi in zip(x, y):
+            cells = [repr(float(yi))] + [
+                "na" if np.isnan(v) else repr(float(v)) for v in xi]
+            fh.write(",".join(cells) + "\n")
+
+
+@needs_oracle
+def test_missing_value_parity_with_reference(tmp_path):
+    """Train the reference CLI on NaN-laced data, load its model here and
+    vice versa — missing-direction semantics must agree end to end
+    (reference: tests/python_package_test/test_engine.py:117-238
+    test_missing_value_handle family)."""
+    r = np.random.RandomState(7)
+    n = 1200
+    x = r.randn(n, 4)
+    y = ((np.nan_to_num(x[:, 0]) + 0.5 * np.nan_to_num(x[:, 1])) > 0
+         ).astype(np.float64)
+    x[r.rand(n) < 0.25, 0] = np.nan
+    x[r.rand(n) < 0.10, 1] = np.nan
+    train_csv = tmp_path / "miss.csv"
+    _write_csv(train_csv, x, y)
+    model = tmp_path / "ref_miss.txt"
+    _run_oracle(
+        str(tmp_path), "task=train", f"data={train_csv}",
+        "objective=binary", "num_trees=10", "num_leaves=15",
+        "min_data_in_leaf=10", "verbosity=-1", "use_missing=true",
+        f"output_model={model}", "header=false", "label_column=0")
+    # reference-trained model in our predictor
+    ref_in_ours = lgb.Booster(model_file=str(model))
+    # reference CLI's own predictions on the same rows
+    pred_file = tmp_path / "ref_preds.txt"
+    _run_oracle(
+        str(tmp_path), "task=predict", f"data={train_csv}",
+        f"input_model={model}", f"output_result={pred_file}",
+        "header=false", "label_column=0", "predict_raw_score=true")
+    ref_preds = np.loadtxt(pred_file)
+    ours_on_ref = ref_in_ours.predict(x, raw_score=True)
+    np.testing.assert_allclose(ours_on_ref, ref_preds, rtol=2e-5, atol=2e-5)
+
+    # our model in the reference CLI
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "min_data_in_leaf": 10, "verbosity": -1}, ds,
+                    num_boost_round=10, verbose_eval=False)
+    ours_model = tmp_path / "ours_miss.txt"
+    bst.save_model(str(ours_model))
+    pred_file2 = tmp_path / "ours_preds.txt"
+    _run_oracle(
+        str(tmp_path), "task=predict", f"data={train_csv}",
+        f"input_model={ours_model}", f"output_result={pred_file2}",
+        "header=false", "label_column=0", "predict_raw_score=true")
+    ref_on_ours = np.loadtxt(pred_file2)
+    np.testing.assert_allclose(bst.predict(x, raw_score=True), ref_on_ours,
+                               rtol=2e-5, atol=2e-5)
+
+
+@needs_oracle
+def test_categorical_parity_with_reference(tmp_path):
+    """Categorical one-hot/subset split semantics against the reference
+    CLI on its own categorical fixture shape (reference:
+    tests/python_package_test/test_engine.py:239-312)."""
+    r = np.random.RandomState(11)
+    n = 1500
+    cat = r.randint(0, 10, n).astype(np.float64)
+    x1 = r.randn(n)
+    effect = np.array([2.0, -1.5, 0.5, 3.0, -2.0, 0.0, 1.0, -0.5, 2.5, -3.0])
+    y = (effect[cat.astype(int)] + 0.5 * x1 + 0.3 * r.randn(n) > 0
+         ).astype(np.float64)
+    x = np.column_stack([cat, x1])
+    train_csv = tmp_path / "cat.csv"
+    _write_csv(train_csv, x, y)
+    model = tmp_path / "ref_cat.txt"
+    _run_oracle(
+        str(tmp_path), "task=train", f"data={train_csv}",
+        "objective=binary", "num_trees=10", "num_leaves=15",
+        "min_data_in_leaf=10", "verbosity=-1", "categorical_feature=0",
+        f"output_model={model}", "header=false", "label_column=0")
+    ref_in_ours = lgb.Booster(model_file=str(model))
+    pred_file = tmp_path / "ref_cat_preds.txt"
+    _run_oracle(
+        str(tmp_path), "task=predict", f"data={train_csv}",
+        f"input_model={model}", f"output_result={pred_file}",
+        "header=false", "label_column=0", "predict_raw_score=true")
+    ref_preds = np.loadtxt(pred_file)
+    np.testing.assert_allclose(ref_in_ours.predict(x, raw_score=True),
+                               ref_preds, rtol=2e-5, atol=2e-5)
+
+    # our categorical training, scored by the reference CLI
+    ds = lgb.Dataset(x, y, categorical_feature=[0], free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "min_data_in_leaf": 10, "verbosity": -1}, ds,
+                    num_boost_round=10, verbose_eval=False)
+    ours_model = tmp_path / "ours_cat.txt"
+    bst.save_model(str(ours_model))
+    pred_file2 = tmp_path / "ours_cat_preds.txt"
+    _run_oracle(
+        str(tmp_path), "task=predict", f"data={train_csv}",
+        f"input_model={ours_model}", f"output_result={pred_file2}",
+        "header=false", "label_column=0", "predict_raw_score=true")
+    ref_on_ours = np.loadtxt(pred_file2)
+    np.testing.assert_allclose(bst.predict(x, raw_score=True), ref_on_ours,
+                               rtol=2e-5, atol=2e-5)
