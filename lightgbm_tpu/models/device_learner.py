@@ -342,7 +342,8 @@ def grow_tree_compact_core(
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
-        axis_name=None, pool_slots: int = 0, scatter_cols: int = 0):
+        axis_name=None, pool_slots: int = 0, scatter_cols: int = 0,
+        feature_shards: int = 0):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
@@ -391,9 +392,18 @@ def grow_tree_compact_core(
         max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
         bynode_k=bynode_k)
-    scatter = scatter_cols > 1 and axis_name is not None
+    scatter = (scatter_cols > 1 and axis_name is not None
+               and feature_shards == 0)
+    # feature-parallel: rows replicated, every shard builds histograms
+    # ONLY for its column slice (no histogram collective at all — the
+    # local slice over all rows IS the global histogram); the winner is
+    # elected exactly like scatter mode (feature_parallel_tree_learner
+    # .cpp:33-76 + SyncUpGlobalBestSplit role)
+    fp = feature_shards > 1 and axis_name is not None
+    sliced = scatter or fp
+    per_w = 32 // item_bits
 
-    if not scatter:
+    if not sliced:
         (node_mask, scan, store_best, scan2, store_best2,
          best_row) = _tree_helpers(
             base_mask, f_numbins, f_missing, f_default, f_monotone,
@@ -413,12 +423,18 @@ def grow_tree_compact_core(
                 functools.partial(best_row, child_depth=child_depth))(res2)
     else:
         # feature-sliced scan: every shard searches only the columns it
-        # owns after the reduce-scatter, then candidates are elected
-        D = scatter_cols
+        # owns (after the reduce-scatter in scatter mode; built directly
+        # in feature-parallel mode), then candidates are elected
+        D = scatter_cols if scatter else feature_shards
         f_all = int(f_numbins.shape[0])
         assert f_all == c_cols, \
-            "scatter_cols requires identity feature->column mapping"
-        cs = -(-c_cols // D)                # columns per shard (padded)
+            "sliced modes require identity feature->column mapping"
+        if fp:
+            # slice boundaries fall on packed-word boundaries so the
+            # window decode can slice words directly
+            cs = padded_shard_cols(c_cols, D, item_bits)
+        else:
+            cs = -(-c_cols // D)            # columns per shard (padded)
         c_pad = cs * D
         shard = jax.lax.axis_index(axis_name)
         start = (shard * cs).astype(jnp.int32)
@@ -447,10 +463,14 @@ def grow_tree_compact_core(
             mask_sl, nb_sl, miss_sl, def_sl, mono_sl, pen_sl, elide_sl,
             hi_local, **helper_kwargs)
 
-        def reduce_hist(h):
-            h = jnp.pad(h, ((0, c_pad - c_cols), (0, 0), (0, 0)))
-            return jax.lax.psum_scatter(h, axis_name, scatter_dimension=0,
-                                        tiled=True)
+        if scatter:
+            def reduce_hist(h):
+                h = jnp.pad(h, ((0, c_pad - c_cols), (0, 0), (0, 0)))
+                return jax.lax.psum_scatter(
+                    h, axis_name, scatter_dimension=0, tiled=True)
+        else:
+            def reduce_hist(h):
+                return h     # already the local slice over ALL rows
 
         def _elect(row):
             rows = jax.lax.all_gather(row, axis_name)        # (D, 12)
@@ -474,6 +494,21 @@ def grow_tree_compact_core(
             win = jnp.argmax(g[:, :, B_GAIN], axis=0)        # (2,)
             return g[win, jnp.arange(2)]
 
+    hist_cols = cs if fp else c_cols   # width of branch-built histograms
+    if fp:
+        cs_words = cs // per_w
+        assert cw >= cs_words * D, \
+            "feature-parallel needs codes packed to the padded column count"
+        w0 = (shard * cs_words).astype(jnp.int32)
+
+        def decode_for_hist(words2d):
+            wsl = jax.lax.dynamic_slice(
+                words2d, (jnp.int32(0), w0), (words2d.shape[0], cs_words))
+            return _unpack_codes(wsl, cs, item_bits)
+    else:
+        def decode_for_hist(words2d):
+            return _unpack_codes(words2d[:, :cw], c_cols, item_bits)
+
     classes = _size_classes(n)
     wmax = classes[-1]
     thresholds = jnp.asarray(np.array(classes[:-1], np.int32))
@@ -488,15 +523,27 @@ def grow_tree_compact_core(
 
     # ---- root ------------------------------------------------------------
     from ..ops.histogram import build_histogram
-    hist0 = build_histogram(codes_row, gh, col_bins, use_pallas=use_pallas)
-    if scatter:
-        # global totals first (the slice no longer carries column 0
-        # everywhere), then tile the columns across shards
-        totals = jax.lax.psum(hist0[0].sum(axis=0), axis_name)
-        hist0 = reduce_hist(hist0)
+    if fp:
+        # rows are replicated: totals come straight from gh, and the
+        # root histogram is built from this shard's column slice only
+        totals = gh.sum(axis=0)
+        cr = codes_row
+        if cr.shape[1] < c_pad:
+            cr = jnp.pad(cr, ((0, 0), (0, c_pad - cr.shape[1])))
+        cr_sl = jax.lax.dynamic_slice(
+            cr, (jnp.int32(0), (shard * cs).astype(jnp.int32)), (n, cs))
+        hist0 = build_histogram(cr_sl, gh, col_bins, use_pallas=use_pallas)
     else:
-        hist0 = reduce_hist(hist0)
-        totals = hist0[0].sum(axis=0)
+        hist0 = build_histogram(codes_row, gh, col_bins,
+                                use_pallas=use_pallas)
+        if scatter:
+            # global totals first (the slice no longer carries column 0
+            # everywhere), then tile the columns across shards
+            totals = jax.lax.psum(hist0[0].sum(axis=0), axis_name)
+            hist0 = reduce_hist(hist0)
+        else:
+            hist0 = reduce_hist(hist0)
+            totals = hist0[0].sum(axis=0)
     pool_c = hist0.shape[0]
     root_key, loop_key = jax.random.split(rng_key)
     row0 = search_row(hist0, totals[0], totals[1], totals[2],
@@ -578,7 +625,7 @@ def grow_tree_compact_core(
                 off = s_begin - start
                 sw = jax.lax.dynamic_slice(win_sorted, (start, 0),
                                            (half, d_cols))
-                s_codes = _unpack_codes(sw[:, :cw], c_cols, item_bits)
+                s_codes = decode_for_hist(sw[:, :cw])
                 j = jnp.arange(half, dtype=jnp.int32)
                 sv = ((j >= off) & (j < off + s_count)).astype(jnp.float32)
                 s_gh = jax.lax.bitcast_convert_type(
@@ -587,8 +634,7 @@ def grow_tree_compact_core(
                                        use_pallas=use_pallas)
 
             def hist_full(_):
-                s_codes = _unpack_codes(win_sorted[:, :cw], c_cols,
-                                        item_bits)
+                s_codes = decode_for_hist(win_sorted[:, :cw])
                 j = jnp.arange(wsz, dtype=jnp.int32)
                 sv = ((j >= s_begin)
                       & (j < s_begin + s_count)).astype(jnp.float32)
@@ -609,8 +655,7 @@ def grow_tree_compact_core(
                 o_count = pcount - s_count
 
                 def hist_other_fn(_):
-                    s_codes = _unpack_codes(win_sorted[:, :cw], c_cols,
-                                            item_bits)
+                    s_codes = decode_for_hist(win_sorted[:, :cw])
                     j = jnp.arange(wsz, dtype=jnp.int32)
                     sv = ((j >= o_begin)
                           & (j < o_begin + o_count)).astype(jnp.float32)
@@ -621,10 +666,12 @@ def grow_tree_compact_core(
 
                 hist_other = jax.lax.cond(
                     need_other, hist_other_fn,
-                    lambda _: jnp.zeros((c_cols, col_bins, 3), jnp.float32),
+                    lambda _: jnp.zeros((hist_cols, col_bins, 3),
+                                        jnp.float32),
                     operand=None)
             else:
-                hist_other = jnp.zeros((c_cols, col_bins, 3), jnp.float32)
+                hist_other = jnp.zeros((hist_cols, col_bins, 3),
+                                       jnp.float32)
             return data, pos_leaf, leaf_begin, leaf_phys, hist_small, \
                 hist_other
         return branch
@@ -799,6 +846,15 @@ def leaf_values_from_rec(rec: jax.Array, k: jax.Array, L: int) -> jax.Array:
     return jax.lax.fori_loop(0, L - 1, body, jnp.zeros((L,), jnp.float32))
 
 
+def padded_shard_cols(c_cols: int, shards: int, item_bits: int) -> int:
+    """Word-aligned per-shard column width for feature-parallel slicing:
+    ceil(c_cols / shards) rounded up to a whole packed u32 word. The ONE
+    copy used by the learner's packing and the core's slice math."""
+    per = 32 // item_bits
+    cs = -(-c_cols // shards)
+    return -(-cs // per) * per
+
+
 def padded_device_bins(raw_bins: int) -> int:
     """Pow2-padded on-device bin count (min 16) — the one copy of the
     padding rule used for device_bins, col_device_bins and the pool
@@ -930,22 +986,8 @@ class DeviceTreeLearner:
                 self.item_bits = 4
             else:
                 self.item_bits = 8
-            nrow, ncol = host_codes.shape
-            if self.item_bits == 4:
-                npairs = ((ncol + 7) // 8) * 4      # byte pairs per row
-                byte_arr = np.zeros((nrow, npairs * 2), dtype=np.uint8)
-                byte_arr[:, :ncol] = host_codes
-                packed_bytes = (byte_arr[:, 0::2]
-                                | (byte_arr[:, 1::2] << 4)).astype(np.uint8)
-                packed = np.ascontiguousarray(packed_bytes).view(np.uint32)
-            else:
-                per = 32 // self.item_bits
-                padded = np.zeros((nrow, ((ncol + per - 1) // per) * per),
-                                  dtype=np.uint8 if self.item_bits == 8
-                                  else np.uint16)
-                padded[:, :ncol] = host_codes
-                packed = np.ascontiguousarray(padded).view(np.uint32)
-            self.c_cols = ncol
+            self.c_cols = host_codes.shape[1]
+            packed = self.pack_codes(host_codes)
             if device_place:
                 self.codes_row = jnp.asarray(host_codes)      # (N, C)
                 self.codes_pack = jnp.asarray(packed)
@@ -961,6 +1003,27 @@ class DeviceTreeLearner:
         self.last_leaf_id: Optional[jax.Array] = None
         self._leaf_id_host: Optional[np.ndarray] = None
         self._bag_mask_host: Optional[np.ndarray] = None
+
+    def pack_codes(self, host_codes: np.ndarray,
+                   col_target: Optional[int] = None) -> np.ndarray:
+        """Bit-pack (N, C) column codes into u32 words for the compact
+        working buffer. col_target pads the column capacity (the
+        feature-parallel learner needs word-aligned per-shard slices)."""
+        nrow, ncol = host_codes.shape
+        want = max(ncol, col_target or 0)
+        if self.item_bits == 4:
+            npairs = ((want + 7) // 8) * 4          # byte pairs per row
+            byte_arr = np.zeros((nrow, npairs * 2), dtype=np.uint8)
+            byte_arr[:, :ncol] = host_codes
+            packed_bytes = (byte_arr[:, 0::2]
+                            | (byte_arr[:, 1::2] << 4)).astype(np.uint8)
+            return np.ascontiguousarray(packed_bytes).view(np.uint32)
+        per = 32 // self.item_bits
+        padded = np.zeros((nrow, ((want + per - 1) // per) * per),
+                          dtype=np.uint8 if self.item_bits == 8
+                          else np.uint16)
+        padded[:, :ncol] = host_codes
+        return np.ascontiguousarray(padded).view(np.uint32)
 
     # ------------------------------------------------------------------
     @staticmethod

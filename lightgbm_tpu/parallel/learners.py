@@ -40,7 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..io.dataset import Dataset
-from ..models.device_learner import DeviceTreeLearner
+from ..models.device_learner import DeviceTreeLearner, padded_shard_cols
 from ..models.serial_learner import SerialTreeLearner, _bucket, _MIN_BUCKET
 from ..models.tree import Tree
 from ..ops import histogram as hist_ops
@@ -523,17 +523,20 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                     pool_slots=self.pool_slots,
                     scatter_cols=self.scatter_cols, **self._statics())
 
-    def _sharded_tree_fn(self, with_bag_key: bool):
+    def _sharded_tree_fn(self, with_bag_key: bool, allow_bagging=True):
         """shard_map'd whole-tree program. with_bag_key=True computes the
         per-shard bag weights inside the program (fused path); False takes
-        an explicit (n_pad,) weight vector (generic path)."""
+        an explicit (n_pad,) weight vector (generic path). allow_bagging
+        =False forces full-data growth regardless of bagging params (the
+        GOSS-warmup contract, should fused GOSS ever land here)."""
         from ..models.device_learner import grow_tree_compact_core
         statics = self._grow_statics()
         meta = self._meta
         cfg = self.config
         n = self.dataset.num_data
         local_n = self.local_n
-        bag_on = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+        bag_on = (allow_bagging and cfg.bagging_freq > 0
+                  and cfg.bagging_fraction < 1.0)
         frac = float(cfg.bagging_fraction)
 
         def local(cp_l, cr_l, g_l, h_l, w_or_key, base_mask, key):
@@ -633,7 +636,8 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         n = self.dataset.num_data
         npad = self.n_pad
         L = int(self.config.num_leaves)
-        fn = self._sharded_tree_fn(with_bag_key=True)
+        fn = self._sharded_tree_fn(with_bag_key=True,
+                                   allow_bagging=bagging)
 
         @jax.jit
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
@@ -650,6 +654,119 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         return step
 
 
+class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
+    """Whole-tree feature-parallel learner: rows REPLICATED, columns
+    partitioned — each shard builds histograms only for its word-aligned
+    column slice (the local slice over all rows IS the global histogram,
+    so there is no histogram collective at all) and the best split is
+    elected from a (D, 12) all_gather of per-shard candidates — the
+    reference FeatureParallelTreeLearner's exact communication shape
+    (feature_parallel_tree_learner.cpp:33-76, SyncUpGlobalBestSplit),
+    with the entire leaf-wise tree grown inside one shard_map program
+    instead of one host round-trip per split."""
+
+    def __init__(self, config: Config, dataset: Dataset,
+                 mesh: Optional[Mesh] = None):
+        super().__init__(config, dataset, strategy="compact",
+                         device_place=False)
+        self.mesh = mesh or make_mesh(axis_name="feature")
+        self.shards = int(self.mesh.devices.size)
+        cs = padded_shard_cols(self.c_cols, self.shards, self.item_bits)
+        self._c_pad = cs * self.shards
+        # repack with word-aligned per-shard column capacity
+        host_codes = np.asarray(self.codes_row)
+        self.codes_pack = jnp.asarray(
+            self.pack_codes(host_codes, col_target=self._c_pad))
+        self.codes_row = jnp.asarray(host_codes)
+        self._meta = (self.f_numbins, self.f_missing, self.f_default,
+                      self.f_monotone, self.f_penalty, self.f_col,
+                      self.f_base, self.f_elide, self.hist_idx)
+        self._tree_fn = None
+
+    def _grow_statics(self):
+        return dict(c_cols=self.c_cols, item_bits=self.item_bits,
+                    pool_slots=self.pool_slots,
+                    feature_shards=self.shards, **self._statics())
+
+    def _sharded_tree_fn(self):
+        from ..models.device_learner import grow_tree_compact_core
+        statics = self._grow_statics()
+        meta = self._meta
+
+        def local(cp, cr, g, h, w, base_mask, key):
+            return grow_tree_compact_core(
+                cp, cr, g, h, w, base_mask, *meta, key,
+                axis_name="feature", **statics)
+
+        reps = (P(),) * 7
+        return shard_map(local, mesh=self.mesh, in_specs=reps,
+                         out_specs=(P(), P(), P(), P()), check_vma=False)
+
+    def train(self, grad: jax.Array, hess: jax.Array,
+              bag_indices: Optional[np.ndarray] = None,
+              iter_seed: int = 0) -> Tree:
+        cfg = self.config
+        n = self.dataset.num_data
+        if bag_indices is None:
+            w = jnp.ones(n, jnp.float32)
+            self._bag_mask_host = None
+        else:
+            wv = np.zeros(n, dtype=np.float32)
+            wv[bag_indices] = 1.0
+            w = jnp.asarray(wv)
+            self._bag_mask_host = wv > 0
+        rng = np.random.RandomState(
+            (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
+        base_mask = jnp.asarray(self._feature_mask(rng)
+                                & np.asarray(self.f_categorical == 0))
+        key = jax.random.PRNGKey(iter_seed)
+        if self._tree_fn is None:
+            self._tree_fn = jax.jit(self._sharded_tree_fn())
+        rec, leaf_id, n_splits, _ = self._tree_fn(
+            self.codes_pack, self.codes_row, grad, hess, w, base_mask, key)
+        self.last_leaf_id = leaf_id
+        self._leaf_id_host = None
+        rec_h, k = jax.device_get((rec, n_splits))
+        k = int(k)
+        if k == 0:
+            log.warning("No further splits with positive gain")
+        return self.replay_tree(rec_h, k)
+
+    def make_fused_step(self, objective, goss=None, bagging=True):
+        """Fused boosting iteration over the feature mesh: one sharded
+        whole-tree program per iteration (rows replicated, columns
+        sliced), same contract as DeviceTreeLearner.make_fused_step."""
+        if goss is not None:
+            raise NotImplementedError(
+                "fused GOSS is not supported on the feature-parallel "
+                "learner")
+        from ..models.device_learner import leaf_values_from_rec
+        cfg = self.config
+        n = self.dataset.num_data
+        L = int(cfg.num_leaves)
+        bag_on = (bagging and cfg.bagging_freq > 0
+                  and cfg.bagging_fraction < 1.0)
+        bag_k = max(1, int(n * cfg.bagging_fraction))
+        fn = self._sharded_tree_fn()
+
+        @jax.jit
+        def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+            g, h = objective.get_gradients(score_row)
+            if bag_on:
+                u = jax.random.uniform(bag_key, (n,))
+                cut = jnp.sort(u)[bag_k - 1]
+                w = (u <= cut).astype(jnp.float32)
+            else:
+                w = jnp.ones((n,), jnp.float32)
+            rec, leaf_id, k, _ = fn(self.codes_pack, self.codes_row,
+                                    g, h, w, base_mask, tree_key)
+            lv = leaf_values_from_rec(rec, k, L)
+            delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
+            return score_row + delta, rec, leaf_id, k
+
+        return step
+
+
 def create_tree_learner(config: Config, dataset: Dataset,
                         mesh: Optional[Mesh] = None):
     """Factory: {serial, feature, data, voting} (reference:
@@ -658,7 +775,7 @@ def create_tree_learner(config: Config, dataset: Dataset,
     x parallelism the same way, tree_learner.cpp:24-33 GPU templates) and
     falls back to the host-loop learner for unsupported configs."""
     import os
-    from ..models.device_learner import DeviceTreeLearner
+    from ..models.device_learner import DeviceTreeLearner, padded_shard_cols
     host_only = os.environ.get("LGBM_TPU_HOST_LEARNER", "0") == "1"
     name = config.tree_learner
     if name in ("serial",):
@@ -666,6 +783,14 @@ def create_tree_learner(config: Config, dataset: Dataset,
             return DeviceTreeLearner(config, dataset)
         return SerialTreeLearner(config, dataset)
     if name in ("feature", "feature_parallel"):
+        # whole-tree device FP needs the identity feature->column mapping
+        # (no EFB bundles) and no by-node sampling
+        if (not host_only
+                and dataset.bundle_arrays() is None
+                and not (0.0 < config.feature_fraction_bynode < 1.0)
+                and DeviceTreeLearner.supports(config, dataset,
+                                               strategy="compact")):
+            return DeviceFeatureParallelTreeLearner(config, dataset, mesh)
         return FeatureParallelTreeLearner(config, dataset, mesh)
     if name in ("data", "data_parallel"):
         # the DP device learner always runs the compact strategy; check

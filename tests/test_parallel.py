@@ -124,9 +124,14 @@ def test_data_parallel_host_learner_matches_serial():
 
 
 def test_feature_parallel_matches_serial_structurally():
+    from lightgbm_tpu.parallel.learners import (
+        DeviceFeatureParallelTreeLearner)
     x, y = make_binary(1200, 10)
     bs = _train(x, y, "serial", rounds=5)
     bf = _train(x, y, "feature", rounds=5)
+    # the whole-tree device FP learner must be the default on a
+    # bundle-free dataset (one program per tree, no per-split host sync)
+    assert isinstance(bf.learner, DeviceFeatureParallelTreeLearner)
     assert_trees_structurally_equal(bs, bf, 5, "feature-parallel")
     np.testing.assert_allclose(bs.predict(x, raw_score=True),
                                bf.predict(x, raw_score=True),
@@ -134,13 +139,19 @@ def test_feature_parallel_matches_serial_structurally():
 
 
 def test_feature_parallel_binned_matrix_is_sharded():
-    """The feature-parallel mode only earns its name if the binned matrix
-    actually stays partitioned across devices (VERDICT r1 weak #4)."""
+    """The GSPMD host-loop FP learner (fallback for categoricals/EFB)
+    only earns its name if the binned matrix actually stays partitioned
+    across devices (VERDICT r1 weak #4)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.learners import FeatureParallelTreeLearner
     x, y = make_binary(800, 16)
-    bf = _train(x, y, "feature", rounds=1)
-    shardings = {d.device for d in bf.learner.binned.addressable_shards}
+    cfg = Config({"objective": "binary", "tree_learner": "feature",
+                  "verbosity": -1, "num_leaves": 15, "min_data_in_leaf": 5})
+    ds = InnerDataset(x, config=cfg, label=y)
+    lrn = FeatureParallelTreeLearner(cfg, ds)
+    shardings = {d.device for d in lrn.binned.addressable_shards}
     assert len(shardings) == 8, "binned matrix not spread over the mesh"
-    shard_cols = {s.data.shape[1] for s in bf.learner.binned.addressable_shards}
+    shard_cols = {s.data.shape[1] for s in lrn.binned.addressable_shards}
     assert shard_cols == {2}, f"expected 2 features per shard, {shard_cols}"
 
 

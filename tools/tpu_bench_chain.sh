@@ -1,0 +1,23 @@
+#!/bin/bash
+# Poll for TPU recovery, then immediately run the queued benchmark battery.
+# Results land in /tmp/tpu_bench_results.log; status in /tmp/tpu_status.log.
+cd /root/repo
+RES=/tmp/tpu_bench_results.log
+while true; do
+  if timeout 120 python -c "import jax; assert jax.default_backend()=='tpu'" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) TPU RECOVERED - starting bench battery" >> /tmp/tpu_status.log
+    break
+  fi
+  echo "$(date +%H:%M:%S) tpu down" >> /tmp/tpu_status.log
+  sleep 180
+done
+echo "=== battery start $(date +%H:%M:%S) ===" >> $RES
+echo "--- microbench_injit (incl pallas v2) ---" >> $RES
+timeout 900 python tools/microbench_injit.py 1000000 20 >> $RES 2>&1
+echo "--- microbench_gather ---" >> $RES
+timeout 900 python tools/microbench_gather.py 1000000 >> $RES 2>&1
+echo "--- scaling_probe 1M ---" >> $RES
+timeout 1500 python tools/scaling_probe.py 1000000 >> $RES 2>&1
+echo "--- bench 1M ---" >> $RES
+BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WARMUP=3 timeout 1200 python bench.py >> $RES 2>&1
+echo "=== battery done $(date +%H:%M:%S) ===" >> $RES
