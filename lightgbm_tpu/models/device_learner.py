@@ -343,7 +343,7 @@ def grow_tree_compact_core(
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         axis_name=None, pool_slots: int = 0, scatter_cols: int = 0,
-        feature_shards: int = 0):
+        feature_shards: int = 0, voting_k: int = 0):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
@@ -400,10 +400,106 @@ def grow_tree_compact_core(
     # elected exactly like scatter mode (feature_parallel_tree_learner
     # .cpp:33-76 + SyncUpGlobalBestSplit role)
     fp = feature_shards > 1 and axis_name is not None
+    voting = voting_k > 0 and axis_name is not None and not (scatter or fp)
     sliced = scatter or fp
     per_w = 32 // item_bits
 
-    if not sliced:
+    if voting:
+        # PV-Tree 2-stage voting (voting_parallel_tree_learner.cpp:170-
+        # 260): per split, every shard scans its LOCAL histograms with
+        # 1/D-scaled data gates, votes for its top-k features, the vote
+        # psum elects 2k global candidates, and ONLY the elected
+        # features' histograms are reduced — O(2k*B) communication per
+        # split instead of O(F*B). Deterministic and replicated on every
+        # shard, so no best-split broadcast is needed.
+        f_all = int(f_numbins.shape[0])
+        assert f_all == c_cols, \
+            "voting mode requires identity feature->column mapping"
+        n_elect = min(2 * voting_k, f_all)
+        # the reference scales the local gates by machine count
+        # (voting_parallel_tree_learner.cpp:57-59)
+        d_v = jax.lax.psum(1, axis_name)
+        (node_mask, _, _, _, _, best_row) = _tree_helpers(
+            base_mask, f_numbins, f_missing, f_default, f_monotone,
+            f_penalty, f_elide, hist_idx, **helper_kwargs)
+        scan_kwargs_local = dict(
+            num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
+            min_data_in_leaf=min_data_in_leaf / d_v,
+            min_sum_hessian=min_sum_hessian / d_v,
+            min_gain_to_split=min_gain_to_split)
+        scan_kwargs_global = dict(
+            num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
+            min_data_in_leaf=min_data_in_leaf,
+            min_sum_hessian=min_sum_hessian,
+            min_gain_to_split=min_gain_to_split)
+
+        def _local_rel(col_hist_l, fmask):
+            """Per-feature local best gains from the shard's histograms."""
+            lt = col_hist_l[0].sum(axis=0)        # local (sg, sh, cnt)
+            hist = bundle_ops.expand_column_hist(
+                col_hist_l, lt, hist_idx, f_elide, f_default)
+            rel, _, _, _ = split_ops.per_feature_best(
+                hist, lt[0], lt[1], lt[2], f_numbins, f_missing, f_default,
+                fmask, f_monotone, jnp.float32(-np.inf),
+                jnp.float32(np.inf), f_penalty, None, **scan_kwargs_local)
+            return rel                            # (F,)
+
+        def _vote(rel):
+            """top-k vote mask from local rel gains (ties by gain)."""
+            kth = jnp.sort(rel)[f_all - voting_k]
+            return ((rel >= kth) & (rel > NEG_INF / 2)).astype(jnp.float32)
+
+        def _elected_scan(col_hist_l, elect, sg, sh, cnt, mn, mx, fmask,
+                          child_depth):
+            """Reduce elected features' histograms and find the winner."""
+            hist_e = jax.lax.psum(jnp.take(col_hist_l, elect, axis=0),
+                                  axis_name)      # (2k, B, 3) global
+            nb_e = jnp.take(f_numbins, elect)
+            hi_e = (jnp.arange(n_elect, dtype=jnp.int32)[:, None] * col_bins
+                    + jnp.arange(col_bins, dtype=jnp.int32)[None, :])
+            hi_e = jnp.where(
+                jnp.arange(col_bins, dtype=jnp.int32)[None, :]
+                < nb_e[:, None], hi_e, n_elect * col_bins)
+            hist_f = bundle_ops.expand_column_hist(
+                hist_e, jnp.stack([sg, sh, cnt]), hi_e,
+                jnp.take(f_elide, elect), jnp.take(f_default, elect))
+            rel, t, use_m1, prefix = split_ops.per_feature_best(
+                hist_f, sg, sh, cnt, nb_e, jnp.take(f_missing, elect),
+                jnp.take(f_default, elect), jnp.take(fmask, elect),
+                jnp.take(f_monotone, elect), mn, mx,
+                jnp.take(f_penalty, elect), None, **scan_kwargs_global)
+            fe = jnp.argmax(rel).astype(jnp.int32)
+            res = split_ops.materialize_split(
+                fe, rel, t, use_m1, prefix, sg, sh, cnt, mn, mx,
+                l1=l1, l2=l2, max_delta_step=max_delta_step)
+            row = best_row(res, child_depth)
+            # map the elected-subset index back to the real feature id
+            return row.at[B_FEAT].set(
+                jnp.take(elect, fe).astype(jnp.float32))
+
+        def reduce_hist(h):
+            return h                               # stays local
+
+        def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
+            fmask = node_mask(key)
+            rel = _local_rel(col_hist, fmask)
+            votes = jax.lax.psum(_vote(rel), axis_name)
+            elect = jnp.argsort(-votes, stable=True)[:n_elect]                 .astype(jnp.int32)
+            return _elected_scan(col_hist, elect, sg, sh, cnt, mn, mx,
+                                 fmask, child_depth)
+
+        def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
+                         child_depth):
+            fmask2 = jax.vmap(node_mask)(keys2)
+            rel2 = jax.vmap(_local_rel)(col_hist2, fmask2)
+            votes2 = jax.lax.psum(jax.vmap(_vote)(rel2), axis_name)
+            elect2 = jnp.argsort(-votes2, axis=1, stable=True)[:, :n_elect]                 .astype(jnp.int32)
+            return jnp.stack([
+                _elected_scan(col_hist2[i], elect2[i], sg2[i], sh2[i],
+                              cnt2[i], mn2[i], mx2[i], fmask2[i],
+                              child_depth)
+                for i in range(2)])
+    elif not sliced:
         (node_mask, scan, store_best, scan2, store_best2,
          best_row) = _tree_helpers(
             base_mask, f_numbins, f_missing, f_default, f_monotone,
@@ -536,9 +632,9 @@ def grow_tree_compact_core(
     else:
         hist0 = build_histogram(codes_row, gh, col_bins,
                                 use_pallas=use_pallas)
-        if scatter:
-            # global totals first (the slice no longer carries column 0
-            # everywhere), then tile the columns across shards
+        if scatter or voting:
+            # global totals first (the post-reduce histogram is a column
+            # slice / stays local), then reduce per mode
             totals = jax.lax.psum(hist0[0].sum(axis=0), axis_name)
             hist0 = reduce_hist(hist0)
         else:
@@ -803,6 +899,14 @@ def packed_go_left(win: jax.Array, feat, thr, dleft,
         col, f_base[feat], f_default[feat], f_numbins[feat], f_elide[feat])
     return decide_left(fbins, thr, dleft, f_missing[feat], f_default[feat],
                        f_numbins[feat])
+
+
+def exact_k_bag_weights(bag_key: jax.Array, n: int, bag_k: int) -> jax.Array:
+    """0/1 weight vector with exactly bag_k ones, deterministic per key
+    (reference Bagging, gbdt.cpp:210-276)."""
+    u = jax.random.uniform(bag_key, (n,))
+    cut = jnp.sort(u)[bag_k - 1]
+    return (u <= cut).astype(jnp.float32)
 
 
 def route_rows_by_rec(codes_pack_rows: jax.Array, rec: jax.Array,
@@ -1105,20 +1209,8 @@ class DeviceTreeLearner:
                                 & np.asarray(self.f_categorical == 0))
         key = jax.random.PRNGKey(iter_seed)
 
-        if self.strategy == "compact":
-            rec, leaf_id, n_splits, _ = grow_tree_compact(
-                self.codes_pack, self.codes_row, grad, hess, w, base_mask,
-                self.f_numbins, self.f_missing, self.f_default,
-                self.f_monotone, self.f_penalty, self.f_col, self.f_base,
-                self.f_elide, self.hist_idx, key,
-                c_cols=self.c_cols, item_bits=self.item_bits,
-                pool_slots=self.pool_slots, **self._statics())
-        else:
-            rec, leaf_id, n_splits, _ = grow_tree(
-                self.codes_t, grad, hess, w, base_mask,
-                self.f_numbins, self.f_missing, self.f_default,
-                self.f_monotone, self.f_penalty, self.f_col, self.f_base,
-                self.f_elide, self.hist_idx, key, **self._statics())
+        rec, leaf_id, n_splits, _ = self._run_grow(
+            grad, hess, w, base_mask, key)
 
         self.last_leaf_id = leaf_id
         self._leaf_id_host = None
@@ -1127,6 +1219,23 @@ class DeviceTreeLearner:
         if k == 0:
             log.warning("No further splits with positive gain")
         return self.replay_tree(rec_h, k)
+
+    def _run_grow(self, grad, hess, w, base_mask, key):
+        """The grow-program invocation; sharded subclasses override this
+        single hook and inherit the rest of train()."""
+        if self.strategy == "compact":
+            return grow_tree_compact(
+                self.codes_pack, self.codes_row, grad, hess, w, base_mask,
+                self.f_numbins, self.f_missing, self.f_default,
+                self.f_monotone, self.f_penalty, self.f_col, self.f_base,
+                self.f_elide, self.hist_idx, key,
+                c_cols=self.c_cols, item_bits=self.item_bits,
+                pool_slots=self.pool_slots, **self._statics())
+        return grow_tree(
+            self.codes_t, grad, hess, w, base_mask,
+            self.f_numbins, self.f_missing, self.f_default,
+            self.f_monotone, self.f_penalty, self.f_col, self.f_base,
+            self.f_elide, self.hist_idx, key, **self._statics())
 
     def replay_tree(self, rec_h, k: int) -> Tree:
         """Materialize a host Tree from the fetched (L-1, 13) split-record
@@ -1222,12 +1331,8 @@ class DeviceTreeLearner:
                 w = jnp.zeros((n,), jnp.float32).at[bag_idx].set(
                     1.0, unique_indices=True)
             elif bag_on:
-                # exactly bag_k in-bag rows, deterministic per bag_key
-                # (reference Bagging, gbdt.cpp:210-276)
-                u = jax.random.uniform(bag_key, (n,))
-                cut = jnp.sort(u)[bag_k - 1]
-                inbag = u <= cut
-                w = inbag.astype(jnp.float32)
+                w = exact_k_bag_weights(bag_key, n, bag_k)
+                inbag = w > 0
             else:
                 w = jnp.ones((n,), jnp.float32)
             if bag_compact:

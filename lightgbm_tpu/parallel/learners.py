@@ -654,6 +654,26 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         return step
 
 
+class DeviceVotingParallelTreeLearner(DeviceDataParallelTreeLearner):
+    """Whole-tree voting-parallel learner (PV-Tree) on the device: the
+    data-parallel shard_map program with per-split two-stage voting —
+    local top-k election by locally-scanned gains, vote psum, and a
+    reduction of ONLY the elected 2k features' histograms
+    (voting_parallel_tree_learner.cpp:170-260). Communication per split
+    is O(2k*B), constant in feature count."""
+
+    def __init__(self, config: Config, dataset: Dataset,
+                 mesh: Optional[Mesh] = None):
+        super().__init__(config, dataset, mesh)
+        self.scatter_cols = 0              # voting replaces the scatter
+        self.voting_k = max(1, int(config.top_k))
+
+    def _grow_statics(self):
+        d = super()._grow_statics()
+        d["voting_k"] = self.voting_k
+        return d
+
+
 class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
     """Whole-tree feature-parallel learner: rows REPLICATED, columns
     partitioned — each shard builds histograms only for its word-aligned
@@ -702,35 +722,11 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         return shard_map(local, mesh=self.mesh, in_specs=reps,
                          out_specs=(P(), P(), P(), P()), check_vma=False)
 
-    def train(self, grad: jax.Array, hess: jax.Array,
-              bag_indices: Optional[np.ndarray] = None,
-              iter_seed: int = 0) -> Tree:
-        cfg = self.config
-        n = self.dataset.num_data
-        if bag_indices is None:
-            w = jnp.ones(n, jnp.float32)
-            self._bag_mask_host = None
-        else:
-            wv = np.zeros(n, dtype=np.float32)
-            wv[bag_indices] = 1.0
-            w = jnp.asarray(wv)
-            self._bag_mask_host = wv > 0
-        rng = np.random.RandomState(
-            (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
-        base_mask = jnp.asarray(self._feature_mask(rng)
-                                & np.asarray(self.f_categorical == 0))
-        key = jax.random.PRNGKey(iter_seed)
+    def _run_grow(self, grad, hess, w, base_mask, key):
         if self._tree_fn is None:
             self._tree_fn = jax.jit(self._sharded_tree_fn())
-        rec, leaf_id, n_splits, _ = self._tree_fn(
-            self.codes_pack, self.codes_row, grad, hess, w, base_mask, key)
-        self.last_leaf_id = leaf_id
-        self._leaf_id_host = None
-        rec_h, k = jax.device_get((rec, n_splits))
-        k = int(k)
-        if k == 0:
-            log.warning("No further splits with positive gain")
-        return self.replay_tree(rec_h, k)
+        return self._tree_fn(self.codes_pack, self.codes_row, grad, hess,
+                             w, base_mask, key)
 
     def make_fused_step(self, objective, goss=None, bagging=True):
         """Fused boosting iteration over the feature mesh: one sharded
@@ -753,9 +749,8 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
             g, h = objective.get_gradients(score_row)
             if bag_on:
-                u = jax.random.uniform(bag_key, (n,))
-                cut = jnp.sort(u)[bag_k - 1]
-                w = (u <= cut).astype(jnp.float32)
+                from ..models.device_learner import exact_k_bag_weights
+                w = exact_k_bag_weights(bag_key, n, bag_k)
             else:
                 w = jnp.ones((n,), jnp.float32)
             rec, leaf_id, k, _ = fn(self.codes_pack, self.codes_row,
@@ -775,7 +770,7 @@ def create_tree_learner(config: Config, dataset: Dataset,
     x parallelism the same way, tree_learner.cpp:24-33 GPU templates) and
     falls back to the host-loop learner for unsupported configs."""
     import os
-    from ..models.device_learner import DeviceTreeLearner, padded_shard_cols
+    from ..models.device_learner import DeviceTreeLearner
     host_only = os.environ.get("LGBM_TPU_HOST_LEARNER", "0") == "1"
     name = config.tree_learner
     if name in ("serial",):
@@ -800,5 +795,17 @@ def create_tree_learner(config: Config, dataset: Dataset,
             return DeviceDataParallelTreeLearner(config, dataset, mesh)
         return DataParallelTreeLearner(config, dataset, mesh)
     if name in ("voting", "voting_parallel"):
+        # device PV-Tree needs the identity mapping and a feature count
+        # the 2k election actually reduces
+        n_shards = (mesh.devices.size if mesh is not None
+                    else len(jax.devices()))
+        if (not host_only
+                and dataset.bundle_arrays() is None
+                and not (0.0 < config.feature_fraction_bynode < 1.0)
+                and dataset.num_features > 2 * max(1, int(config.top_k))
+                and n_shards > 1
+                and DeviceTreeLearner.supports(config, dataset,
+                                               strategy="compact")):
+            return DeviceVotingParallelTreeLearner(config, dataset, mesh)
         return VotingParallelTreeLearner(config, dataset, mesh)
     log.fatal("Unknown tree learner %s", name)

@@ -156,10 +156,37 @@ def test_feature_parallel_binned_matrix_is_sharded():
 
 
 def test_voting_parallel_quality():
+    from lightgbm_tpu.parallel.learners import (
+        DeviceVotingParallelTreeLearner)
     x, y = make_binary(2000, 12)
     bv = _train(x, y, "voting", rounds=15, top_k=4)
+    # the whole-tree device PV-Tree learner must engage by default
+    assert isinstance(bv.learner, DeviceVotingParallelTreeLearner)
     auc = _auc(y, bv.predict(x, raw_score=True))
     assert auc > 0.9
+
+
+def test_voting_device_matches_host_voting():
+    """Device PV-Tree and the host-loop voting learner run the same
+    algorithm over the same contiguous row partition: same local votes,
+    same elected features, near-identical trees (fp reduction order can
+    perturb gain ties)."""
+    import os
+    x, y = make_binary(1600, 12)
+    bv = _train(x, y, "voting", rounds=5, top_k=4)
+    os.environ["LGBM_TPU_HOST_LEARNER"] = "1"
+    try:
+        bh = _train(x, y, "voting", rounds=5, top_k=4)
+    finally:
+        os.environ.pop("LGBM_TPU_HOST_LEARNER", None)
+    for tv, th in zip(bv.models, bh.models):
+        assert tv.num_leaves == th.num_leaves
+    pv = bv.predict(x[:300], raw_score=True)
+    ph = bh.predict(x[:300], raw_score=True)
+    # gain ties may route a handful of rows differently; the two
+    # implementations must agree on (nearly) every prediction
+    close = np.abs(pv - ph) <= 0.05 + 0.1 * np.abs(ph)
+    assert close.mean() > 0.98, f"only {close.mean():.3f} close"
 
 
 def test_data_parallel_with_bagging():
