@@ -283,6 +283,11 @@ class _CarryC(NamedTuple):
 
 
 def _size_classes(n: int, min_bucket: int = 4096, step: int = 4):
+    """Padded window-size ladder for the lax.switch dispatch. Smaller
+    step = tighter windows (less wasted per-split work, ~step/2 mean
+    inflation) but more traced branches (compile time); tunable via
+    LGBM_TPU_WINDOW_STEP (read once at learner init, threaded through
+    as a static so the jit cache keys on it)."""
     ws = []
     wcur = min_bucket
     while wcur < n:
@@ -305,7 +310,8 @@ def _unpack_codes(words: jax.Array, c_cols: int, item_bits: int) -> jax.Array:
     jax.jit,
     static_argnames=("c_cols", "item_bits",
                      "num_leaves", "num_bins", "col_bins", "max_depth",
-                     "bynode_k", "use_pallas", "pool_slots"))
+                     "bynode_k", "use_pallas", "pool_slots",
+                     "window_step"))
 def grow_tree_compact(
         codes_pack: jax.Array,       # (N, CW) u32: packed column codes
         codes_row: jax.Array,        # (N, C) u8/u16 for the root pass
@@ -318,7 +324,7 @@ def grow_tree_compact(
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
-        pool_slots: int = 0):
+        pool_slots: int = 0, window_step: int = 4):
     return grow_tree_compact_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -328,7 +334,8 @@ def grow_tree_compact(
         l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
-        use_pallas=use_pallas, axis_name=None, pool_slots=pool_slots)
+        use_pallas=use_pallas, axis_name=None, pool_slots=pool_slots,
+        window_step=window_step)
 
 
 def grow_tree_compact_core(
@@ -343,7 +350,7 @@ def grow_tree_compact_core(
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         axis_name=None, pool_slots: int = 0, scatter_cols: int = 0,
-        feature_shards: int = 0, voting_k: int = 0):
+        feature_shards: int = 0, voting_k: int = 0, window_step: int = 4):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
@@ -424,7 +431,10 @@ def grow_tree_compact_core(
             f_penalty, f_elide, hist_idx, **helper_kwargs)
         scan_kwargs_local = dict(
             num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
-            min_data_in_leaf=min_data_in_leaf / d_v,
+            # integer division for the count gate, exactly the
+            # reference's local_config (voting_parallel:58-59)
+            min_data_in_leaf=jnp.asarray(min_data_in_leaf,
+                                         jnp.int32) // d_v,
             min_sum_hessian=min_sum_hessian / d_v,
             min_gain_to_split=min_gain_to_split)
         scan_kwargs_global = dict(
@@ -445,9 +455,12 @@ def grow_tree_compact_core(
             return rel                            # (F,)
 
         def _vote(rel):
-            """top-k vote mask from local rel gains (ties by gain)."""
-            kth = jnp.sort(rel)[f_all - voting_k]
-            return ((rel >= kth) & (rel > NEG_INF / 2)).astype(jnp.float32)
+            """Exactly-k vote mask from local rel gains (lax.top_k ties
+            break by index, same as the host learner — a >=kth threshold
+            would let gain ties cast extra votes)."""
+            _, top_idx = jax.lax.top_k(rel, min(voting_k, f_all))
+            return jnp.zeros(f_all, jnp.float32).at[top_idx].add(
+                jnp.where(rel[top_idx] > NEG_INF / 2, 1.0, 0.0))
 
         def _elected_scan(col_hist_l, elect, sg, sh, cnt, mn, mx, fmask,
                           child_depth):
@@ -605,7 +618,7 @@ def grow_tree_compact_core(
         def decode_for_hist(words2d):
             return _unpack_codes(words2d[:, :cw], c_cols, item_bits)
 
-    classes = _size_classes(n)
+    classes = _size_classes(n, step=window_step)
     wmax = classes[-1]
     thresholds = jnp.asarray(np.array(classes[:-1], np.int32))
     d_cols = cw + 4
@@ -1063,6 +1076,7 @@ class DeviceTreeLearner:
         # so the fused XLA path is the default even on TPU.
         self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
         self.strategy = resolve_strategy(config, dataset, strategy)
+        self.window_step = max(2, int(_env("LGBM_TPU_WINDOW_STEP", "4")))
         # LRU-capped histogram pool: when the dense (L,C,B,3) pool would
         # exceed the budget, the compact strategy runs with K LRU slots
         # and rebuilds sibling histograms on miss
@@ -1230,7 +1244,8 @@ class DeviceTreeLearner:
                 self.f_monotone, self.f_penalty, self.f_col, self.f_base,
                 self.f_elide, self.hist_idx, key,
                 c_cols=self.c_cols, item_bits=self.item_bits,
-                pool_slots=self.pool_slots, **self._statics())
+                pool_slots=self.pool_slots, window_step=self.window_step,
+                **self._statics())
         return grow_tree(
             self.codes_t, grad, hess, w, base_mask,
             self.f_numbins, self.f_missing, self.f_default,
@@ -1348,7 +1363,8 @@ class DeviceTreeLearner:
                     jnp.ones((bag_k,), jnp.float32), base_mask,
                     *meta, tree_key, c_cols=self.c_cols,
                     item_bits=self.item_bits,
-                    pool_slots=self.pool_slots, **statics)
+                    pool_slots=self.pool_slots,
+                    window_step=self.window_step, **statics)
                 leaf_o = route_rows_by_rec(
                     jnp.take(self.codes_pack, oob_idx, axis=0), rec, k,
                     self.f_numbins, self.f_missing, self.f_default,
@@ -1362,7 +1378,8 @@ class DeviceTreeLearner:
                     self.codes_pack, self.codes_row, g, h, w, base_mask,
                     *meta, tree_key, c_cols=self.c_cols,
                     item_bits=self.item_bits,
-                    pool_slots=self.pool_slots, **statics)
+                    pool_slots=self.pool_slots,
+                    window_step=self.window_step, **statics)
             else:
                 rec, leaf_id, k, _ = grow(
                     self.codes_t, g, h, w, base_mask, *meta, tree_key,

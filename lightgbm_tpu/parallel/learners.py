@@ -391,8 +391,12 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
                 defaults, mask, mono, jnp.float32(-jnp.inf),
                 jnp.float32(jnp.inf),
                 **{**scan_kwargs,
-                   "min_data_in_leaf": max(
-                       1, scan_kwargs["min_data_in_leaf"] // self.shards)})
+                   # the reference scales BOTH local gates by machine
+                   # count (voting_parallel_tree_learner.cpp:58-59)
+                   "min_data_in_leaf":
+                       scan_kwargs["min_data_in_leaf"] // self.shards,
+                   "min_sum_hessian":
+                       scan_kwargs["min_sum_hessian"] / self.shards})
             f = rel.shape[0]
             k = min(top_k, f)
             _, top_idx = jax.lax.top_k(rel, k)
@@ -521,7 +525,8 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     def _grow_statics(self):
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
-                    scatter_cols=self.scatter_cols, **self._statics())
+                    scatter_cols=self.scatter_cols,
+                    window_step=self.window_step, **self._statics())
 
     def _sharded_tree_fn(self, with_bag_key: bool, allow_bagging=True):
         """shard_map'd whole-tree program. with_bag_key=True computes the
@@ -706,7 +711,8 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
     def _grow_statics(self):
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
-                    feature_shards=self.shards, **self._statics())
+                    feature_shards=self.shards,
+                    window_step=self.window_step, **self._statics())
 
     def _sharded_tree_fn(self):
         from ..models.device_learner import grow_tree_compact_core

@@ -21,3 +21,18 @@ timeout 1500 python tools/scaling_probe.py 1000000 >> $RES 2>&1
 echo "--- bench 1M ---" >> $RES
 BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WARMUP=3 timeout 1200 python bench.py >> $RES 2>&1
 echo "=== battery done $(date +%H:%M:%S) ===" >> $RES
+
+# ---- A/B tuning runs (appended after the baseline battery) ----
+echo "--- bench 1M window step 2 ---" >> $RES
+LGBM_TPU_WINDOW_STEP=2 BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WARMUP=3 \
+  timeout 1500 python bench.py >> $RES 2>&1
+echo "--- bench 1M masked strategy ---" >> $RES
+LGBM_TPU_STRATEGY=masked BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=2 \
+  timeout 1200 python bench.py >> $RES 2>&1
+echo "--- bench 1M pallas hist ---" >> $RES
+LGBM_TPU_PALLAS=1 BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WARMUP=3 \
+  timeout 1200 python bench.py >> $RES 2>&1
+echo "--- bench 10.5M (reference Higgs scale) ---" >> $RES
+BENCH_ROWS=10500000 BENCH_ITERS=20 BENCH_WARMUP=3 \
+  timeout 2400 python bench.py >> $RES 2>&1
+echo "=== full battery done $(date +%H:%M:%S) ===" >> $RES
