@@ -242,3 +242,100 @@ def test_categorical_parity_with_reference(tmp_path):
     ref_on_ours = np.loadtxt(pred_file2)
     np.testing.assert_allclose(bst.predict(x, raw_score=True), ref_on_ours,
                                rtol=2e-5, atol=2e-5)
+
+
+@needs_oracle
+def test_multiclass_parity_with_reference(tmp_path):
+    """Reference-trained multiclass softmax model must predict identically
+    through our loader (per-class raw scores + softmax)."""
+    r = np.random.RandomState(3)
+    n, f, k = 900, 5, 3
+    centers = r.randn(k, f) * 2.0
+    y = r.randint(0, k, n).astype(np.float64)
+    x = centers[y.astype(int)] + r.randn(n, f)
+    train_csv = tmp_path / "mc.csv"
+    _write_csv(train_csv, x, y)
+    model = tmp_path / "ref_mc.txt"
+    _run_oracle(
+        str(tmp_path), "task=train", f"data={train_csv}",
+        "objective=multiclass", "num_class=3", "num_trees=8",
+        "num_leaves=15", "min_data_in_leaf=10", "verbosity=-1",
+        f"output_model={model}", "header=false", "label_column=0")
+    pred_file = tmp_path / "mc_preds.txt"
+    _run_oracle(
+        str(tmp_path), "task=predict", f"data={train_csv}",
+        f"input_model={model}", f"output_result={pred_file}",
+        "header=false", "label_column=0")
+    ref_preds = np.loadtxt(pred_file)          # (n, 3) probabilities
+    ours = lgb.Booster(model_file=str(model)).predict(x)
+    np.testing.assert_allclose(ours, ref_preds, rtol=2e-5, atol=2e-5)
+
+    # ours -> reference: our multiclass serialization (num_class trees
+    # per iteration, objective line) must load and score in the CLI
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "min_data_in_leaf": 10,
+                     "verbosity": -1}, ds, num_boost_round=8,
+                    verbose_eval=False)
+    ours_model = tmp_path / "ours_mc.txt"
+    bst.save_model(str(ours_model))
+    pred_file2 = tmp_path / "mc_preds_ours.txt"
+    _run_oracle(
+        str(tmp_path), "task=predict", f"data={train_csv}",
+        f"input_model={ours_model}", f"output_result={pred_file2}",
+        "header=false", "label_column=0")
+    ref_on_ours = np.loadtxt(pred_file2)
+    np.testing.assert_allclose(bst.predict(x), ref_on_ours,
+                               rtol=2e-5, atol=2e-5)
+
+
+@needs_oracle
+def test_lambdarank_query_file_parity(tmp_path):
+    """LambdaRank with a .query side file: the reference trains, we load
+    and reproduce its scores; side-file parsing (Metadata role) and the
+    ranking objective surface both get exercised end to end."""
+    r = np.random.RandomState(13)
+    nq, per = 40, 25
+    n = nq * per
+    x = r.randn(n, 6)
+    rel = np.clip((x[:, 0] + 0.5 * r.randn(n)) * 1.2 + 1.5, 0, 4)
+    y = np.floor(rel).astype(np.float64)
+    train_csv = tmp_path / "rank.csv"
+    _write_csv(train_csv, x, y)
+    with open(str(train_csv) + ".query", "w") as fh:
+        for _ in range(nq):
+            fh.write(f"{per}\n")
+    model = tmp_path / "ref_rank.txt"
+    _run_oracle(
+        str(tmp_path), "task=train", f"data={train_csv}",
+        "objective=lambdarank", "num_trees=8", "num_leaves=15",
+        "min_data_in_leaf=5", "verbosity=-1",
+        f"output_model={model}", "header=false", "label_column=0")
+    pred_file = tmp_path / "rank_preds.txt"
+    _run_oracle(
+        str(tmp_path), "task=predict", f"data={train_csv}",
+        f"input_model={model}", f"output_result={pred_file}",
+        "header=false", "label_column=0")
+    ref_preds = np.loadtxt(pred_file)
+    ours = lgb.Booster(model_file=str(model)).predict(x, raw_score=True)
+    np.testing.assert_allclose(ours, ref_preds, rtol=2e-5, atol=2e-5)
+
+    # ours -> reference, training OUR side from the file so the .query
+    # side file flows through our Metadata loader (basic.py qpath)
+    ds = lgb.Dataset(str(train_csv), params={"header": False,
+                                             "label_column": 0})
+    bst = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1}, ds,
+                    num_boost_round=8, verbose_eval=False)
+    assert bst._gbdt.train_set.metadata.query_boundaries is not None, \
+        "the .query side file must reach Metadata"
+    ours_model = tmp_path / "ours_rank.txt"
+    bst.save_model(str(ours_model))
+    pred_file2 = tmp_path / "rank_preds_ours.txt"
+    _run_oracle(
+        str(tmp_path), "task=predict", f"data={train_csv}",
+        f"input_model={ours_model}", f"output_result={pred_file2}",
+        "header=false", "label_column=0")
+    ref_on_ours = np.loadtxt(pred_file2)
+    np.testing.assert_allclose(bst.predict(x, raw_score=True), ref_on_ours,
+                               rtol=2e-5, atol=2e-5)
