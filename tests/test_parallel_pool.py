@@ -1,0 +1,64 @@
+"""Mode-combination coverage: the LRU-capped histogram pool composed
+with each distributed reduction mode. The pool's miss path (direct
+sibling rebuild) must behave identically under psum, reduce-scatter and
+feature-parallel slice histograms — these interactions are exactly where
+silent corruption would hide."""
+import numpy as np
+
+import jax
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset as InnerDataset
+from lightgbm_tpu.models.gbdt import create_boosting
+
+from conftest import make_binary
+
+
+def _train_pooled(x, y, tree_learner, pool_slots, rounds=4, **extra):
+    params = {"objective": "binary", "tree_learner": tree_learner,
+              "verbosity": -1, "num_leaves": 15, "min_data_in_leaf": 5}
+    params.update(extra)
+    cfg = Config(params)
+    ds = InnerDataset(x, config=cfg, label=y)
+    b = create_boosting(cfg, ds)
+    if pool_slots is not None:
+        b.learner.pool_slots = pool_slots
+    for _ in range(rounds):
+        b.train_one_iter()
+    return b
+
+
+def _assert_same_trees(ba, bb, what):
+    for ta, tb in zip(ba.models, bb.models):
+        assert ta.num_leaves == tb.num_leaves, what
+        for i in range(ta.num_leaves - 1):
+            assert int(ta.split_feature[i]) == int(tb.split_feature[i]), \
+                (what, i)
+            assert int(ta.internal_count[i]) == int(tb.internal_count[i]), \
+                (what, i)
+
+
+def test_scatter_dp_with_lru_pool():
+    """Reduce-scatter DP + 4-slot LRU pool == dense pool, tree for tree
+    (the miss path reduces hist_other through the same psum_scatter)."""
+    x, y = make_binary(1600, 8)
+    bd = _train_pooled(x, y, "data", None)
+    bp = _train_pooled(x, y, "data", 4)
+    _assert_same_trees(bd, bp, "scatter+pool")
+
+
+def test_feature_parallel_with_lru_pool():
+    """Feature-parallel slice histograms + LRU pool == dense pool."""
+    x, y = make_binary(1200, 10)
+    bf = _train_pooled(x, y, "feature", None)
+    bp = _train_pooled(x, y, "feature", 4)
+    _assert_same_trees(bf, bp, "fp+pool")
+
+
+def test_voting_with_lru_pool():
+    """Device PV-Tree + LRU pool == dense pool (local-histogram sibling
+    subtraction with evictions)."""
+    x, y = make_binary(1600, 12)
+    bv = _train_pooled(x, y, "voting", None, top_k=4)
+    bp = _train_pooled(x, y, "voting", 4, top_k=4)
+    _assert_same_trees(bv, bp, "voting+pool")
