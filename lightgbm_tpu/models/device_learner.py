@@ -497,7 +497,8 @@ def grow_tree_compact_core(
             fmask = node_mask(key)
             rel = _local_rel(col_hist, fmask)
             votes = jax.lax.psum(_vote(rel), axis_name)
-            elect = jnp.argsort(-votes, stable=True)[:n_elect]                 .astype(jnp.int32)
+            elect = jnp.argsort(
+                -votes, stable=True)[:n_elect].astype(jnp.int32)
             return _elected_scan(col_hist, elect, sg, sh, cnt, mn, mx,
                                  fmask, child_depth)
 
@@ -506,7 +507,9 @@ def grow_tree_compact_core(
             fmask2 = jax.vmap(node_mask)(keys2)
             rel2 = jax.vmap(_local_rel)(col_hist2, fmask2)
             votes2 = jax.lax.psum(jax.vmap(_vote)(rel2), axis_name)
-            elect2 = jnp.argsort(-votes2, axis=1, stable=True)[:, :n_elect]                 .astype(jnp.int32)
+            elect2 = jnp.argsort(
+                -votes2, axis=1,
+                stable=True)[:, :n_elect].astype(jnp.int32)
             return jnp.stack([
                 _elected_scan(col_hist2[i], elect2[i], sg2[i], sh2[i],
                               cnt2[i], mn2[i], mx2[i], fmask2[i],
