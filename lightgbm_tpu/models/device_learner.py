@@ -1108,7 +1108,19 @@ class DeviceTreeLearner:
             else:
                 self.item_bits = 8
             self.c_cols = host_codes.shape[1]
-            packed = self.pack_codes(host_codes)
+            # LGBM_TPU_PACK_WORDS pads the packed code section to a fixed
+            # u32-word width: row gathers on TPU are latency-bound per
+            # row, so wider rows may reach DMA bandwidth (A/B lever for
+            # the partition cost; costs memory proportionally)
+            pack_words = int(_env("LGBM_TPU_PACK_WORDS", "0"))
+            col_target = (pack_words * (32 // self.item_bits)
+                          if pack_words > 0 else None)
+            if col_target is not None and col_target < host_codes.shape[1]:
+                log.warning(
+                    "LGBM_TPU_PACK_WORDS=%d is below the natural packed "
+                    "width (%d cols); padding lever inactive",
+                    pack_words, host_codes.shape[1])
+            packed = self.pack_codes(host_codes, col_target=col_target)
             if device_place:
                 self.codes_row = jnp.asarray(host_codes)      # (N, C)
                 self.codes_pack = jnp.asarray(packed)

@@ -698,10 +698,14 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         self.shards = int(self.mesh.devices.size)
         cs = padded_shard_cols(self.c_cols, self.shards, self.item_bits)
         self._c_pad = cs * self.shards
-        # repack with word-aligned per-shard column capacity
+        # repack with word-aligned per-shard column capacity; honor the
+        # LGBM_TPU_PACK_WORDS A/B lever if it asks for an even wider row
+        import os as _os
+        pack_words = int(_os.environ.get("LGBM_TPU_PACK_WORDS", "0"))
+        env_cols = pack_words * (32 // self.item_bits)
         host_codes = np.asarray(self.codes_row)
-        self.codes_pack = jnp.asarray(
-            self.pack_codes(host_codes, col_target=self._c_pad))
+        self.codes_pack = jnp.asarray(self.pack_codes(
+            host_codes, col_target=max(self._c_pad, env_cols)))
         self.codes_row = jnp.asarray(host_codes)
         self._meta = (self.f_numbins, self.f_missing, self.f_default,
                       self.f_monotone, self.f_penalty, self.f_col,

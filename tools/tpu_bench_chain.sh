@@ -36,3 +36,7 @@ echo "--- bench 10.5M (reference Higgs scale) ---" >> $RES
 BENCH_ROWS=10500000 BENCH_ITERS=20 BENCH_WARMUP=3 \
   timeout 2400 python bench.py >> $RES 2>&1
 echo "=== full battery done $(date +%H:%M:%S) ===" >> $RES
+echo "--- bench 1M pack 28 words (128B rows) ---" >> $RES
+LGBM_TPU_PACK_WORDS=28 BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WARMUP=3 \
+  timeout 1500 python bench.py >> $RES 2>&1
+echo "=== extended battery done $(date +%H:%M:%S) ===" >> $RES
