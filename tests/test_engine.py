@@ -487,6 +487,38 @@ def test_fused_goss_device_sampling():
     assert _auc(y, pred) > 0.95
 
 
+def test_window_step2_matches_default():
+    """The tighter window-class ladder (LGBM_TPU_WINDOW_STEP=2) must be a
+    pure performance knob: identical trees to the default step-4 ladder."""
+    import os
+    import lightgbm_tpu as lgb
+    r = np.random.RandomState(31)
+    x = r.randn(2500, 6).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 2] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15,
+              "verbosity": -1, "min_data_in_leaf": 5}
+
+    def run(step):
+        os.environ["LGBM_TPU_STRATEGY"] = "compact"
+        if step:
+            os.environ["LGBM_TPU_WINDOW_STEP"] = step
+        try:
+            b = lgb.Booster(params=params, train_set=lgb.Dataset(x, y))
+            for _ in range(3):
+                b.update()
+            return b
+        finally:
+            os.environ.pop("LGBM_TPU_STRATEGY", None)
+            os.environ.pop("LGBM_TPU_WINDOW_STEP", None)
+
+    b4, b2 = run(None), run("2")
+    for t4, t2 in zip(b4._gbdt.models, b2._gbdt.models):
+        assert t4.num_leaves == t2.num_leaves
+        for i in range(t4.num_leaves - 1):
+            assert int(t4.split_feature[i]) == int(t2.split_feature[i])
+            assert int(t4.threshold_in_bin[i]) == int(t2.threshold_in_bin[i])
+
+
 def test_lru_histogram_pool_matches_dense():
     """The slot-capped LRU histogram pool (role of the reference's
     HistogramPool, feature_histogram.hpp:654-831) must grow identical
