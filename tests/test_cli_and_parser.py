@@ -116,3 +116,18 @@ def test_side_files_weight_query(tmp_path):
     ds.construct()
     assert ds.get_weight() is not None
     assert len(ds.get_group()) == 20
+
+
+def test_r_glue_syntax():
+    """The R package's C glue compiles against the stubbed R API (no R
+    toolchain in this image; tools/rstub declares the symbols used), so
+    signature typos in the untestable surface still fail CI."""
+    import shutil
+    import subprocess
+    if shutil.which("g++") is None:
+        import pytest
+        pytest.skip("no g++")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(["sh", os.path.join(repo, "tools", "check_r_glue.sh")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]

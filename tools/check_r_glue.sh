@@ -1,0 +1,9 @@
+#!/bin/sh
+# Syntax-check the R package's C glue without an R installation: the
+# stub headers in tools/rstub declare the R API symbols the glue uses,
+# so signature typos and undeclared identifiers surface in CI even
+# though this image has no R toolchain.
+set -e
+DIR=$(dirname "$0")
+g++ -fsyntax-only -I"$DIR/rstub" "$DIR/../R-package/src/lightgbm_tpu_R.cpp"
+echo "R glue syntax OK"

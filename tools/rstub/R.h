@@ -1,0 +1,49 @@
+/* minimal stub for syntax-checking lightgbm_tpu_R.cpp without R */
+#pragma once
+#include <cstddef>
+typedef void* SEXP;
+extern "C" {
+SEXP R_NilValue;
+typedef void (*R_CFinalizer_t)(SEXP);
+SEXP R_MakeExternalPtr(void*, SEXP, SEXP);
+void* R_ExternalPtrAddr(SEXP);
+void R_RegisterCFinalizerEx(SEXP, R_CFinalizer_t, int);
+void R_ClearExternalPtr(SEXP);
+int Rf_asInteger(SEXP);
+double Rf_asReal(SEXP);
+SEXP Rf_asChar(SEXP);
+const char* CHAR(SEXP);
+SEXP Rf_mkString(const char*);
+SEXP Rf_mkChar(const char*);
+SEXP Rf_ScalarInteger(int);
+SEXP Rf_ScalarReal(double);
+SEXP Rf_ScalarLogical(int);
+SEXP Rf_allocVector(unsigned, long);
+SEXP Rf_protect(SEXP);
+void Rf_unprotect(int);
+void Rf_error(const char*, ...);
+double* REAL(SEXP);
+int* INTEGER(SEXP);
+int* LOGICAL(SEXP);
+SEXP STRING_ELT(SEXP, long);
+void SET_STRING_ELT(SEXP, long, SEXP);
+long Rf_xlength(SEXP);
+int TYPEOF(SEXP);
+}
+#define PROTECT(x) Rf_protect(x)
+#define UNPROTECT(n) Rf_unprotect(n)
+#define STRSXP 16
+#define REALSXP 14
+#define INTSXP 13
+extern "C" {
+int Rf_isNull(SEXP);
+long Rf_length(SEXP);
+SEXP VECTOR_ELT(SEXP, long);
+void SET_VECTOR_ELT(SEXP, long, SEXP);
+}
+#define TRUE 1
+#define FALSE 0
+#define LGLSXP 10
+#define VECSXP 19
+typedef long R_xlen_t;
+#include "R_ext_Rdynload.h"
