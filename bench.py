@@ -85,6 +85,11 @@ def main():
         os.environ.setdefault("LGBM_TPU_STRATEGY", "masked")
     import lightgbm_tpu as lgb
     sys.stderr.write(f"backend: {backend}\n")
+    knobs = {k: os.environ[k] for k in
+             ("LGBM_TPU_STRATEGY", "LGBM_TPU_WINDOW_STEP",
+              "LGBM_TPU_PACK_WORDS", "LGBM_TPU_PALLAS",
+              "LGBM_TPU_DP_REDUCE") if k in os.environ}
+    sys.stderr.write(f"rows={N_ROWS} iters={N_ITERS} knobs={knobs}\n")
 
     x, y = make_higgs_like(N_ROWS, N_FEATURES)
     params = {
