@@ -62,3 +62,22 @@ def test_voting_with_lru_pool():
     bv = _train_pooled(x, y, "voting", None, top_k=4)
     bp = _train_pooled(x, y, "voting", 4, top_k=4)
     _assert_same_trees(bv, bp, "voting+pool")
+
+
+def test_goss_on_data_parallel_learner():
+    """GOSS cannot fuse on the sharded learners (global top-k not in the
+    sharded program); it must fall back to the generic path with host
+    sampling and still learn."""
+    x, y = make_binary(2000, 8)
+    b = _train_pooled(x, y, "data", None, rounds=12, boosting="goss",
+                      top_rate=0.3, other_rate=0.2, learning_rate=0.3)
+    assert b._fused_step is None or not b._fused_step, \
+        "GOSS+DP must not take the fused path"
+    s = b.predict(x, raw_score=True)
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0
+    auc = ((ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
+           / (pos.sum() * (~pos).sum()))
+    assert auc > 0.9, auc
