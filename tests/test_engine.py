@@ -664,3 +664,21 @@ def test_categorical_handle_na_exact():
                     num_boost_round=1)
     pred = bst.predict(x)
     np.testing.assert_allclose(pred, y, atol=1e-6)
+
+
+def test_early_stopping_first_metric_only():
+    """first_metric_only: the stopper tracks only the first metric even
+    when a second metric keeps improving (reference callback.py:221)."""
+    x, y = make_binary(2400)
+    xt, yt, xv, yv = x[:1600], y[:1600], x[1600:], y[1600:]
+    params = {"objective": "binary", "metric": ["binary_logloss", "auc"],
+              "first_metric_only": True, "verbosity": -1}
+    ds = lgb.Dataset(xt, yt, free_raw_data=False)
+    vds = lgb.Dataset(xv, yv, reference=ds, free_raw_data=False)
+    evals = {}
+    bst = lgb.train(params, ds, num_boost_round=60, valid_sets=[vds],
+                    valid_names=["val"], early_stopping_rounds=5,
+                    evals_result=evals, verbose_eval=False)
+    assert bst.best_iteration > 0
+    # both metrics were still recorded
+    assert "binary_logloss" in evals["val"] and "auc" in evals["val"]
