@@ -7,7 +7,14 @@ Higgs 10.5M x 28, 255 leaves, 500 iters, 238.5 s on 2x E5-2670v3 =>
 255 leaves, 63 bins like the GPU experiments) on a size that fits the bench
 budget and report throughput in row-trees/sec vs that baseline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+diagnostic fields: "degraded" (true when the accelerator was unusable and
+the workload was self-capped — the value is then NOT comparable to the
+baseline), "backend", "rows", "iters", "valid_auc", and "sec_to_auc"
+(wall seconds of update() calls — warmup + first-jit compile included,
+see "warmup_secs" — until held-out AUC first reached BENCH_AUC_TARGET;
+null if never reached; mirrors the reference's time-to-AUC headline,
+docs/Experiments.rst:106: 238.5 s to AUC 0.845154).
 """
 import json
 import os
@@ -43,17 +50,24 @@ N_FEATURES = 28
 N_ITERS = int(os.environ.get("BENCH_ITERS", 50))
 WARMUP_ITERS = int(os.environ.get("BENCH_WARMUP", 5))
 BASELINE_ROWTREES_PER_SEC = 10_500_000 * 500 / 238.505  # reference Higgs CPU
+AUC_TARGET = float(os.environ.get("BENCH_AUC_TARGET", 0.75))
+EVAL_EVERY = int(os.environ.get("BENCH_EVAL_EVERY", 10))
+N_VALID = int(os.environ.get("BENCH_VALID_ROWS", 100_000))
 
 
-def make_higgs_like(n, f, seed=17):
+def make_higgs_like(n, f, seed=17, w=None):
     """Synthetic stand-in with Higgs-like statistics: mixed informative /
-    noise features, moderately separable classes."""
+    noise features, moderately separable classes. Pass `w` to draw a new
+    sample from the SAME ground-truth function (e.g. a held-out valid set)
+    without perturbing the default stream, which is bit-identical to the
+    rounds 1-2 training sets."""
     r = np.random.RandomState(seed)
     x = r.randn(n, f).astype(np.float32)
-    w = r.randn(f) * (r.rand(f) > 0.4)
+    if w is None:
+        w = r.randn(f) * (r.rand(f) > 0.4)
     logit = x @ w * 0.3 + 0.2 * x[:, 0] * x[:, 1] - 0.1 * x[:, 2] ** 2
     y = (logit + r.randn(n) * 1.5 > 0).astype(np.float64)
-    return x, y
+    return x, y, w
 
 
 def main():
@@ -91,7 +105,12 @@ def main():
               "LGBM_TPU_DP_REDUCE") if k in os.environ}
     sys.stderr.write(f"rows={N_ROWS} iters={N_ITERS} knobs={knobs}\n")
 
-    x, y = make_higgs_like(N_ROWS, N_FEATURES)
+    # any capped run (explicit CPU or fallback) is not comparable to the
+    # 22M row-trees/s TPU-vs-CPU baseline: flag it machine-readably
+    degraded = backend in ("cpu", "cpu-fallback")
+    n_valid = min(N_VALID, max(N_ROWS // 10, 1000))
+    x, y, w_true = make_higgs_like(N_ROWS, N_FEATURES)
+    xv, yv, _ = make_higgs_like(n_valid, N_FEATURES, seed=4242, w=w_true)
     params = {
         "objective": "binary",
         "num_leaves": num_leaves,
@@ -109,33 +128,72 @@ def main():
     t_warm = time.time()
     for _ in range(WARMUP_ITERS):
         booster.update()
+    warmup_secs = time.time() - t_warm
     sys.stderr.write(
-        f"warmup ({WARMUP_ITERS} iters, incl. compile) {time.time()-t_warm:.1f}s\n")
+        f"warmup ({WARMUP_ITERS} iters, incl. compile) {warmup_secs:.1f}s\n")
 
-    t0 = time.time()
-    for _ in range(N_ITERS):
+    def rank_auc(scores, labels):
+        # tie-aware (mid-rank) AUC: few-tree models collapse many rows
+        # onto identical score sums; ordinal ranks would credit tied
+        # pos/neg pairs 0-or-1 by row order instead of 0.5
+        _, inv, counts = np.unique(scores, return_inverse=True,
+                                   return_counts=True)
+        avg_rank = np.cumsum(counts) - counts + (counts + 1) / 2.0
+        ranks = avg_rank[inv]
+        pos = labels > 0
+        return float((ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
+                     / max(pos.sum() * (~pos).sum(), 1))
+
+    # timed loop: the clock accumulates update() wall only; held-out AUC is
+    # evaluated off-clock every EVAL_EVERY iters to find sec_to_auc (the
+    # reference's headline is time-to-AUC, docs/Experiments.rst:106).
+    # sec_to_auc counts the warmup iterations' wall too (their trees also
+    # move the AUC), so it includes the first-jit compile cost.
+    t_train = 0.0
+    sec_to_auc = None
+    for i in range(N_ITERS):
+        t0 = time.time()
         booster.update()
-    elapsed = time.time() - t0
-    iters_per_sec = N_ITERS / elapsed
+        t_train += time.time() - t0
+        # the final-model eval below is the last scheduled check, so skip
+        # the mid-loop one on the last iteration (no duplicate predict)
+        if (sec_to_auc is None and EVAL_EVERY and i + 1 < N_ITERS
+                and (i + 1) % EVAL_EVERY == 0):
+            mid_auc = rank_auc(booster.predict(xv, raw_score=True), yv)
+            if mid_auc >= AUC_TARGET:
+                sec_to_auc = round(warmup_secs + t_train, 3)
+                sys.stderr.write(
+                    f"iter {i+1}: valid AUC {mid_auc:.4f} >= "
+                    f"{AUC_TARGET} at {sec_to_auc}s train wall "
+                    f"(incl. {warmup_secs:.1f}s warmup+compile)\n")
+    iters_per_sec = N_ITERS / t_train if t_train > 0 else 0.0
     rowtrees_per_sec = N_ROWS * iters_per_sec
 
+    valid_auc = rank_auc(booster.predict(xv, raw_score=True), yv)
+    if sec_to_auc is None and valid_auc >= AUC_TARGET:
+        sec_to_auc = round(warmup_secs + t_train, 3)
+    sys.stderr.write(f"valid AUC ({len(yv)} held-out): {valid_auc:.4f}\n")
     # sanity: the model must actually learn
-    s = booster.predict(x[:100_000], raw_score=True)
-    yy = y[:100_000]
-    order = np.argsort(s)
-    ranks = np.empty(len(s))
-    ranks[order] = np.arange(1, len(s) + 1)
-    pos = yy > 0
-    auc = float((ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
-                / max(pos.sum() * (~pos).sum(), 1))
-    sys.stderr.write(f"train AUC (100k sample): {auc:.4f}\n")
-    assert auc > 0.60, "model failed to learn"
+    train_auc = rank_auc(booster.predict(x[:100_000], raw_score=True),
+                         y[:100_000])
+    sys.stderr.write(f"train AUC (100k sample): {train_auc:.4f}\n")
+    assert train_auc > 0.60, "model failed to learn"
 
     print(json.dumps({
         "metric": "higgs_like_train_throughput",
         "value": round(rowtrees_per_sec, 1),
         "unit": "row-trees/sec",
-        "vs_baseline": round(rowtrees_per_sec / BASELINE_ROWTREES_PER_SEC, 4),
+        "vs_baseline": 0.0 if degraded else
+            round(rowtrees_per_sec / BASELINE_ROWTREES_PER_SEC, 4),
+        "degraded": degraded,
+        "backend": backend,
+        "rows": N_ROWS,
+        "iters": N_ITERS,
+        "num_leaves": num_leaves,
+        "valid_auc": round(valid_auc, 5),
+        "auc_target": AUC_TARGET,
+        "sec_to_auc": sec_to_auc,
+        "warmup_secs": round(warmup_secs, 3),
     }))
 
 
@@ -164,5 +222,6 @@ if __name__ == "__main__":
             "value": 0.0,
             "unit": "row-trees/sec",
             "vs_baseline": 0.0,
+            "degraded": True,
             "error": f"{type(exc).__name__}: {exc}"[:500],
         }))
