@@ -25,8 +25,14 @@ Design deltas vs the reference's DataPartition/HistogramPool machinery:
   exactly the `best_split_per_leaf_` argmax of the reference.
 
 Monotone constraints propagate like serial_tree_learner.cpp:771-852 (basic
-mode); depth limits gate stored gains. Categorical splits, forced splits and
-CEGB fall back to the host-loop learner (create_tree_learner picks).
+mode); depth limits gate stored gains. Categorical splits run INSIDE the
+whole-tree program (one-hot and sorted k-vs-rest, the device analog of
+feature_histogram.hpp:118-279): each leaf's scan merges the numerical and
+categorical winners, the winning left-bin mask lives in a (L, B) store and
+is recorded per split for host replay into bitset tree nodes. Forced splits
+and CEGB fall back to the host-loop learner (create_tree_learner picks);
+the parallel device learners keep categorical gated (their supports() call
+passes categorical_ok=False).
 """
 from __future__ import annotations
 
@@ -78,7 +84,9 @@ class _Carry(NamedTuple):
     leaf_min: jax.Array
     leaf_max: jax.Array
     best: jax.Array          # (L, 12) f32
+    best_cat: jax.Array      # (L, B|1) f32 0/1 left-bin masks
     rec: jax.Array           # (L-1, 13) f32
+    rec_cat: jax.Array       # (L-1, B|1) f32
     key: jax.Array
 
 
@@ -93,15 +101,35 @@ def _hist_t(codes_t, gh, num_bins, use_pallas):
 def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
                   f_penalty, f_elide, hist_idx, *, num_bins, max_depth,
                   l1, l2, max_delta_step, min_data_in_leaf, min_sum_hessian,
-                  min_gain_to_split, bynode_k):
+                  min_gain_to_split, bynode_k,
+                  f_categorical=None, cat_statics=None):
     """Shared pieces of both growth strategies: per-node feature sampling,
     the (expand + scan + materialize) split search, and per-leaf best-state
-    stores with depth gating."""
+    stores with depth gating.
+
+    cat_statics = (cat_l2, cat_smooth, max_cat_threshold,
+    max_cat_to_onehot, min_data_per_group) switches the scan into merged
+    numerical+categorical mode: each leaf evaluates both searches over the
+    same expanded histogram and the better gain wins (the in-program analog
+    of SerialTreeLearner._merge_categorical). scan then returns
+    (SplitResult, left-bin mask) where the mask is all-zero for a numerical
+    winner; without cat_statics the mask is a (1,) placeholder."""
     f = f_numbins.shape[0]
+    has_cat = cat_statics is not None
+    cat_b = num_bins if has_cat else 1
     scan_kwargs = dict(
         num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split)
+    if has_cat:
+        is_cat = f_categorical != 0
+        cat_l2, cat_smooth, max_cat_threshold, max_cat_to_onehot, \
+            min_data_per_group = cat_statics
+        cat_kwargs = dict(
+            scan_kwargs, cat_l2=cat_l2, cat_smooth=cat_smooth,
+            max_cat_threshold=max_cat_threshold,
+            max_cat_to_onehot=max_cat_to_onehot,
+            min_data_per_group=min_data_per_group)
 
     def node_mask(key):
         if bynode_k <= 0:
@@ -114,12 +142,45 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
         hist = bundle_ops.expand_column_hist(
             col_hist, jnp.stack([sg, sh, cnt]), hist_idx, f_elide, f_default)
         rel, t, use_m1, prefix = split_ops.per_feature_best(
-            hist, sg, sh, cnt, f_numbins, f_missing, f_default, fmask,
+            hist, sg, sh, cnt, f_numbins, f_missing, f_default,
+            fmask & ~is_cat if has_cat else fmask,
             f_monotone, mn, mx, f_penalty, None, **scan_kwargs)
         feat = jnp.argmax(rel).astype(jnp.int32)
-        return split_ops.materialize_split(
+        res = split_ops.materialize_split(
             feat, rel, t, use_m1, prefix, sg, sh, cnt, mn, mx,
             l1=l1, l2=l2, max_delta_step=max_delta_step)
+        if not has_cat:
+            return res, jnp.zeros((cat_b,), jnp.float32)
+        crel, caux = split_ops.per_feature_best_categorical(
+            hist, sg, sh, cnt, f_numbins, f_missing, fmask & is_cat,
+            mn, mx, f_penalty, **cat_kwargs)
+        cfeat = jnp.argmax(crel).astype(jnp.int32)
+        cres = split_ops.materialize_cat_split(
+            cfeat, crel, caux, hist, sg, sh, cnt, mn, mx,
+            l1=l1, l2=l2, cat_l2=cat_l2, max_delta_step=max_delta_step)
+        cat_wins = cres.gain > res.gain
+        merged = split_ops.SplitResult(
+            gain=jnp.where(cat_wins, cres.gain, res.gain),
+            feature=jnp.where(cat_wins, cres.feature, res.feature),
+            threshold=jnp.where(cat_wins, 0, res.threshold),
+            default_left=jnp.where(cat_wins, False, res.default_left),
+            left_sum_grad=jnp.where(
+                cat_wins, cres.left_sum_grad, res.left_sum_grad),
+            left_sum_hess=jnp.where(
+                cat_wins, cres.left_sum_hess, res.left_sum_hess),
+            left_count=jnp.where(cat_wins, cres.left_count, res.left_count),
+            right_sum_grad=jnp.where(
+                cat_wins, cres.right_sum_grad, res.right_sum_grad),
+            right_sum_hess=jnp.where(
+                cat_wins, cres.right_sum_hess, res.right_sum_hess),
+            right_count=jnp.where(
+                cat_wins, cres.right_count, res.right_count),
+            left_output=jnp.where(
+                cat_wins, cres.left_output, res.left_output),
+            right_output=jnp.where(
+                cat_wins, cres.right_output, res.right_output))
+        cm = jnp.where(cat_wins, cres.left_mask.astype(jnp.float32), 0.0)
+        return merged, cm
 
     def _best_row(res: split_ops.SplitResult, child_depth) -> jax.Array:
         gain = res.gain
@@ -133,19 +194,21 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
             res.right_sum_grad, res.right_sum_hess, res.right_count,
             res.left_output, res.right_output])
 
-    def store_best(best: jax.Array, i, res: split_ops.SplitResult,
-                   child_depth) -> jax.Array:
-        return best.at[i].set(_best_row(res, child_depth))
+    def store_best(best: jax.Array, best_cat: jax.Array, i,
+                   res: split_ops.SplitResult, cm, child_depth):
+        return (best.at[i].set(_best_row(res, child_depth)),
+                best_cat.at[i].set(cm))
 
     def scan2(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2):
         """Both children's split scans in one vectorized pass."""
         fmask2 = jax.vmap(node_mask)(keys2)
         return jax.vmap(scan)(col_hist2, sg2, sh2, cnt2, mn2, mx2, fmask2)
 
-    def store_best2(best, i2, res2: split_ops.SplitResult, child_depth):
+    def store_best2(best, best_cat, i2, res2: split_ops.SplitResult, cm2,
+                    child_depth):
         rows = jax.vmap(functools.partial(_best_row,
                                           child_depth=child_depth))(res2)
-        return best.at[i2].set(rows)
+        return best.at[i2].set(rows), best_cat.at[i2].set(cm2)
 
     return node_mask, scan, store_best, scan2, store_best2, _best_row
 
@@ -153,13 +216,14 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "col_bins", "max_depth",
-                     "bynode_k", "use_pallas"))
+                     "bynode_k", "use_pallas", "cat_statics"))
 def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
               grad: jax.Array, hess: jax.Array,   # (N,)
               w: jax.Array,               # (N,) bagging weight (0/1)
               base_mask: jax.Array,       # (F,) bool feature sample
               f_numbins, f_missing, f_default, f_monotone,  # (F,) int32
               f_penalty,                  # (F,) f32 gain multipliers
+              f_categorical,              # (F,) int32 1 = categorical
               f_col, f_base, f_elide,     # (F,) int32 EFB maps
               hist_idx,                   # (F, B) int32 expansion gather
               rng_key,                    # PRNG key for by-node sampling
@@ -167,10 +231,13 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
               max_depth: int,
               l1: float, l2: float, max_delta_step: float,
               min_data_in_leaf: int, min_sum_hessian: float,
-              min_gain_to_split: float, bynode_k: int, use_pallas: bool):
+              min_gain_to_split: float, bynode_k: int, use_pallas: bool,
+              cat_statics=None):
     c_cols, n = codes_t.shape
     f = f_numbins.shape[0]
     L = num_leaves
+    has_cat = cat_statics is not None
+    cat_b = num_bins if has_cat else 1
     gh = jnp.stack([grad * w, hess * w, w], axis=1)     # (N, 3)
     node_mask, scan, store_best, scan2, store_best2, _ = _tree_helpers(
         base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -178,21 +245,24 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
         max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
-        bynode_k=bynode_k)
+        bynode_k=bynode_k, f_categorical=f_categorical,
+        cat_statics=cat_statics)
 
     # ---- root ------------------------------------------------------------
     hist0 = _hist_t(codes_t, gh, col_bins, use_pallas)
     totals = hist0[0].sum(axis=0)                       # (3,): sum_g, sum_h, cnt
     root_key, loop_key = jax.random.split(rng_key)
-    root_res = scan(hist0, totals[0], totals[1], totals[2],
-                    jnp.float32(-np.inf), jnp.float32(np.inf),
-                    node_mask(root_key))
+    root_res, root_cm = scan(hist0, totals[0], totals[1], totals[2],
+                             jnp.float32(-np.inf), jnp.float32(np.inf),
+                             node_mask(root_key))
 
     best = jnp.full((L, 12), NEG_INF, jnp.float32) \
         .at[:, B_FEAT:].set(0.0)
+    best_cat = jnp.zeros((L, cat_b), jnp.float32)
     # the depth argument is the stored leaf's own depth (a leaf at depth d
     # may split iff d < max_depth, reference _splittable); root sits at 0
-    best = store_best(best, 0, root_res, jnp.int32(0))
+    best, best_cat = store_best(best, best_cat, 0, root_res, root_cm,
+                                jnp.int32(0))
     pool = jnp.zeros((L, c_cols, col_bins, 3), jnp.float32).at[0].set(hist0)
     rec = jnp.zeros((L - 1, 13), jnp.float32)
     zi = functools.partial(jnp.zeros, dtype=jnp.int32)
@@ -201,7 +271,8 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
         depth=zi(L),
         leaf_min=jnp.full((L,), -np.inf, jnp.float32),
         leaf_max=jnp.full((L,), np.inf, jnp.float32),
-        best=best, rec=rec, key=loop_key)
+        best=best, best_cat=best_cat, rec=rec,
+        rec_cat=jnp.zeros((L - 1, cat_b), jnp.float32), key=loop_key)
 
     def cond(c: _Carry):
         return (c.k < L - 1) & (jnp.max(c.best[:, B_GAIN]) > 1e-10)
@@ -221,6 +292,12 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
             f_numbins[feat], f_elide[feat])
         go_left = decide_left(fbins, thr, dleft,
                               f_missing[feat], f_default[feat], f_numbins[feat])
+        if has_cat:
+            # categorical routing: left iff the row's logical bin is in
+            # the winning left-bin mask (CategoricalDecisionInner)
+            cmask = c.best_cat[l]
+            cat_left = cmask[jnp.clip(fbins, 0, cat_b - 1)] > 0.5
+            go_left = jnp.where(f_categorical[feat] != 0, cat_left, go_left)
         parent = c.leaf_id == l
         lmask = parent & go_left
         leaf_id = jnp.where(parent & ~go_left, new_id, c.leaf_id)
@@ -248,20 +325,24 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
                        row[B_DLEFT], row[B_GAIN]]),
             row[B_LSG:]])
         rec2 = c.rec.at[c.k].set(rec_row)
+        rec_cat2 = c.rec_cat.at[c.k].set(c.best_cat[l])
 
         key, kl, kr = jax.random.split(c.key, 3)
-        res2 = scan2(jnp.stack([hist_l, hist_r]),
-                     jnp.stack([row[B_LSG], row[B_RSG]]),
-                     jnp.stack([row[B_LSH], row[B_RSH]]),
-                     jnp.stack([row[B_LCNT], row[B_RCNT]]),
-                     jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
-                     jnp.stack([kl, kr]))
-        best2 = store_best2(b, jnp.stack([l, new_id]), res2, child_depth)
+        res2, cm2 = scan2(jnp.stack([hist_l, hist_r]),
+                          jnp.stack([row[B_LSG], row[B_RSG]]),
+                          jnp.stack([row[B_LSH], row[B_RSH]]),
+                          jnp.stack([row[B_LCNT], row[B_RCNT]]),
+                          jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
+                          jnp.stack([kl, kr]))
+        best2, best_cat2 = store_best2(b, c.best_cat,
+                                       jnp.stack([l, new_id]), res2, cm2,
+                                       child_depth)
         return _Carry(new_id, leaf_id, pool, depth, leaf_min, leaf_max,
-                      best2, rec2, key)
+                      best2, best_cat2, rec2, rec_cat2, key)
 
     out = jax.lax.while_loop(cond, body, carry)
-    return out.rec, out.leaf_id, out.k, totals
+    return (out.rec, out.rec_cat if has_cat else None,
+            out.leaf_id, out.k, totals)
 
 
 class _CarryC(NamedTuple):
@@ -278,7 +359,9 @@ class _CarryC(NamedTuple):
     leaf_min: jax.Array
     leaf_max: jax.Array
     best: jax.Array          # (L, 12) f32
+    best_cat: jax.Array      # (L, B|1) f32 0/1 left-bin masks
     rec: jax.Array           # (L-1, 13) f32
+    rec_cat: jax.Array       # (L-1, B|1) f32
     key: jax.Array
 
 
@@ -311,31 +394,31 @@ def _unpack_codes(words: jax.Array, c_cols: int, item_bits: int) -> jax.Array:
     static_argnames=("c_cols", "item_bits",
                      "num_leaves", "num_bins", "col_bins", "max_depth",
                      "bynode_k", "use_pallas", "pool_slots",
-                     "window_step"))
+                     "window_step", "cat_statics"))
 def grow_tree_compact(
         codes_pack: jax.Array,       # (N, CW) u32: packed column codes
         codes_row: jax.Array,        # (N, C) u8/u16 for the root pass
         grad: jax.Array, hess: jax.Array, w: jax.Array,
         base_mask: jax.Array,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
-        f_col, f_base, f_elide, hist_idx, rng_key,
+        f_categorical, f_col, f_base, f_elide, hist_idx, rng_key,
         *, c_cols: int, item_bits: int,
         num_leaves: int, num_bins: int, col_bins: int, max_depth: int,
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
-        pool_slots: int = 0, window_step: int = 4):
+        pool_slots: int = 0, window_step: int = 4, cat_statics=None):
     return grow_tree_compact_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
-        f_col, f_base, f_elide, hist_idx, rng_key,
+        f_categorical, f_col, f_base, f_elide, hist_idx, rng_key,
         c_cols=c_cols, item_bits=item_bits, num_leaves=num_leaves,
         num_bins=num_bins, col_bins=col_bins, max_depth=max_depth,
         l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
         use_pallas=use_pallas, axis_name=None, pool_slots=pool_slots,
-        window_step=window_step)
+        window_step=window_step, cat_statics=cat_statics)
 
 
 def grow_tree_compact_core(
@@ -343,14 +426,15 @@ def grow_tree_compact_core(
         grad: jax.Array, hess: jax.Array, w: jax.Array,
         base_mask: jax.Array,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
-        f_col, f_base, f_elide, hist_idx, rng_key,
+        f_categorical, f_col, f_base, f_elide, hist_idx, rng_key,
         *, c_cols: int, item_bits: int,
         num_leaves: int, num_bins: int, col_bins: int, max_depth: int,
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         axis_name=None, pool_slots: int = 0, scatter_cols: int = 0,
-        feature_shards: int = 0, voting_k: int = 0, window_step: int = 4):
+        feature_shards: int = 0, voting_k: int = 0, window_step: int = 4,
+        cat_statics=None):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
@@ -389,6 +473,8 @@ def grow_tree_compact_core(
     n = grad.shape[0]
     cw = codes_pack.shape[1]
     L = num_leaves
+    has_cat = cat_statics is not None
+    cat_b = num_bins if has_cat else 1
     # K=1 cannot hold both children of a split (the second allocation
     # would evict the first and corrupt the sibling subtraction)
     K = max(2, pool_slots) if 0 < pool_slots < L else L
@@ -419,6 +505,8 @@ def grow_tree_compact_core(
         # features' histograms are reduced — O(2k*B) communication per
         # split instead of O(F*B). Deterministic and replicated on every
         # shard, so no best-split broadcast is needed.
+        assert not has_cat, \
+            "categorical splits are not wired into voting mode"
         f_all = int(f_numbins.shape[0])
         assert f_all == c_cols, \
             "voting mode requires identity feature->column mapping"
@@ -499,8 +587,9 @@ def grow_tree_compact_core(
             votes = jax.lax.psum(_vote(rel), axis_name)
             elect = jnp.argsort(
                 -votes, stable=True)[:n_elect].astype(jnp.int32)
-            return _elected_scan(col_hist, elect, sg, sh, cnt, mn, mx,
-                                 fmask, child_depth)
+            return (_elected_scan(col_hist, elect, sg, sh, cnt, mn, mx,
+                                  fmask, child_depth),
+                    jnp.zeros((cat_b,), jnp.float32))
 
         def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
                          child_depth):
@@ -514,29 +603,34 @@ def grow_tree_compact_core(
                 _elected_scan(col_hist2[i], elect2[i], sg2[i], sh2[i],
                               cnt2[i], mn2[i], mx2[i], fmask2[i],
                               child_depth)
-                for i in range(2)])
+                for i in range(2)]), jnp.zeros((2, cat_b), jnp.float32)
     elif not sliced:
         (node_mask, scan, store_best, scan2, store_best2,
          best_row) = _tree_helpers(
             base_mask, f_numbins, f_missing, f_default, f_monotone,
-            f_penalty, f_elide, hist_idx, **helper_kwargs)
+            f_penalty, f_elide, hist_idx,
+            f_categorical=f_categorical, cat_statics=cat_statics,
+            **helper_kwargs)
 
         def reduce_hist(h):
             return jax.lax.psum(h, axis_name) if axis_name is not None else h
 
         def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
-            res = scan(col_hist, sg, sh, cnt, mn, mx, node_mask(key))
-            return best_row(res, child_depth)
+            res, cm = scan(col_hist, sg, sh, cnt, mn, mx, node_mask(key))
+            return best_row(res, child_depth), cm
 
         def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
                          child_depth):
-            res2 = scan2(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2)
+            res2, cm2 = scan2(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2)
             return jax.vmap(
-                functools.partial(best_row, child_depth=child_depth))(res2)
+                functools.partial(best_row,
+                                  child_depth=child_depth))(res2), cm2
     else:
         # feature-sliced scan: every shard searches only the columns it
         # owns (after the reduce-scatter in scatter mode; built directly
         # in feature-parallel mode), then candidates are elected
+        assert not has_cat, \
+            "categorical splits are not wired into sliced modes"
         D = scatter_cols if scatter else feature_shards
         f_all = int(f_numbins.shape[0])
         assert f_all == c_cols, \
@@ -589,14 +683,14 @@ def grow_tree_compact_core(
             return rows[jnp.argmax(rows[:, B_GAIN])]
 
         def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
-            res = scan_sl(col_hist, sg, sh, cnt, mn, mx, mask_sl)
+            res, _ = scan_sl(col_hist, sg, sh, cnt, mn, mx, mask_sl)
             row = best_row(res, child_depth)
             row = row.at[B_FEAT].add(start.astype(jnp.float32))
-            return _elect(row)
+            return _elect(row), jnp.zeros((cat_b,), jnp.float32)
 
         def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
                          child_depth):
-            res2 = jax.vmap(scan_sl)(
+            res2, _ = jax.vmap(scan_sl)(
                 col_hist2, sg2, sh2, cnt2, mn2, mx2,
                 jnp.broadcast_to(mask_sl, (2,) + mask_sl.shape))
             rows = jax.vmap(
@@ -604,7 +698,7 @@ def grow_tree_compact_core(
             rows = rows.at[:, B_FEAT].add(start.astype(jnp.float32))
             g = jax.lax.all_gather(rows, axis_name)          # (D, 2, 12)
             win = jnp.argmax(g[:, :, B_GAIN], axis=0)        # (2,)
-            return g[win, jnp.arange(2)]
+            return g[win, jnp.arange(2)], jnp.zeros((2, cat_b), jnp.float32)
 
     hist_cols = cs if fp else c_cols   # width of branch-built histograms
     if fp:
@@ -658,13 +752,14 @@ def grow_tree_compact_core(
             totals = hist0[0].sum(axis=0)
     pool_c = hist0.shape[0]
     root_key, loop_key = jax.random.split(rng_key)
-    row0 = search_row(hist0, totals[0], totals[1], totals[2],
-                      jnp.float32(-np.inf), jnp.float32(np.inf),
-                      root_key, jnp.int32(0))
+    row0, cm0 = search_row(hist0, totals[0], totals[1], totals[2],
+                           jnp.float32(-np.inf), jnp.float32(np.inf),
+                           root_key, jnp.int32(0))
 
     zi = functools.partial(jnp.zeros, dtype=jnp.int32)
     best = jnp.full((L, 12), NEG_INF, jnp.float32).at[:, B_FEAT:].set(0.0)
     best = best.at[0].set(row0)
+    best_cat = jnp.zeros((L, cat_b), jnp.float32).at[0].set(cm0)
     pool = jnp.zeros((K, pool_c, col_bins, 3), jnp.float32).at[0].set(hist0)
     rec = jnp.zeros((L - 1, 13), jnp.float32)
     carry = _CarryC(
@@ -679,7 +774,8 @@ def grow_tree_compact_core(
         depth=zi(L),
         leaf_min=jnp.full((L,), -np.inf, jnp.float32),
         leaf_max=jnp.full((L,), np.inf, jnp.float32),
-        best=best, rec=rec, key=loop_key)
+        best=best, best_cat=best_cat, rec=rec,
+        rec_cat=jnp.zeros((L - 1, cat_b), jnp.float32), key=loop_key)
 
     def cond(c: _CarryC):
         return (c.k < L - 1) & (jnp.max(c.best[:, B_GAIN]) > 1e-10)
@@ -698,7 +794,9 @@ def grow_tree_compact_core(
             go_left = packed_go_left(
                 win, feat, row[B_THR].astype(jnp.int32),
                 row[B_DLEFT] > 0.5, f_numbins, f_missing, f_default,
-                f_col, f_base, f_elide, item_bits=item_bits) & valid
+                f_col, f_base, f_elide, item_bits=item_bits,
+                f_categorical=f_categorical if has_cat else None,
+                cat_mask=c.best_cat[l] if has_cat else None) & valid
 
             # stable partition of the window (reference DataPartition::
             # Split): overrun rows past pcount get key 2, so the stable
@@ -875,35 +973,45 @@ def grow_tree_compact_core(
                        row[B_DLEFT], row[B_GAIN]]),
             row[B_LSG:]])
         rec2 = c.rec.at[c.k].set(rec_row)
+        rec_cat2 = c.rec_cat.at[c.k].set(c.best_cat[l])
 
         key, kl, kr = jax.random.split(c.key, 3)
-        rows2 = search2_rows(jnp.stack([hist_l, hist_r]),
-                             jnp.stack([row[B_LSG], row[B_RSG]]),
-                             jnp.stack([row[B_LSH], row[B_RSH]]),
-                             jnp.stack([row[B_LCNT], row[B_RCNT]]),
-                             jnp.stack([lmin, rmin]),
-                             jnp.stack([lmax, rmax]),
-                             jnp.stack([kl, kr]), child_depth)
-        best2 = b.at[jnp.stack([l, new_id])].set(rows2)
+        rows2, cm2 = search2_rows(jnp.stack([hist_l, hist_r]),
+                                  jnp.stack([row[B_LSG], row[B_RSG]]),
+                                  jnp.stack([row[B_LSH], row[B_RSH]]),
+                                  jnp.stack([row[B_LCNT], row[B_RCNT]]),
+                                  jnp.stack([lmin, rmin]),
+                                  jnp.stack([lmax, rmax]),
+                                  jnp.stack([kl, kr]), child_depth)
+        i2 = jnp.stack([l, new_id])
+        best2 = b.at[i2].set(rows2)
+        best_cat2 = c.best_cat.at[i2].set(cm2)
         return _CarryC(new_id, data, pos_leaf, leaf_begin, leaf_phys,
                        pool, slot_of, slot_owner, slot_last,
-                       depth, leaf_min, leaf_max, best2, rec2, key)
+                       depth, leaf_min, leaf_max, best2, best_cat2,
+                       rec2, rec_cat2, key)
 
     out = jax.lax.while_loop(cond, body, carry)
     # final row -> leaf map: scatter physical-position leaves onto row ids
     row_ids = out.data[:n, d_cols - 1].astype(jnp.int32)
     leaf_id = jnp.zeros(n, jnp.int32).at[row_ids].set(
         out.pos_leaf[:n], unique_indices=True)
-    return out.rec, leaf_id, out.k, totals
+    return (out.rec, out.rec_cat if has_cat else None,
+            leaf_id, out.k, totals)
 
 
 def packed_go_left(win: jax.Array, feat, thr, dleft,
                    f_numbins, f_missing, f_default, f_col, f_base, f_elide,
-                   *, item_bits: int) -> jax.Array:
+                   *, item_bits: int, f_categorical=None,
+                   cat_mask=None) -> jax.Array:
     """Decode feature `feat`'s codes from a packed u32 row window and
     apply the split decision — the one copy of the unpack + logical-bin +
     decide_left sequence shared by the partition branches and the
-    out-of-bag router (any drift between them would silently mis-route)."""
+    out-of-bag router (any drift between them would silently mis-route).
+
+    cat_mask (B,) enables categorical routing: when `feat` is categorical
+    the row goes left iff its logical bin is set in the mask (the bitset
+    semantics of CategoricalDecisionInner / partition_step_categorical)."""
     per = 32 // item_bits
     mask = jnp.uint32((1 << item_bits) - 1)
     n_r = win.shape[0]
@@ -913,8 +1021,12 @@ def packed_go_left(win: jax.Array, feat, thr, dleft,
     col = ((col32 >> (sub * item_bits)) & mask).astype(jnp.int32)
     fbins = bundle_ops.logical_bins_for_feature(
         col, f_base[feat], f_default[feat], f_numbins[feat], f_elide[feat])
-    return decide_left(fbins, thr, dleft, f_missing[feat], f_default[feat],
-                       f_numbins[feat])
+    num_left = decide_left(fbins, thr, dleft, f_missing[feat],
+                           f_default[feat], f_numbins[feat])
+    if cat_mask is None:
+        return num_left
+    cat_left = cat_mask[jnp.clip(fbins, 0, cat_mask.shape[0] - 1)] > 0.5
+    return jnp.where(f_categorical[feat] != 0, cat_left, num_left)
 
 
 def exact_k_bag_weights(bag_key: jax.Array, n: int, bag_k: int) -> jax.Array:
@@ -928,7 +1040,8 @@ def exact_k_bag_weights(bag_key: jax.Array, n: int, bag_k: int) -> jax.Array:
 def route_rows_by_rec(codes_pack_rows: jax.Array, rec: jax.Array,
                       k: jax.Array, f_numbins, f_missing, f_default,
                       f_col, f_base, f_elide, *, item_bits: int,
-                      num_leaves: int) -> jax.Array:
+                      num_leaves: int, rec_cat=None,
+                      f_categorical=None) -> jax.Array:
     """Assign rows to leaves by replaying the (L-1, 13) split records.
 
     The role of the reference's out-of-bag AddPredictionToScore: rows that
@@ -945,7 +1058,8 @@ def route_rows_by_rec(codes_pack_rows: jax.Array, rec: jax.Array,
             codes_pack_rows, r[R_FEAT].astype(jnp.int32),
             r[R_THR].astype(jnp.int32), r[R_DLEFT] > 0.5,
             f_numbins, f_missing, f_default, f_col, f_base, f_elide,
-            item_bits=item_bits)
+            item_bits=item_bits, f_categorical=f_categorical,
+            cat_mask=None if rec_cat is None else rec_cat[i])
         at = leaf == r[R_LEAF].astype(jnp.int32)
         return jnp.where(do & at & ~go_left, i + 1, leaf)
 
@@ -1028,6 +1142,11 @@ class DeviceTreeLearner:
         self.dataset = dataset
         (self.f_numbins, self.f_missing, self.f_default,
          self.f_categorical, self.f_monotone) = dataset.feature_meta_arrays()
+        # categorical splits run inside the whole-tree program (scan-level
+        # merge); gbdt's fused path checks cat_in_program before masking
+        # categorical features out of the feature sample
+        self._has_cat = bool(np.any(np.asarray(self.f_categorical)))
+        self.cat_in_program = self._has_cat
         self.num_features = dataset.num_features
         self.num_bins = int(dataset.max_num_bins)
         self.device_bins = padded_device_bins(self.num_bins)
@@ -1161,11 +1280,15 @@ class DeviceTreeLearner:
     # ------------------------------------------------------------------
     @staticmethod
     def supports(config: Config, dataset: Dataset,
-                 strategy: Optional[str] = None) -> bool:
+                 strategy: Optional[str] = None,
+                 categorical_ok: bool = True) -> bool:
         """Static capability check; unsupported configs use the host-loop
-        learner (create_tree_learner falls back)."""
-        if any(dataset.bin_mappers[fr].bin_type == BIN_CATEGORICAL
-               for fr in dataset.used_features):
+        learner (create_tree_learner falls back). categorical_ok=False is
+        the parallel device learners' gate — categorical scan/routing is
+        wired into the single-chip program only."""
+        if not categorical_ok and any(
+                dataset.bin_mappers[fr].bin_type == BIN_CATEGORICAL
+                for fr in dataset.used_features):
             return False
         if config.forcedsplits_filename:
             return False
@@ -1194,7 +1317,16 @@ class DeviceTreeLearner:
         bynode_k = 0
         if 0.0 < cfg.feature_fraction_bynode < 1.0:
             bynode_k = max(1, int(self.num_features * cfg.feature_fraction_bynode))
+        # a hashable tuple (jit static): (cat_l2, cat_smooth,
+        # max_cat_threshold, max_cat_to_onehot, min_data_per_group)
+        cat_statics = None
+        if self._has_cat:
+            cat_statics = (float(cfg.cat_l2), float(cfg.cat_smooth),
+                           int(cfg.max_cat_threshold),
+                           int(cfg.max_cat_to_onehot),
+                           int(cfg.min_data_per_group))
         return dict(
+            cat_statics=cat_statics,
             num_leaves=int(cfg.num_leaves), num_bins=self.device_bins,
             col_bins=self.col_device_bins,
             max_depth=int(cfg.max_depth), l1=float(cfg.lambda_l1),
@@ -1234,20 +1366,23 @@ class DeviceTreeLearner:
             self._bag_mask_host = wv > 0
         rng = np.random.RandomState(
             (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
-        base_mask = jnp.asarray(self._feature_mask(rng)
-                                & np.asarray(self.f_categorical == 0))
+        base_mask = jnp.asarray(self._feature_mask(rng))
         key = jax.random.PRNGKey(iter_seed)
 
-        rec, leaf_id, n_splits, _ = self._run_grow(
+        rec, rec_cat, leaf_id, n_splits, _ = self._run_grow(
             grad, hess, w, base_mask, key)
 
         self.last_leaf_id = leaf_id
         self._leaf_id_host = None
-        rec_h, k = jax.device_get((rec, n_splits))
+        if rec_cat is None:
+            rec_h, k = jax.device_get((rec, n_splits))
+            rec_cat_h = None
+        else:
+            rec_h, rec_cat_h, k = jax.device_get((rec, rec_cat, n_splits))
         k = int(k)
         if k == 0:
             log.warning("No further splits with positive gain")
-        return self.replay_tree(rec_h, k)
+        return self.replay_tree(rec_h, k, rec_cat_h)
 
     def _run_grow(self, grad, hess, w, base_mask, key):
         """The grow-program invocation; sharded subclasses override this
@@ -1256,7 +1391,8 @@ class DeviceTreeLearner:
             return grow_tree_compact(
                 self.codes_pack, self.codes_row, grad, hess, w, base_mask,
                 self.f_numbins, self.f_missing, self.f_default,
-                self.f_monotone, self.f_penalty, self.f_col, self.f_base,
+                self.f_monotone, self.f_penalty, self.f_categorical,
+                self.f_col, self.f_base,
                 self.f_elide, self.hist_idx, key,
                 c_cols=self.c_cols, item_bits=self.item_bits,
                 pool_slots=self.pool_slots, window_step=self.window_step,
@@ -1264,12 +1400,16 @@ class DeviceTreeLearner:
         return grow_tree(
             self.codes_t, grad, hess, w, base_mask,
             self.f_numbins, self.f_missing, self.f_default,
-            self.f_monotone, self.f_penalty, self.f_col, self.f_base,
+            self.f_monotone, self.f_penalty, self.f_categorical,
+            self.f_col, self.f_base,
             self.f_elide, self.hist_idx, key, **self._statics())
 
-    def replay_tree(self, rec_h, k: int) -> Tree:
+    def replay_tree(self, rec_h, k: int, rec_cat_h=None) -> Tree:
         """Materialize a host Tree from the fetched (L-1, 13) split-record
-        array (the one device->host transfer per tree)."""
+        array (the one device->host transfer per tree). rec_cat_h carries
+        the categorical winners' (L-1, B) left-bin masks; a split whose
+        feature is categorical replays as a bitset node."""
+        from .serial_learner import _make_bitset
         ds = self.dataset
         rec_h = np.asarray(rec_h)
         tree = Tree(self.config.num_leaves)
@@ -1278,6 +1418,23 @@ class DeviceTreeLearner:
             inner_f = int(r[R_FEAT])
             real_f = ds.inner_to_real(inner_f)
             mapper = ds.bin_mappers[real_f]
+            if mapper.bin_type == BIN_CATEGORICAL and rec_cat_h is not None:
+                bins = [int(bb) for bb in
+                        np.nonzero(np.asarray(rec_cat_h[i]) > 0.5)[0]]
+                inner_bits = _make_bitset(bins)
+                cats = [mapper.bin_2_categorical[b] for b in bins
+                        if b < len(mapper.bin_2_categorical)]
+                real_bits = _make_bitset(cats)
+                tree.split_categorical(
+                    int(r[R_LEAF]), inner_f, real_f,
+                    [int(wd) for wd in inner_bits],
+                    [int(wd) for wd in real_bits],
+                    float(r[R_LOUT]), float(r[R_ROUT]),
+                    int(round(float(r[R_LCNT]))),
+                    int(round(float(r[R_RCNT]))),
+                    float(r[R_LSH]), float(r[R_RSH]),
+                    float(r[R_GAIN]), mapper.missing_type)
+                continue
             thr_bin = int(r[R_THR])
             tree.split(
                 int(r[R_LEAF]), inner_f, real_f, thr_bin,
@@ -1314,7 +1471,8 @@ class DeviceTreeLearner:
         use_compact = self.strategy == "compact"
         grow = grow_tree_compact if use_compact else grow_tree
         meta = (self.f_numbins, self.f_missing, self.f_default,
-                self.f_monotone, self.f_penalty, self.f_col, self.f_base,
+                self.f_monotone, self.f_penalty, self.f_categorical,
+                self.f_col, self.f_base,
                 self.f_elide, self.hist_idx)
         if goss is not None:
             top_k, other_k, multiply = goss
@@ -1371,7 +1529,7 @@ class DeviceTreeLearner:
                         jnp.where(inbag, 0, 1).astype(jnp.int8),
                         stable=True)
                     bag_idx, oob_idx = order[:bag_k], order[bag_k:]
-                rec, leaf_b, k, _ = grow(
+                rec, rec_cat, leaf_b, k, _ = grow(
                     jnp.take(self.codes_pack, bag_idx, axis=0),
                     jnp.take(self.codes_row, bag_idx, axis=0),
                     jnp.take(g, bag_idx), jnp.take(h, bag_idx),
@@ -1384,26 +1542,27 @@ class DeviceTreeLearner:
                     jnp.take(self.codes_pack, oob_idx, axis=0), rec, k,
                     self.f_numbins, self.f_missing, self.f_default,
                     self.f_col, self.f_base, self.f_elide,
-                    item_bits=self.item_bits, num_leaves=L)
+                    item_bits=self.item_bits, num_leaves=L,
+                    rec_cat=rec_cat, f_categorical=self.f_categorical)
                 leaf_id = jnp.zeros(n, jnp.int32) \
                     .at[bag_idx].set(leaf_b, unique_indices=True) \
                     .at[oob_idx].set(leaf_o, unique_indices=True)
             elif use_compact:
-                rec, leaf_id, k, _ = grow(
+                rec, rec_cat, leaf_id, k, _ = grow(
                     self.codes_pack, self.codes_row, g, h, w, base_mask,
                     *meta, tree_key, c_cols=self.c_cols,
                     item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
                     window_step=self.window_step, **statics)
             else:
-                rec, leaf_id, k, _ = grow(
+                rec, rec_cat, leaf_id, k, _ = grow(
                     self.codes_t, g, h, w, base_mask, *meta, tree_key,
                     **statics)
 
             # on-device leaf-value replay avoids any H2D of leaf values
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
-            return score_row + delta, rec, leaf_id, k
+            return score_row + delta, rec, rec_cat, leaf_id, k
 
         return step
 

@@ -61,6 +61,10 @@ class ScoreUpdater:
         self.score = self.score.at[class_id].add(jnp.float32(val))
 
     def add_tree(self, tree: Tree, class_id: int) -> None:
+        if not getattr(tree, "inner_valid", True):
+            # deserialized trees (init_model / BoosterMerge continuation)
+            # carry raw thresholds only; reconstruct binned routing first
+            tree.rebin_inner(self.dataset)
         vals = predict_ops.predict_binned_tree_values(
             self.dataset.device_binned(), self.f_missing, self.f_default,
             self.f_numbins, tree)
@@ -142,7 +146,14 @@ class GBDT:
     def add_valid(self, valid_set: Dataset, name: str) -> None:
         self.valid_sets.append(valid_set)
         self.valid_names.append(name)
-        self.valid_updaters.append(ScoreUpdater(valid_set, self.num_class))
+        vu = ScoreUpdater(valid_set, self.num_class)
+        # a valid set added after trees already exist (init_model / merge
+        # continuation, or add_valid mid-training) must see their scores
+        per = max(self.num_tree_per_iteration, 1)
+        for it in range(len(self.models) // per):
+            for k in range(per):
+                vu.add_tree(self.models[it * per + k], k)
+        self.valid_updaters.append(vu)
         metrics = create_metrics(self.config.metric, self.config,
                                  self.config.objective)
         for m in metrics:
@@ -246,9 +257,12 @@ class GBDT:
         fused_step = self._fused_step[fkey]
         rng = np.random.RandomState(
             (cfg.feature_fraction_seed + self.iter) % (2**31 - 1))
-        base_mask = jnp.asarray(
-            self.learner._feature_mask(rng)
-            & np.asarray(self.learner.f_categorical == 0))
+        fmask = self.learner._feature_mask(rng)
+        if not getattr(self.learner, "cat_in_program", False):
+            # learners without in-program categorical splitting (the
+            # parallel device learners) must not sample cat features
+            fmask = fmask & np.asarray(self.learner.f_categorical == 0)
+        base_mask = jnp.asarray(fmask)
         tree_key = jax.random.PRNGKey(self.iter)
         # same bag key for bagging_freq consecutive iterations == reference
         # re-bags only on iter % freq == 0 and reuses the bag otherwise;
@@ -256,17 +270,21 @@ class GBDT:
         freq = 1 if self._fused_goss() else max(cfg.bagging_freq, 1)
         bag_key = jax.random.PRNGKey(
             (cfg.bagging_seed + (self.iter // freq)) % (2**31 - 1))
-        new_score, rec, leaf_id, k_dev = fused_step(
+        new_score, rec, rec_cat, leaf_id, k_dev = fused_step(
             self.score_updater.score[0], base_mask, tree_key, bag_key,
             jnp.float32(self.shrinkage_rate))
-        rec_h, k = jax.device_get((rec, k_dev))
+        if rec_cat is None:
+            rec_h, k = jax.device_get((rec, k_dev))
+            rec_cat_h = None
+        else:
+            rec_h, rec_cat_h, k = jax.device_get((rec, rec_cat, k_dev))
         k = int(k)
         if k == 0:
             # delegate the stop bookkeeping (constant init-score tree on a
             # first-iteration stop, warning, model trimming) to the generic
             # path so both paths produce identical final models
             return self._train_one_iter_generic()
-        tree = self.learner.replay_tree(rec_h, k)
+        tree = self.learner.replay_tree(rec_h, k, rec_cat_h)
         tree.apply_shrinkage(self.shrinkage_rate)
         if abs(init_score) > K_EPSILON:
             tree.add_bias(init_score)

@@ -277,20 +277,18 @@ class CatSplitResult(NamedTuple):
     right_output: jax.Array
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_bins",))
-def find_best_split_categorical(
+def per_feature_best_categorical(
     hist: jax.Array, sum_grad: jax.Array, sum_hess: jax.Array,
     num_data: jax.Array, feature_num_bins: jax.Array,
     feature_missing: jax.Array, feature_mask: jax.Array,
     min_constraint: jax.Array, max_constraint: jax.Array,
+    feature_penalty: jax.Array = None,
     *, num_bins: int, l1: float, l2: float, cat_l2: float, cat_smooth: float,
     max_delta_step: float, min_data_in_leaf: int, min_sum_hessian: float,
     min_gain_to_split: float, max_cat_threshold: int, max_cat_to_onehot: int,
     min_data_per_group: int,
-) -> CatSplitResult:
-    """Categorical k-vs-rest split search (reference:
+):
+    """Per-feature categorical k-vs-rest best gains (reference:
     feature_histogram.hpp:118-279 FindBestThresholdCategorical).
 
     One-hot mode for small cardinality; otherwise bins are sorted by
@@ -298,6 +296,13 @@ def find_best_split_categorical(
     by max_cat_threshold). Vectorized over features x sorted-positions.
     Deviation noted: the reference's min_data_per_group *running-group*
     accumulation is approximated by the per-candidate right-count check.
+
+    Returns (rel_gains (F,), aux) where rel_gains are min_gain_shift-
+    relative (penalty-scaled) gains comparable to per_feature_best's, and
+    aux holds what materialize_cat_split needs to build the winner's
+    left-bin mask. Split out from the monolithic jit so the whole-tree
+    device program can merge categorical and numerical candidates in one
+    traced scan (the device analog of SerialTreeLearner._merge_categorical).
     """
     f, b, _ = hist.shape
     g = hist[:, :, 0]
@@ -389,16 +394,37 @@ def find_best_split_categorical(
 
     per_gain = jnp.where(use_onehot, oh_best, sort_best)
     per_gain = jnp.where(feature_mask, per_gain, NEG_INF)
-    feat = jnp.argmax(per_gain).astype(jnp.int32)
-    gain = per_gain[feat]
-
-    # left mask over inner bins for the winner
-    onehot_mask = (jnp.arange(b, dtype=jnp.int32) == oh_t[feat])
-    k = sort_t[feat]
-    sel_sorted = (pos[0] <= k)
-    fwd_mask = jnp.zeros(b, dtype=bool).at[order[feat]].set(sel_sorted & v_s[feat])
+    rel = jnp.where(per_gain > NEG_INF / 2,
+                    per_gain - min_gain_shift, NEG_INF)
+    if feature_penalty is not None:
+        rel = jnp.where(rel > NEG_INF / 2, rel * feature_penalty, rel)
     order_r = roll_rows(order[:, ::-1])
-    bwd_mask = jnp.zeros(b, dtype=bool).at[order_r[feat]].set(sel_sorted & v_r[feat])
+    aux = (use_onehot, oh_t, sort_t, use_fwd, order, v_s, order_r, v_r)
+    return rel, aux
+
+
+def materialize_cat_split(feat, rel, aux, hist,
+                          sum_grad, sum_hess, num_data,
+                          min_constraint, max_constraint,
+                          *, l1, l2, cat_l2,
+                          max_delta_step) -> CatSplitResult:
+    """Build the full CatSplitResult (incl. the left-bin mask over inner
+    bins) for one chosen categorical feature."""
+    use_onehot, oh_t, sort_t, use_fwd, order, v_s, order_r, v_r = aux
+    b = hist.shape[1]
+    g = hist[:, :, 0]
+    h = hist[:, :, 1]
+    c = hist[:, :, 2]
+    gain = rel[feat]
+
+    pos_b = jnp.arange(b, dtype=jnp.int32)
+    onehot_mask = (pos_b == oh_t[feat])
+    k = sort_t[feat]
+    sel_sorted = (pos_b <= k)
+    fwd_mask = jnp.zeros(b, dtype=bool).at[order[feat]].set(
+        sel_sorted & v_s[feat])
+    bwd_mask = jnp.zeros(b, dtype=bool).at[order_r[feat]].set(
+        sel_sorted & v_r[feat])
     sorted_mask = jnp.where(use_fwd[feat], fwd_mask, bwd_mask)
     left_mask = jnp.where(use_onehot[feat], onehot_mask, sorted_mask)
 
@@ -408,12 +434,44 @@ def find_best_split_categorical(
     rg = sum_grad - lg
     rh = sum_hess - lh
     rc = num_data - lc
-    w_l2 = jnp.where(use_onehot[feat], l2, eff_l2)
-    lo = jnp.clip(-_threshold_l1(lg, l1) / (lh + w_l2), min_constraint, max_constraint)
-    ro = jnp.clip(-_threshold_l1(rg, l1) / (rh + w_l2), min_constraint, max_constraint)
+    w_l2 = jnp.where(use_onehot[feat], l2, l2 + cat_l2)
+    lo = jnp.clip(-_threshold_l1(lg, l1) / (lh + w_l2),
+                  min_constraint, max_constraint)
+    ro = jnp.clip(-_threshold_l1(rg, l1) / (rh + w_l2),
+                  min_constraint, max_constraint)
     limit = jnp.where(max_delta_step > 0, max_delta_step, jnp.inf)
     lo = jnp.clip(lo, -limit, limit)
     ro = jnp.clip(ro, -limit, limit)
-    rel_gain = jnp.where(gain > NEG_INF / 2, gain - min_gain_shift, NEG_INF)
-    return CatSplitResult(rel_gain, feat, left_mask, lg, lh, lc,
-                          rg, rh, rc, lo, ro)
+    return CatSplitResult(gain, feat.astype(jnp.int32), left_mask,
+                          lg, lh, lc, rg, rh, rc, lo, ro)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins",))
+def find_best_split_categorical(
+    hist: jax.Array, sum_grad: jax.Array, sum_hess: jax.Array,
+    num_data: jax.Array, feature_num_bins: jax.Array,
+    feature_missing: jax.Array, feature_mask: jax.Array,
+    min_constraint: jax.Array, max_constraint: jax.Array,
+    *, num_bins: int, l1: float, l2: float, cat_l2: float, cat_smooth: float,
+    max_delta_step: float, min_data_in_leaf: int, min_sum_hessian: float,
+    min_gain_to_split: float, max_cat_threshold: int, max_cat_to_onehot: int,
+    min_data_per_group: int,
+) -> CatSplitResult:
+    """Whole-leaf categorical winner (host-loop learner entry point)."""
+    rel, aux = per_feature_best_categorical(
+        hist, sum_grad, sum_hess, num_data, feature_num_bins,
+        feature_missing, feature_mask, min_constraint, max_constraint,
+        num_bins=num_bins, l1=l1, l2=l2, cat_l2=cat_l2,
+        cat_smooth=cat_smooth, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split,
+        max_cat_threshold=max_cat_threshold,
+        max_cat_to_onehot=max_cat_to_onehot,
+        min_data_per_group=min_data_per_group)
+    feat = jnp.argmax(rel).astype(jnp.int32)
+    return materialize_cat_split(
+        feat, rel, aux, hist, sum_grad, sum_hess, num_data,
+        min_constraint, max_constraint,
+        l1=l1, l2=l2, cat_l2=cat_l2, max_delta_step=max_delta_step)

@@ -489,6 +489,12 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                  mesh: Optional[Mesh] = None):
         super().__init__(config, dataset, strategy="compact",
                          device_place=False)
+        # create_tree_learner gates with categorical_ok=False; a direct
+        # construction must not silently drop the cat masks (the local()
+        # wrapper discards rec_cat)
+        assert not self._has_cat, \
+            "categorical features are not supported on the sharded " \
+            "device learners; use the host parallel learners"
         self.mesh = mesh or make_mesh(axis_name="data")
         self.shards = int(self.mesh.devices.size)
         # reduce-scatter mode needs the identity feature->column mapping
@@ -517,8 +523,8 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         self.codes_pack = jax.device_put(jnp.asarray(cp), rsh)
         self.codes_row = jax.device_put(jnp.asarray(cr), rsh)
         self._meta = (self.f_numbins, self.f_missing, self.f_default,
-                      self.f_monotone, self.f_penalty, self.f_col,
-                      self.f_base, self.f_elide, self.hist_idx)
+                      self.f_monotone, self.f_penalty, self.f_categorical,
+                      self.f_col, self.f_base, self.f_elide, self.hist_idx)
         self._tree_w_fn = None
 
     # ------------------------------------------------------------------
@@ -571,9 +577,12 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                     w_l = alive.astype(jnp.float32)
             else:
                 w_l = w_or_key * alive.astype(jnp.float32)
-            return grow_tree_compact_core(
+            rec, _rec_cat, leaf_id, ks, tot = grow_tree_compact_core(
                 cp_l, cr_l, g_l, h_l, w_l, base_mask, *meta, key,
                 axis_name="data", **statics)
+            # rec_cat is None here (categorical is gated off the parallel
+            # learners, see supports(categorical_ok=False))
+            return rec, leaf_id, ks, tot
 
         w_spec = P() if with_bag_key else P("data")
         return shard_map(
@@ -654,7 +663,7 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
             leaf_id = leaf_id_pad[:n]
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
-            return score_row + delta, rec, leaf_id, k
+            return score_row + delta, rec, None, leaf_id, k
 
         return step
 
@@ -694,6 +703,9 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                  mesh: Optional[Mesh] = None):
         super().__init__(config, dataset, strategy="compact",
                          device_place=False)
+        assert not self._has_cat, \
+            "categorical features are not supported on the sharded " \
+            "device learners; use the host parallel learners"
         self.mesh = mesh or make_mesh(axis_name="feature")
         self.shards = int(self.mesh.devices.size)
         cs = padded_shard_cols(self.c_cols, self.shards, self.item_bits)
@@ -708,8 +720,8 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
             host_codes, col_target=max(self._c_pad, env_cols)))
         self.codes_row = jnp.asarray(host_codes)
         self._meta = (self.f_numbins, self.f_missing, self.f_default,
-                      self.f_monotone, self.f_penalty, self.f_col,
-                      self.f_base, self.f_elide, self.hist_idx)
+                      self.f_monotone, self.f_penalty, self.f_categorical,
+                      self.f_col, self.f_base, self.f_elide, self.hist_idx)
         self._tree_fn = None
 
     def _grow_statics(self):
@@ -724,9 +736,10 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         meta = self._meta
 
         def local(cp, cr, g, h, w, base_mask, key):
-            return grow_tree_compact_core(
+            rec, _rec_cat, leaf_id, ks, tot = grow_tree_compact_core(
                 cp, cr, g, h, w, base_mask, *meta, key,
                 axis_name="feature", **statics)
+            return rec, leaf_id, ks, tot
 
         reps = (P(),) * 7
         return shard_map(local, mesh=self.mesh, in_specs=reps,
@@ -735,8 +748,9 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
     def _run_grow(self, grad, hess, w, base_mask, key):
         if self._tree_fn is None:
             self._tree_fn = jax.jit(self._sharded_tree_fn())
-        return self._tree_fn(self.codes_pack, self.codes_row, grad, hess,
-                             w, base_mask, key)
+        rec, leaf_id, k, tot = self._tree_fn(
+            self.codes_pack, self.codes_row, grad, hess, w, base_mask, key)
+        return rec, None, leaf_id, k, tot
 
     def make_fused_step(self, objective, goss=None, bagging=True):
         """Fused boosting iteration over the feature mesh: one sharded
@@ -767,7 +781,7 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                                     g, h, w, base_mask, tree_key)
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
-            return score_row + delta, rec, leaf_id, k
+            return score_row + delta, rec, None, leaf_id, k
 
         return step
 
@@ -794,14 +808,16 @@ def create_tree_learner(config: Config, dataset: Dataset,
                 and dataset.bundle_arrays() is None
                 and not (0.0 < config.feature_fraction_bynode < 1.0)
                 and DeviceTreeLearner.supports(config, dataset,
-                                               strategy="compact")):
+                                               strategy="compact",
+                                               categorical_ok=False)):
             return DeviceFeatureParallelTreeLearner(config, dataset, mesh)
         return FeatureParallelTreeLearner(config, dataset, mesh)
     if name in ("data", "data_parallel"):
         # the DP device learner always runs the compact strategy; check
         # the learner that will actually be built
-        if not host_only and DeviceTreeLearner.supports(config, dataset,
-                                                        strategy="compact"):
+        if not host_only and DeviceTreeLearner.supports(
+                config, dataset, strategy="compact",
+                categorical_ok=False):
             return DeviceDataParallelTreeLearner(config, dataset, mesh)
         return DataParallelTreeLearner(config, dataset, mesh)
     if name in ("voting", "voting_parallel"):
@@ -815,7 +831,8 @@ def create_tree_learner(config: Config, dataset: Dataset,
                 and dataset.num_features > 2 * max(1, int(config.top_k))
                 and n_shards > 1
                 and DeviceTreeLearner.supports(config, dataset,
-                                               strategy="compact")):
+                                               strategy="compact",
+                                               categorical_ok=False)):
             return DeviceVotingParallelTreeLearner(config, dataset, mesh)
         return VotingParallelTreeLearner(config, dataset, mesh)
     log.fatal("Unknown tree learner %s", name)
