@@ -257,6 +257,41 @@ class Dataset:
         self.feature_name = feature_name
         return self
 
+    def get_ref_chain(self, ref_limit: int = 100) -> set:
+        """Walk the reference chain (reference: basic.py:1295)."""
+        head = self
+        ref_chain = set()
+        while len(ref_chain) < ref_limit:
+            if isinstance(head, Dataset):
+                ref_chain.add(id(head))
+                if head.reference is not None and \
+                        id(head.reference) not in ref_chain:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return ref_chain
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Use `reference`'s bin mappers as the template for this dataset
+        (reference: basic.py:1319). Constructed state is dropped so the
+        next construct() aligns to the new reference; requires the raw
+        data to still be around (free_raw_data=False)."""
+        if not isinstance(reference, Dataset):
+            raise TypeError("Reference should be Dataset instance")
+        self.set_categorical_feature(reference.categorical_feature) \
+            .set_feature_name(reference.feature_name)
+        if self.get_ref_chain().intersection(reference.get_ref_chain()):
+            return self
+        if self.data is not None:
+            self.reference = reference
+            self._inner = None     # re-construct against the new template
+            return self
+        raise LightGBMError(
+            "Cannot set reference after freed raw data, set "
+            "free_raw_data=False when construct Dataset to avoid this.")
+
 
 _NO_DEFAULT = object()
 
@@ -272,6 +307,7 @@ class Booster:
         self._train_data_name = "training"
         self.name_valid_sets: List[str] = []
         self._gbdt: Optional[GBDT] = None
+        self._attr: Dict[str, str] = {}
 
         if train_set is not None:
             if not isinstance(train_set, Dataset):
@@ -419,6 +455,77 @@ class Booster:
 
     def dump_model(self, num_iteration=None, start_iteration=0) -> dict:
         return self._gbdt.dump_model(num_iteration, start_iteration)
+
+    def model_from_string(self, model_str: str, verbose=True) -> "Booster":
+        """Replace this Booster's model with one loaded from a string
+        (reference: basic.py:2241)."""
+        self._gbdt = GBDT.load_model_from_string(model_str,
+                                                 Config(self.params))
+        if verbose:
+            log.info("Finished loading model, total used %d iterations",
+                     self._gbdt.current_iteration)
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Output value of one leaf (reference: basic.py:2463
+        -> LGBM_BoosterGetLeafValue)."""
+        return float(self._gbdt.models[tree_id].leaf_value[leaf_id])
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style=False):
+        """Histogram of split threshold values used for `feature`
+        (reference: basic.py:2565). Categorical features are rejected
+        like the reference."""
+        def add(root):
+            if "split_index" in root:     # non-leaf
+                if feature_names is not None and isinstance(feature, str):
+                    split_feature = feature_names[root["split_feature"]]
+                else:
+                    split_feature = root["split_feature"]
+                if split_feature == feature:
+                    if isinstance(root["threshold"], str):
+                        raise LightGBMError(
+                            "Cannot compute split value histogram for the "
+                            "categorical feature")
+                    values.append(root["threshold"])
+                add(root["left_child"])
+                add(root["right_child"])
+
+        model = self.dump_model()
+        feature_names = model.get("feature_names")
+        values: List[float] = []
+        for tree_info in model["tree_info"]:
+            add(tree_info["tree_structure"])
+
+        if bins is None or isinstance(bins, int) and xgboost_style:
+            n_unique = len(np.unique(values))
+            bins = max(min(n_unique, bins) if bins is not None
+                       else n_unique, 1)
+        hist, bin_edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            ret = np.column_stack((bin_edges[1:], hist))
+            ret = ret[ret[:, 1] > 0]
+            try:
+                from pandas import DataFrame
+                return DataFrame(ret, columns=["SplitValue", "Count"])
+            except ImportError:
+                return ret
+        return hist, bin_edges
+
+    def attr(self, key: str) -> Optional[str]:
+        """Get a Booster attribute string (reference: basic.py:2717)."""
+        return self._attr.get(key, None)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """Set Booster attributes; None deletes (reference: basic.py:2733)."""
+        for key, value in kwargs.items():
+            if value is not None:
+                if not isinstance(value, str):
+                    raise ValueError("Only string values are accepted")
+                self._attr[key] = value
+            else:
+                self._attr.pop(key, None)
+        return self
 
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type="split",
