@@ -682,3 +682,132 @@ def test_early_stopping_first_metric_only():
     assert bst.best_iteration > 0
     # both metrics were still recorded
     assert "binary_logloss" in evals["val"] and "auc" in evals["val"]
+
+
+def test_booster_attr():
+    """attr/set_attr string attributes (reference: basic.py:2717/:2733)."""
+    x, y = make_binary(300)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(x, y), num_boost_round=2)
+    assert bst.attr("foo") is None
+    bst.set_attr(foo="bar", n="1")
+    assert bst.attr("foo") == "bar" and bst.attr("n") == "1"
+    bst.set_attr(foo=None)
+    assert bst.attr("foo") is None
+    with pytest.raises(ValueError):
+        bst.set_attr(k=7)
+
+
+def test_model_from_string_roundtrip():
+    """model_from_string replaces the model in-place (reference
+    basic.py:2241)."""
+    x, y = make_binary(600)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(x, y), num_boost_round=4)
+    s = bst.model_to_string()
+    bst2 = lgb.train({"objective": "binary", "verbosity": -1},
+                     lgb.Dataset(x[:100], y[:100]), num_boost_round=1)
+    bst2.model_from_string(s, verbose=False)
+    np.testing.assert_allclose(bst.predict(x), bst2.predict(x), rtol=1e-9)
+
+
+def test_get_leaf_output_matches_pred_leaf():
+    """Summing get_leaf_output over pred_leaf assignments reproduces the
+    raw prediction (reference: test_engine.py pred-leaf invariants)."""
+    x, y = make_binary(800)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, lgb.Dataset(x, y), num_boost_round=3)
+    leaves = bst.predict(x[:50], pred_leaf=True).astype(int)
+    raw = bst.predict(x[:50], raw_score=True)
+    manual = np.array(
+        [sum(bst.get_leaf_output(t, leaves[i, t])
+             for t in range(leaves.shape[1])) for i in range(50)])
+    np.testing.assert_allclose(manual, raw, atol=1e-6)
+
+
+def test_get_split_value_histogram():
+    """reference: test_engine.py:1473 — histogram over a feature's used
+    split values; categorical features rejected."""
+    x, y = make_binary(1200)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15}, lgb.Dataset(x, y),
+                    num_boost_round=10)
+    # some feature must be split on; find one from importances
+    f = int(np.argmax(bst.feature_importance("split")))
+    hist, edges = bst.get_split_value_histogram(f)
+    assert hist.sum() > 0 and len(edges) == len(hist) + 1
+    # by-name lookup agrees with by-index
+    name = bst.feature_name()[f]
+    hist2, edges2 = bst.get_split_value_histogram(name)
+    np.testing.assert_array_equal(hist, hist2)
+    # xgboost-style output keeps only non-empty bins
+    ret = bst.get_split_value_histogram(f, xgboost_style=True)
+    vals = np.asarray(ret)
+    assert (vals[:, 1] > 0).all()
+    # categorical feature -> error (reference behavior)
+    xc = np.column_stack([np.random.RandomState(0).randint(0, 8, 500),
+                          np.random.RandomState(1).randn(500)])
+    yc = (xc[:, 0] > 3).astype(float)
+    bc = lgb.train({"objective": "binary", "verbosity": -1,
+                    "min_data_per_group": 1},
+                   lgb.Dataset(xc, yc, categorical_feature=[0]),
+                   num_boost_round=2)
+    with pytest.raises(lgb.LightGBMError):
+        bc.get_split_value_histogram(0)
+
+
+def test_set_reference_rebins_to_template():
+    """set_reference re-aligns an unconstructed/constructed dataset to the
+    reference's bin mappers (reference: basic.py:1319)."""
+    x, y = make_binary(1000)
+    ds_train = lgb.Dataset(x, y, free_raw_data=False)
+    ds_train.construct()
+    x2, y2 = make_binary(400, seed=9)
+    ds_other = lgb.Dataset(x2, y2, free_raw_data=False)
+    ds_other.construct()          # constructed standalone first
+    ds_other.set_reference(ds_train)
+    ds_other.construct()
+    # aligned bin mappers: identical bin upper bounds per feature
+    a = ds_train._inner.bin_mappers
+    b = ds_other._inner.bin_mappers
+    for ma, mb in zip(a, b):
+        np.testing.assert_array_equal(
+            np.asarray(ma.bin_upper_bound), np.asarray(mb.bin_upper_bound))
+    # freed raw data -> error, like the reference
+    ds3 = lgb.Dataset(x2, y2)     # free_raw_data=True
+    ds3.construct()
+    with pytest.raises(lgb.LightGBMError):
+        ds3.set_reference(ds_train)
+
+
+def test_init_model_from_file_seeds_scores_and_valids():
+    """Continuation from a model FILE must seed training scores and valid
+    updaters with the loaded trees (deserialized trees need their binned
+    routing reconstructed — rebin_inner)."""
+    x, y = make_binary(1500)
+    xt, yt, xv, yv = x[:1000], y[:1000], x[1000:], y[1000:]
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "verbosity": -1}
+    ds = lgb.Dataset(xt, yt, free_raw_data=False)
+    bst1 = lgb.train(dict(params), ds, num_boost_round=6)
+    import tempfile, os
+    path = os.path.join(tempfile.mkdtemp(), "cont.txt")
+    bst1.save_model(path)
+
+    evals = {}
+    vds = lgb.Dataset(xv, yv, reference=ds, free_raw_data=False)
+    bst2 = lgb.train(dict(params), ds, num_boost_round=4,
+                     init_model=path, valid_sets=[vds],
+                     valid_names=["val"], evals_result=evals,
+                     verbose_eval=False)
+    assert bst2.current_iteration() == 10
+    # the first continuation eval must already include the 6 loaded trees:
+    # it must beat the logloss of an untrained model by a wide margin and
+    # be close to bst1's own valid logloss
+    def logloss(b):
+        p = np.clip(b.predict(xv), 1e-9, 1 - 1e-9)
+        return float(-np.mean(yv * np.log(p) + (1 - yv) * np.log(1 - p)))
+    first_eval = evals["val"]["binary_logloss"][0]
+    assert abs(first_eval - logloss(bst1)) < 0.05, (first_eval, logloss(bst1))
+    # and the final model must improve on the 6-tree model
+    assert logloss(bst2) < logloss(bst1) + 1e-9
