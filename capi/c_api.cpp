@@ -14,6 +14,8 @@
 
 #include <Python.h>
 
+#include "c_api.h"
+
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -23,7 +25,9 @@
 #include <string>
 #include <vector>
 
-#define LGBM_API extern "C" __attribute__((visibility("default")))
+// LGBM_API and the handle typedefs come from c_api.h; including the
+// header here makes the compiler cross-check every definition against
+// the published declaration.
 
 namespace {
 
@@ -118,10 +122,14 @@ PyObject* MemViewW(void* data, Py_ssize_t nbytes) {
 
 }  // namespace
 
-typedef void* DatasetHandle;
-typedef void* BoosterHandle;
-
 LGBM_API const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+// The reference ships this as an inline header helper
+// (include/LightGBM/c_api.h:1040); exporting it keeps bindings that link
+// the symbol (rather than inlining the header) working.
+LGBM_API void LGBM_SetLastError(const char* msg) {
+  g_last_error = msg ? msg : "";
+}
 
 // ---------------------------------------------------------------------------
 // Dataset

@@ -479,11 +479,29 @@ def dataset_add_features_from(h, other_h):
 # ---------------------------------------------------------------------------
 
 def booster_merge(h, other_h):
-    """reference: LGBM_BoosterMerge (c_api.h:437) — append the other
-    booster's models."""
+    """reference: LGBM_BoosterMerge (c_api.h:437) — PREPEND the other
+    booster's models (GBDT::MergeFrom, reference gbdt.h:60: other first,
+    then own). When the target is a freshly-created training booster with
+    no trees yet (the R bindings' init_model flow: BoosterCreate +
+    BoosterMerge, reference R lgb.Booster.R:65), the merged trees are
+    also replayed into the score updaters so continued training sees the
+    previous model — the role the reference fills by seeding the train
+    set's init_score from a Predictor."""
     import copy as _copy
     bst, other = _get(h), _get(other_h)
-    bst._gbdt.models.extend(_copy.deepcopy(t) for t in other._gbdt.models)
+    g = bst._gbdt
+    merged = [_copy.deepcopy(t) for t in other._gbdt.models]
+    continuation = (not g.models
+                    and getattr(g, "score_updater", None) is not None)
+    g.models = merged + g.models
+    g.num_init_iteration = len(merged) // max(g.num_tree_per_iteration, 1)
+    if continuation:
+        for k in range(g.num_tree_per_iteration):
+            for it in range(g.num_init_iteration):
+                tree = merged[it * g.num_tree_per_iteration + k]
+                g.score_updater.add_tree(tree, k)
+                for vu in g.valid_updaters:
+                    vu.add_tree(tree, k)
     return 0
 
 

@@ -70,6 +70,10 @@ class Tree:
         self.cat_threshold_inner: List[int] = []
         self.shrinkage = 1.0
         self.max_depth = -1
+        # binned routing (threshold_in_bin / *_inner bitsets) is valid for
+        # trees built by a learner; deserialized trees carry raw values
+        # only until rebin_inner() reconstructs the binned side
+        self.inner_valid = True
 
     # ------------------------------------------------------------------
     def _split_common(self, leaf: int, feature: int, real_feature: int,
@@ -293,11 +297,57 @@ class Tree:
             t.cat_boundaries = list(ints("cat_boundaries", t.num_cat + 1))
             ncat_words = t.cat_boundaries[-1]
             t.cat_threshold = [int(x) for x in ints("cat_threshold", ncat_words)]
-            # inner thresholds unavailable after load; raw-value traversal only
+            # inner thresholds unavailable after load; raw-value traversal
+            # only, until rebin_inner() runs against a dataset
             t.cat_boundaries_inner = list(t.cat_boundaries)
             t.cat_threshold_inner = list(t.cat_threshold)
+        t.inner_valid = False
         t.recompute_depths()
         return t
+
+    def rebin_inner(self, dataset) -> None:
+        """Reconstruct the binned routing of a deserialized tree from the
+        dataset's bin mappers, so score replay over binned data
+        (ScoreUpdater.add_tree) routes identically to raw traversal.
+
+        Model text stores raw thresholds (the bin upper bound,
+        BinMapper.bin_to_value) and real category values; the inverse maps
+        are exact: value_to_bin(upper_bound[b]) == b and
+        categorical_2_bin[real_cat] == bin. The reference never needs this
+        (its Predictor replays over raw rows, predictor.hpp); our replay
+        path runs on the device-resident binned matrix instead."""
+        n_int = max(self.num_leaves - 1, 0)
+        cat_bounds = [0]
+        cat_words: List[int] = []
+        for node in range(n_int):
+            mapper = dataset.bin_mappers[int(self.split_feature[node])]
+            if self.decision_type[node] & K_CATEGORICAL_MASK:
+                # for a deserialized tree the cat index rides threshold
+                # (split_categorical stores it in both fields)
+                ci = int(self.threshold[node])
+                self.threshold_in_bin[node] = ci
+                lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+                bins = []
+                for w, word in enumerate(self.cat_threshold[lo:hi]):
+                    for b in range(32):
+                        if (int(word) >> b) & 1:
+                            cat = w * 32 + b
+                            bin_i = mapper.categorical_2_bin.get(cat)
+                            if bin_i is not None:
+                                bins.append(bin_i)
+                n_words = (max(bins) // 32 + 1) if bins else 1
+                words = [0] * n_words
+                for b in bins:
+                    words[b // 32] |= 1 << (b % 32)
+                cat_words.extend(words)
+                cat_bounds.append(cat_bounds[-1] + n_words)
+            else:
+                self.threshold_in_bin[node] = mapper.value_to_bin(
+                    float(self.threshold[node]))
+        if self.num_cat > 0:
+            self.cat_boundaries_inner = cat_bounds
+            self.cat_threshold_inner = cat_words
+        self.inner_valid = True
 
     def recompute_depths(self) -> None:
         """Rebuild leaf_depth from the children arrays (reference

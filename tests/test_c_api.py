@@ -332,3 +332,88 @@ def test_c_api_predict_for_file(lib, tmp_path):
 def test_c_api_network_init_with_functions(lib):
     _check(lib, lib.LGBM_NetworkInitWithFunctions(2, 0, None, None))
     _check(lib, lib.LGBM_NetworkFree())
+
+
+def test_c_api_set_last_error(lib):
+    """LGBM_SetLastError round-trips through LGBM_GetLastError
+    (reference: include/LightGBM/c_api.h:1040)."""
+    lib.LGBM_SetLastError(b"custom error 42")
+    assert lib.LGBM_GetLastError().decode() == "custom error 42"
+    lib.LGBM_SetLastError(b"")
+
+
+def test_c_api_merge_continuation(lib, tmp_path):
+    """BoosterCreate + BoosterMerge is the R bindings' init_model
+    continuation flow (reference R lgb.Booster.R:65). The merged history
+    must count toward current_iteration and seed continued training."""
+    x, y = make_binary(800, 6)
+    xf = np.ascontiguousarray(x, dtype=np.float64)
+    yl = np.ascontiguousarray(y, dtype=np.float32)
+
+    def new_ds():
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            xf.ctypes.data_as(ctypes.c_void_p), 1, 800, 6, 1,
+            b"max_bin=63", None, ctypes.byref(ds)))
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", yl.ctypes.data_as(ctypes.c_void_p), 800, 0))
+        return ds
+
+    params = b"objective=binary verbosity=-1 seed=3"
+    bst1 = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(new_ds(), params, ctypes.byref(bst1)))
+    fin = ctypes.c_int(0)
+    for _ in range(4):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst1, ctypes.byref(fin)))
+    model_file = str(tmp_path / "cont.txt").encode()
+    _check(lib, lib.LGBM_BoosterSaveModel(bst1, 0, -1, model_file))
+
+    def raw_pred(bst):
+        out = np.zeros(800, dtype=np.float64)
+        n = ctypes.c_int64(0)
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, xf.ctypes.data_as(ctypes.c_void_p), 1, 800, 6, 1,
+            1, -1, b"", ctypes.byref(n),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        return out
+
+    loaded = ctypes.c_void_p()
+    it = ctypes.c_int(0)
+    _check(lib, lib.LGBM_BoosterCreateFromModelfile(
+        model_file, ctypes.byref(it), ctypes.byref(loaded)))
+    assert it.value == 4
+
+    bst2 = ctypes.c_void_p()
+    _check(lib, lib.LGBM_BoosterCreate(new_ds(), params, ctypes.byref(bst2)))
+    _check(lib, lib.LGBM_BoosterMerge(bst2, loaded))
+    cur = ctypes.c_int(0)
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst2, ctypes.byref(cur)))
+    assert cur.value == 4
+    # merged-only booster predicts identically to the source model
+    np.testing.assert_allclose(raw_pred(bst2), raw_pred(bst1), rtol=1e-6)
+    # the SEEDED TRAINING SCORES must equal the source model's raw
+    # predictions — this is what continued gradients are computed from
+    # (catches deserialized trees replayed with unrebinned thresholds)
+    seeded = np.zeros(800, dtype=np.float64)
+    n64 = ctypes.c_int64(0)
+    _check(lib, lib.LGBM_BoosterGetPredict(
+        bst2, 0, ctypes.byref(n64),
+        seeded.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert n64.value == 800
+    # GetPredict converts output (sigmoid for binary)
+    np.testing.assert_allclose(seeded, 1.0 / (1.0 + np.exp(-raw_pred(bst1))),
+                               rtol=1e-5, atol=1e-5)
+
+    for _ in range(4):
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst2, ctypes.byref(fin)))
+    _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst2, ctypes.byref(cur)))
+    assert cur.value == 8
+
+    def logloss(p_raw):
+        p = 1.0 / (1.0 + np.exp(-p_raw))
+        eps = 1e-9
+        return -np.mean(y * np.log(p + eps) + (1 - y) * np.log(1 - p + eps))
+
+    # continuation must actually descend the training loss (it would
+    # plateau if the merged trees were invisible to the gradient scores)
+    assert logloss(raw_pred(bst2)) < logloss(raw_pred(bst1)) - 1e-4
