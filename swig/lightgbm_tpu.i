@@ -1,132 +1,258 @@
 /* SWIG interface for the lightgbm_tpu C ABI (role of the reference's
- * swig/lightgbmlib.i — a generated Java/JNI wrapper over the stable C API
- * used by mmlspark). Targets the same LGBM_* surface exported by
- * capi/lib_lightgbm_tpu.so.
+ * swig/lightgbmlib.i — the Java/JNI wrapper over the stable C API that
+ * mmlspark builds on). Wraps the FULL surface declared in capi/c_api.h,
+ * plus the same JNI convenience helpers the reference ships: zero-copy
+ * single-row predict, Spark SparseVector streaming into
+ * LGBM_DatasetCreateFromCSRFunc, and string-returning wrappers for the
+ * buffer-filling exports.
  *
- * Generate + build (swig and a JDK are NOT in the CI image; run where
- * available):
- *   swig -java -package com.lightgbm.tpu -outdir java/com/lightgbm/tpu \
- *        lightgbm_tpu.i
+ * Generate + build:
+ *   swig -c++ -java -package com.lightgbm.tpu \
+ *        -outdir java/com/lightgbm/tpu lightgbm_tpu.i
  *   g++ -shared -fPIC lightgbm_tpu_wrap.cxx -I$JAVA_HOME/include \
- *        -I$JAVA_HOME/include/linux -L../capi -llightgbm_tpu \
+ *        -I$JAVA_HOME/include/linux -L../capi -l_lightgbm_tpu \
  *        -o lib_lightgbm_tpu_swig.so
+ * CI compiles the generated wrapper against stub JNI headers
+ * (tools/jnistub) the same way the R glue is syntax-checked
+ * (tools/check_swig_wrap.sh).
  */
 %module lightgbmlibtpu
+%ignore LGBM_BoosterSaveModelToString;
+%ignore LGBM_BoosterDumpModel;
+%ignore LGBM_BoosterGetEvalNames;
 
 %{
-#include <cstdint>
-typedef void* DatasetHandle;
-typedef void* BoosterHandle;
-extern "C" {
-const char* LGBM_GetLastError();
-int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
-                               DatasetHandle reference, DatasetHandle* out);
-int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
-                              int32_t ncol, int is_row_major,
-                              const char* parameters, DatasetHandle reference,
-                              DatasetHandle* out);
-int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
-                         const void* field_data, int num_element, int type);
-int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
-int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
-int LGBM_DatasetFree(DatasetHandle handle);
-int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
-                       BoosterHandle* out);
-int LGBM_BoosterCreateFromModelfile(const char* filename, int* out_num_iters,
-                                    BoosterHandle* out);
-int LGBM_BoosterLoadModelFromString(const char* model_str, int* out_num_iters,
-                                    BoosterHandle* out);
-int LGBM_BoosterFree(BoosterHandle handle);
-int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
-int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
-int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
-int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
-int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
-int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
-int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
-                        double* out_results);
-int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
-                          int num_iteration, const char* filename);
-int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
-                              int data_type, int32_t nrow, int32_t ncol,
-                              int is_row_major, int predict_type,
-                              int num_iteration, const char* parameter,
-                              int64_t* out_len, double* out_result);
-int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
-                                  int importance_type, double* out_results);
-int LGBM_NetworkInit(const char* machines, int local_listen_port,
-                     int listen_time_out, int num_machines);
-int LGBM_NetworkFree();
-}
+#include "../capi/c_api.h"
 %}
 
-%include "stdint.i"
-%include "typemaps.i"
-%include "arrays_java.i"
+%include "various.i"
 %include "carrays.i"
+%include "cpointer.i"
+%include "stdint.i"
 
-/* handle types surface as opaque longs on the Java side, matching the
- * reference wrapper's voidpp/handle pattern */
-typedef void* DatasetHandle;
-typedef void* BoosterHandle;
+%apply char **STRING_ARRAY { char **feature_names, char **out_strs }
 
-%apply int* OUTPUT { int* is_finished, int* out_iteration, int* out_len,
-                     int* out_num_iters };
-%apply int32_t* OUTPUT { int32_t* out };
+%include "../capi/c_api.h"
+
+%typemap(in, numinputs = 0) JNIEnv *jenv %{
+  $1 = jenv;
+%}
+
+%inline %{
+  /* Buffer-managing wrapper: sizes, retries, and returns the model text
+   * directly (the raw export fills a caller buffer). */
+  char* LGBM_BoosterSaveModelToStringSWIG(BoosterHandle handle,
+                                          int start_iteration,
+                                          int num_iteration,
+                                          int64_t buffer_len,
+                                          int64_t* out_len) {
+    char* buf = new char[buffer_len];
+    int rc = LGBM_BoosterSaveModelToString(handle, start_iteration,
+                                           num_iteration, buffer_len,
+                                           out_len, buf);
+    if (rc == 0 && *out_len > buffer_len) {
+      delete[] buf;
+      int64_t need = *out_len;
+      buf = new char[need];
+      rc = LGBM_BoosterSaveModelToString(handle, start_iteration,
+                                         num_iteration, need, out_len, buf);
+    }
+    if (rc != 0) {
+      delete[] buf;
+      return nullptr;
+    }
+    return buf;
+  }
+
+  char* LGBM_BoosterDumpModelSWIG(BoosterHandle handle,
+                                  int start_iteration,
+                                  int num_iteration,
+                                  int64_t buffer_len,
+                                  int64_t* out_len) {
+    char* buf = new char[buffer_len];
+    int rc = LGBM_BoosterDumpModel(handle, start_iteration, num_iteration,
+                                   buffer_len, out_len, buf);
+    if (rc == 0 && *out_len > buffer_len) {
+      delete[] buf;
+      int64_t need = *out_len;
+      buf = new char[need];
+      rc = LGBM_BoosterDumpModel(handle, start_iteration, num_iteration,
+                                 need, out_len, buf);
+    }
+    if (rc != 0) {
+      delete[] buf;
+      return nullptr;
+    }
+    return buf;
+  }
+
+  char** LGBM_BoosterGetEvalNamesSWIG(BoosterHandle handle,
+                                      int eval_counts) {
+    char** names = new char*[eval_counts];
+    for (int i = 0; i < eval_counts; ++i) names[i] = new char[128];
+    if (LGBM_BoosterGetEvalNames(handle, &eval_counts, names) != 0) {
+      for (int i = 0; i < eval_counts; ++i) delete[] names[i];
+      delete[] names;
+      return nullptr;
+    }
+    return names;
+  }
+
+  /* Single-row dense predict. Get*ArrayElements (copying), NOT
+   * GetPrimitiveArrayCritical: the C ABI acquires the embedded CPython
+   * GIL, and blocking inside a JNI critical region can deadlock the JVM
+   * against GC. */
+  int LGBM_BoosterPredictForMatSingle(JNIEnv* jenv,
+                                      jdoubleArray data,
+                                      BoosterHandle handle,
+                                      int data_type,
+                                      int ncol,
+                                      int is_row_major,
+                                      int predict_type,
+                                      int num_iteration,
+                                      const char* parameter,
+                                      int64_t* out_len,
+                                      double* out_result) {
+    double* p = jenv->GetDoubleArrayElements(data, 0);
+    int rc = LGBM_BoosterPredictForMatSingleRow(
+        handle, p, data_type, ncol, is_row_major, predict_type,
+        num_iteration, parameter, out_len, out_result);
+    jenv->ReleaseDoubleArrayElements(data, p, JNI_ABORT);
+    return rc;
+  }
+
+  /* Single-row sparse predict (same no-critical-region rule). */
+  int LGBM_BoosterPredictForCSRSingle(JNIEnv* jenv,
+                                      jintArray indices,
+                                      jdoubleArray values,
+                                      int numNonZeros,
+                                      BoosterHandle handle,
+                                      int indptr_type,
+                                      int data_type,
+                                      int64_t nelem,
+                                      int64_t num_col,
+                                      int predict_type,
+                                      int num_iteration,
+                                      const char* parameter,
+                                      int64_t* out_len,
+                                      double* out_result) {
+    int* idx = (int*)jenv->GetIntArrayElements(indices, 0);
+    double* val = jenv->GetDoubleArrayElements(values, 0);
+    int32_t indptr[2] = {0, numNonZeros};
+    int rc = LGBM_BoosterPredictForCSRSingleRow(
+        handle, indptr, indptr_type, idx, val, data_type, 2, nelem, num_col,
+        predict_type, num_iteration, parameter, out_len, out_result);
+    jenv->ReleaseDoubleArrayElements(values, val, JNI_ABORT);
+    jenv->ReleaseIntArrayElements(indices, (jint*)idx, JNI_ABORT);
+    return rc;
+  }
+
+  #include <functional>
+  #include <utility>
+  #include <vector>
+
+  /* Stream an array of Spark SparseVectors into
+   * LGBM_DatasetCreateFromCSRFunc (the mmlspark ingestion path; the
+   * funptr contract is a std::function<void(int,
+   * vector<pair<int,double>>&)>*, see capi/c_api.h). JNI array handles
+   * are resolved up front because the row callback may run outside the
+   * calling thread. */
+  int LGBM_DatasetCreateFromCSRSpark(JNIEnv* jenv,
+                                     jobjectArray arrayOfSparseVector,
+                                     int num_rows,
+                                     int64_t num_col,
+                                     const char* parameters,
+                                     const DatasetHandle reference,
+                                     DatasetHandle* out) {
+    jclass cls = jenv->FindClass("org/apache/spark/ml/linalg/SparseVector");
+    jmethodID m_indices = jenv->GetMethodID(cls, "indices", "()[I");
+    jmethodID m_values = jenv->GetMethodID(cls, "values", "()[D");
+
+    struct Row {
+      jintArray jidx;
+      jdoubleArray jval;
+      int* idx;
+      double* val;
+      int n;
+    };
+    std::vector<Row> rows;
+    rows.reserve(num_rows);
+    // 2 kept array refs per row: grow the local-ref table up front so
+    // large partitions don't overflow the JVM's default frame capacity
+    jenv->EnsureLocalCapacity(2 * num_rows + 16);
+    auto release_all = [&]() {
+      for (size_t i = 0; i < rows.size(); ++i) {
+        jenv->ReleaseDoubleArrayElements(rows[i].jval, rows[i].val,
+                                         JNI_ABORT);
+        jenv->ReleaseIntArrayElements(rows[i].jidx, (jint*)rows[i].idx,
+                                      JNI_ABORT);
+      }
+    };
+    for (int i = 0; i < num_rows; ++i) {
+      jobject sv = jenv->GetObjectArrayElement(arrayOfSparseVector, i);
+      jintArray jidx = (jintArray)jenv->CallObjectMethod(sv, m_indices);
+      if (jenv->ExceptionCheck()) {
+        release_all();
+        return -1;
+      }
+      jdoubleArray jval = (jdoubleArray)jenv->CallObjectMethod(sv, m_values);
+      if (jenv->ExceptionCheck()) {
+        release_all();
+        return -1;
+      }
+      jenv->DeleteLocalRef(sv);
+      int n = jenv->GetArrayLength(jidx);
+      int* idx = (int*)jenv->GetIntArrayElements(jidx, 0);
+      double* val = jenv->GetDoubleArrayElements(jval, 0);
+      Row row = {jidx, jval, idx, val, n};
+      rows.push_back(row);
+    }
+
+    std::function<void(int, std::vector<std::pair<int, double> >&)> row_fn =
+        [&rows](int r, std::vector<std::pair<int, double> >& ret) {
+          const Row& row = rows[r];
+          ret.clear();
+          ret.reserve(row.n);
+          for (int j = 0; j < row.n; ++j)
+            ret.push_back(std::make_pair(row.idx[j], row.val[j]));
+        };
+
+    int rc = LGBM_DatasetCreateFromCSRFunc(&row_fn, num_rows, num_col,
+                                           parameters, reference, out);
+    release_all();
+    return rc;
+  }
+%}
+
+%pointer_functions(int, intp)
+%pointer_functions(long, longp)
+%pointer_functions(double, doublep)
+%pointer_functions(float, floatp)
+%pointer_functions(int64_t, int64_tp)
+%pointer_functions(int32_t, int32_tp)
+
+%pointer_cast(int64_t*, long*, int64_t_to_long_ptr)
+%pointer_cast(int64_t*, double*, int64_t_to_double_ptr)
+%pointer_cast(int32_t*, int*, int32_t_to_int_ptr)
+%pointer_cast(long*, int64_t*, long_to_int64_t_ptr)
+%pointer_cast(double*, int64_t*, double_to_int64_t_ptr)
+%pointer_cast(int*, int32_t*, int_to_int32_t_ptr)
+
+%pointer_cast(double*, void*, double_to_voidp_ptr)
+%pointer_cast(float*, void*, float_to_voidp_ptr)
+%pointer_cast(int*, void*, int_to_voidp_ptr)
+%pointer_cast(int32_t*, void*, int32_t_to_voidp_ptr)
+%pointer_cast(int64_t*, void*, int64_t_to_voidp_ptr)
 
 %array_functions(double, doubleArray)
 %array_functions(float, floatArray)
 %array_functions(int, intArray)
+%array_functions(long, longArray)
+%array_functions(char*, stringArray)
 
-/* pointer-to-handle helpers (the reference exposes voidpp_handle etc.) */
+/* void** manipulation for out-handles */
 %inline %{
-DatasetHandle* new_DatasetHandlep() { return new DatasetHandle(0); }
-DatasetHandle DatasetHandlep_value(DatasetHandle* p) { return *p; }
-void delete_DatasetHandlep(DatasetHandle* p) { delete p; }
-BoosterHandle* new_BoosterHandlep() { return new BoosterHandle(0); }
-BoosterHandle BoosterHandlep_value(BoosterHandle* p) { return *p; }
-void delete_BoosterHandlep(BoosterHandle* p) { delete p; }
-int64_t* new_int64p() { return new int64_t(0); }
-int64_t int64p_value(int64_t* p) { return *p; }
-void delete_int64p(int64_t* p) { delete p; }
+  void** new_voidpp() { return new void*; }
+  void delete_voidpp(void** self) { if (self) delete self; }
+  void* voidpp_value(void** self) { return *self; }
 %}
-
-const char* LGBM_GetLastError();
-int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
-                               DatasetHandle reference, DatasetHandle* out);
-int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
-                              int32_t ncol, int is_row_major,
-                              const char* parameters, DatasetHandle reference,
-                              DatasetHandle* out);
-int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
-                         const void* field_data, int num_element, int type);
-int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out);
-int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out);
-int LGBM_DatasetFree(DatasetHandle handle);
-int LGBM_BoosterCreate(DatasetHandle train_data, const char* parameters,
-                       BoosterHandle* out);
-int LGBM_BoosterCreateFromModelfile(const char* filename, int* out_num_iters,
-                                    BoosterHandle* out);
-int LGBM_BoosterLoadModelFromString(const char* model_str, int* out_num_iters,
-                                    BoosterHandle* out);
-int LGBM_BoosterFree(BoosterHandle handle);
-int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
-int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
-int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
-int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
-int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
-int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
-int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
-                        double* out_results);
-int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
-                          int num_iteration, const char* filename);
-int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
-                              int data_type, int32_t nrow, int32_t ncol,
-                              int is_row_major, int predict_type,
-                              int num_iteration, const char* parameter,
-                              int64_t* out_len, double* out_result);
-int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
-                                  int importance_type, double* out_results);
-int LGBM_NetworkInit(const char* machines, int local_listen_port,
-                     int listen_time_out, int num_machines);
-int LGBM_NetworkFree();

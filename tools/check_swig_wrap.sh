@@ -1,0 +1,14 @@
+#!/bin/sh
+# Regenerate the SWIG Java wrapper and compile it against stub JNI headers
+# (tools/jnistub) — no JDK in this image, same trick as check_r_glue.sh.
+# Catches interface/header drift (the wrapper is generated from
+# capi/c_api.h, so a signature change that breaks bindings fails here).
+set -e
+DIR=$(dirname "$0")/..
+cd "$DIR/swig"
+if command -v swig >/dev/null 2>&1; then
+  swig -c++ -java -package com.lightgbm.tpu \
+       -outdir java/com/lightgbm/tpu lightgbm_tpu.i
+fi
+g++ -fsyntax-only -std=c++14 -I"../tools/jnistub" lightgbm_tpu_wrap.cxx
+echo "SWIG wrapper syntax OK"

@@ -1,0 +1,112 @@
+/* Minimal JNI header STUB for CI syntax/type checking of the SWIG-generated
+ * wrapper (no JDK in this image — same trick as tools/rstub for the R glue).
+ * Declares exactly the subset of the JNI surface lightgbm_tpu_wrap.cxx
+ * touches, with JNI-compatible shapes. NOT a functional JNI; never link it.
+ */
+#ifndef LGBM_TPU_JNI_STUB_H_
+#define LGBM_TPU_JNI_STUB_H_
+
+#include <cstdarg>
+#include <cstdint>
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNIIMPORT
+#define JNICALL
+
+typedef int32_t jint;
+typedef int64_t jlong;
+typedef int8_t jbyte;
+typedef uint8_t jboolean;
+typedef uint16_t jchar;
+typedef int16_t jshort;
+typedef float jfloat;
+typedef double jdouble;
+typedef jint jsize;
+
+#define JNI_FALSE 0
+#define JNI_TRUE 1
+#define JNI_ABORT 2
+#define JNI_COMMIT 1
+#define JNI_OK 0
+
+class _jobject {};
+class _jclass : public _jobject {};
+class _jstring : public _jobject {};
+class _jthrowable : public _jobject {};
+class _jarray : public _jobject {};
+class _jobjectArray : public _jarray {};
+class _jbooleanArray : public _jarray {};
+class _jbyteArray : public _jarray {};
+class _jcharArray : public _jarray {};
+class _jshortArray : public _jarray {};
+class _jintArray : public _jarray {};
+class _jlongArray : public _jarray {};
+class _jfloatArray : public _jarray {};
+class _jdoubleArray : public _jarray {};
+
+typedef _jobject* jobject;
+typedef _jclass* jclass;
+typedef _jstring* jstring;
+typedef _jthrowable* jthrowable;
+typedef _jarray* jarray;
+typedef _jobjectArray* jobjectArray;
+typedef _jbooleanArray* jbooleanArray;
+typedef _jbyteArray* jbyteArray;
+typedef _jcharArray* jcharArray;
+typedef _jshortArray* jshortArray;
+typedef _jintArray* jintArray;
+typedef _jlongArray* jlongArray;
+typedef _jfloatArray* jfloatArray;
+typedef _jdoubleArray* jdoubleArray;
+typedef jobject jweak;
+
+struct _jmethodID;
+typedef _jmethodID* jmethodID;
+struct _jfieldID;
+typedef _jfieldID* jfieldID;
+
+struct JNIEnv_;
+typedef JNIEnv_ JNIEnv;
+
+struct JNIEnv_ {
+  jclass FindClass(const char*);
+  jmethodID GetMethodID(jclass, const char*, const char*);
+  jobject CallObjectMethod(jobject, jmethodID, ...);
+  jboolean ExceptionCheck();
+  void ExceptionClear();
+  jint ThrowNew(jclass, const char*);
+  void DeleteLocalRef(jobject);
+  jint EnsureLocalCapacity(jint);
+
+  jstring NewStringUTF(const char*);
+  const char* GetStringUTFChars(jstring, jboolean*);
+  void ReleaseStringUTFChars(jstring, const char*);
+
+  jsize GetArrayLength(jarray);
+  jobject GetObjectArrayElement(jobjectArray, jsize);
+  void SetObjectArrayElement(jobjectArray, jsize, jobject);
+  jobjectArray NewObjectArray(jsize, jclass, jobject);
+
+  jint* GetIntArrayElements(jintArray, jboolean*);
+  jlong* GetLongArrayElements(jlongArray, jboolean*);
+  jfloat* GetFloatArrayElements(jfloatArray, jboolean*);
+  jdouble* GetDoubleArrayElements(jdoubleArray, jboolean*);
+  void ReleaseIntArrayElements(jintArray, jint*, jint);
+  void ReleaseLongArrayElements(jlongArray, jlong*, jint);
+  void ReleaseFloatArrayElements(jfloatArray, jfloat*, jint);
+  void ReleaseDoubleArrayElements(jdoubleArray, jdouble*, jint);
+
+  jintArray NewIntArray(jsize);
+  jlongArray NewLongArray(jsize);
+  jfloatArray NewFloatArray(jsize);
+  jdoubleArray NewDoubleArray(jsize);
+  jbooleanArray NewBooleanArray(jsize);
+
+  void* GetPrimitiveArrayCritical(jarray, jboolean*);
+  void ReleasePrimitiveArrayCritical(jarray, void*, jint);
+};
+
+struct JavaVM_;
+typedef JavaVM_ JavaVM;
+
+#endif  /* LGBM_TPU_JNI_STUB_H_ */
