@@ -37,6 +37,19 @@ Dataset <- R6::R6Class(
       invisible(self)
     },
 
+    get_field = function(name) {
+      v <- .Call(LGBMTPU_DatasetGetField_R, self$handle, name)
+      if (name %in% c("group", "query")) as.integer(v) else v
+    },
+
+    # reference API aliases (Dataset$setinfo/getinfo, lgb.Dataset.R)
+    setinfo = function(name, data) self$set_field(name, data),
+    getinfo = function(name) self$get_field(name),
+
+    # the native dataset is built eagerly in initialize(); construct()
+    # exists for reference-API compatibility
+    construct = function() invisible(self),
+
     dim = function() {
       c(.Call(LGBMTPU_DatasetGetNumData_R, self$handle),
         .Call(LGBMTPU_DatasetGetNumFeature_R, self$handle))

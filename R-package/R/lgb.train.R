@@ -3,51 +3,92 @@
 #' Train a lightgbm.tpu model
 #'
 #' Mirrors the upstream lgb.train signature subset: params list, lgb.Dataset,
-#' nrounds, valids, early stopping on the first metric.
+#' nrounds, valids, early stopping on the first metric, init_model
+#' continuation, and record_evals population.
+#' @param params list of parameters
+#' @param data an lgb.Dataset
+#' @param nrounds number of boosting iterations
+#' @param valids named list of lgb.Dataset validation sets
+#' @param early_stopping_rounds stop when the first metric on the first
+#'   validation set has not improved for this many rounds
+#' @param init_model path to a saved model, or an lgb.Booster, to continue
+#'   training from (reference lgb.train init_model)
+#' @param verbose verbosity
 #' @export
 lgb.train <- function(params = list(), data, nrounds = 100L,
                       valids = list(), early_stopping_rounds = NULL,
-                      verbose = 1L) {
+                      init_model = NULL, verbose = 1L) {
   booster <- Booster$new(params, train_set = data)
+  if (!is.null(init_model)) {
+    prev <- if (is.character(init_model)) {
+      Booster$new(modelfile = init_model)
+    } else if (inherits(init_model, "lgb.Booster")) {
+      init_model
+    } else {
+      stop("init_model must be a file path or an lgb.Booster")
+    }
+    # continuation through the C ABI: BoosterCreate + BoosterMerge, the
+    # reference R bindings' mechanism (reference lgb.Booster.R:65)
+    .Call(LGBMTPU_BoosterMerge_R, booster$handle, prev$handle)
+  }
   vnames <- names(valids)
   for (i in seq_along(valids)) {
     booster$add_valid(valids[[i]], vnames[[i]])
   }
+  metric_names <- character(0)
   best_score <- Inf
   best_iter <- -1L
   # direction of the first metric (auc/ndcg/map maximize); queried from the
   # C ABI so it tracks whatever metric the params resolved to
   eval_sign <- 1
+  start_iter <- booster$current_iter()
+  stopped <- FALSE
   for (i in seq_len(nrounds)) {
     finished <- booster$update()
     if (length(valids) > 0) {
-      ev <- booster$eval(1L)
-      if (length(ev) > 0) {
-        if (i == 1L) {
-          hb <- tryCatch(booster$eval_higher_better(),
-                         error = function(e) logical(0))
-          if (length(hb) > 0 && isTRUE(hb[[1]])) eval_sign <- -1
+      if (length(metric_names) == 0) {
+        metric_names <- tryCatch(booster$eval_names(),
+                                 error = function(e) character(0))
+        hb <- tryCatch(booster$eval_higher_better(),
+                       error = function(e) logical(0))
+        if (length(hb) > 0 && isTRUE(hb[[1]])) eval_sign <- -1
+      }
+      for (vi in seq_along(valids)) {
+        ev <- booster$eval(vi)
+        if (length(ev) == 0) next
+        vname <- vnames[[vi]]
+        for (mi in seq_along(ev)) {
+          mname <- if (mi <= length(metric_names)) {
+            metric_names[[mi]]
+          } else {
+            paste0("metric_", mi)
+          }
+          booster$record_evals[[vname]][[mname]]$eval <-
+            c(booster$record_evals[[vname]][[mname]]$eval, ev[[mi]])
         }
         if (verbose > 0) {
-          message(sprintf("[%d] valid: %s", i,
+          message(sprintf("[%d] %s: %s", i, vname,
                           paste(signif(ev, 6), collapse = ", ")))
         }
-        if (!is.null(early_stopping_rounds)) {
+        if (vi == 1L && !is.null(early_stopping_rounds)) {
           if (eval_sign * ev[[1]] < best_score) {
             best_score <- eval_sign * ev[[1]]
             best_iter <- i
           } else if (i - best_iter >= early_stopping_rounds) {
+            # absolute iteration: init_model trees count (start_iter),
+            # so predict(num_iteration = best_iter) keeps them
+            booster$best_iter <- start_iter + best_iter
             if (verbose > 0) {
               message(sprintf("Early stopping, best iteration is: %d",
-                              best_iter))
+                              booster$best_iter))
             }
-            booster$best_iter <- best_iter
+            stopped <- TRUE
             break
           }
         }
       }
     }
-    if (isTRUE(finished)) break
+    if (stopped || isTRUE(finished)) break
   }
   booster
 }

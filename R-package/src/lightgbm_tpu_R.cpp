@@ -37,6 +37,9 @@ int LGBM_BoosterCreateFromModelfile(const char* filename, int* out_num_iters,
 int LGBM_BoosterLoadModelFromString(const char* model_str, int* out_num_iters,
                                     BoosterHandle* out);
 int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle);
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr, int* out_type);
 int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
@@ -151,6 +154,31 @@ SEXP LGBMTPU_DatasetSetField_R(SEXP handle, SEXP field, SEXP data) {
   return R_NilValue;
 }
 
+SEXP LGBMTPU_DatasetGetField_R(SEXP handle, SEXP field) {
+  const char* name = CHAR(Rf_asChar(field));
+  int out_len = 0;
+  const void* ptr = nullptr;
+  int out_type = 0;
+  CheckCall(LGBM_DatasetGetField(R_ExternalPtrAddr(handle), name, &out_len,
+                                 &ptr, &out_type),
+            "DatasetGetField");
+  if (ptr == nullptr || out_len <= 0) return Rf_allocVector(REALSXP, 0);
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, out_len));
+  double* dst = REAL(out);
+  if (out_type == 0) {            // C_API_DTYPE_FLOAT32
+    const float* src = (const float*)ptr;
+    for (int i = 0; i < out_len; ++i) dst[i] = src[i];
+  } else if (out_type == 1) {     // C_API_DTYPE_FLOAT64
+    const double* src = (const double*)ptr;
+    for (int i = 0; i < out_len; ++i) dst[i] = src[i];
+  } else {                        // int32 (group boundaries)
+    const int32_t* src = (const int32_t*)ptr;
+    for (int i = 0; i < out_len; ++i) dst[i] = src[i];
+  }
+  UNPROTECT(1);
+  return out;
+}
+
 SEXP LGBMTPU_DatasetGetNumData_R(SEXP handle) {
   int32_t out = 0;
   CheckCall(LGBM_DatasetGetNumData(R_ExternalPtrAddr(handle), &out),
@@ -188,6 +216,13 @@ SEXP LGBMTPU_BoosterAddValidData_R(SEXP handle, SEXP valid) {
   CheckCall(LGBM_BoosterAddValidData(R_ExternalPtrAddr(handle),
                                      R_ExternalPtrAddr(valid)),
             "BoosterAddValidData");
+  return R_NilValue;
+}
+
+SEXP LGBMTPU_BoosterMerge_R(SEXP handle, SEXP other) {
+  CheckCall(LGBM_BoosterMerge(R_ExternalPtrAddr(handle),
+                              R_ExternalPtrAddr(other)),
+            "BoosterMerge");
   return R_NilValue;
 }
 
@@ -359,11 +394,13 @@ static const R_CallMethodDef CallEntries[] = {
     {"LGBMTPU_DatasetCreateFromMat_R", (DL_FUNC)&LGBMTPU_DatasetCreateFromMat_R, 5},
     {"LGBMTPU_DatasetCreateFromFile_R", (DL_FUNC)&LGBMTPU_DatasetCreateFromFile_R, 3},
     {"LGBMTPU_DatasetSetField_R", (DL_FUNC)&LGBMTPU_DatasetSetField_R, 3},
+    {"LGBMTPU_DatasetGetField_R", (DL_FUNC)&LGBMTPU_DatasetGetField_R, 2},
     {"LGBMTPU_DatasetGetNumData_R", (DL_FUNC)&LGBMTPU_DatasetGetNumData_R, 1},
     {"LGBMTPU_DatasetGetNumFeature_R", (DL_FUNC)&LGBMTPU_DatasetGetNumFeature_R, 1},
     {"LGBMTPU_BoosterCreate_R", (DL_FUNC)&LGBMTPU_BoosterCreate_R, 2},
     {"LGBMTPU_BoosterCreateFromModelfile_R", (DL_FUNC)&LGBMTPU_BoosterCreateFromModelfile_R, 1},
     {"LGBMTPU_BoosterAddValidData_R", (DL_FUNC)&LGBMTPU_BoosterAddValidData_R, 2},
+    {"LGBMTPU_BoosterMerge_R", (DL_FUNC)&LGBMTPU_BoosterMerge_R, 2},
     {"LGBMTPU_BoosterUpdateOneIter_R", (DL_FUNC)&LGBMTPU_BoosterUpdateOneIter_R, 1},
     {"LGBMTPU_BoosterRollbackOneIter_R", (DL_FUNC)&LGBMTPU_BoosterRollbackOneIter_R, 1},
     {"LGBMTPU_BoosterGetCurrentIteration_R", (DL_FUNC)&LGBMTPU_BoosterGetCurrentIteration_R, 1},

@@ -1,0 +1,8 @@
+# testthat driver (run with an R installation: R CMD check or
+# testthat::test_dir). The CI image has no R runtime; these tests are
+# exercised there indirectly via tests/test_c_api.py::test_r_behavior_mirror,
+# which drives the same scenarios through the C ABI the R glue binds.
+library(testthat)
+library(lightgbm_tpu)
+
+test_check("lightgbm_tpu")

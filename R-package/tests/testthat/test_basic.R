@@ -1,0 +1,89 @@
+# Core train/predict behaviors (parity targets:
+# reference R-package/tests/testthat/test_basic.R).
+
+context("training basics")
+
+.make_binary <- function(n = 1200L, f = 8L, seed = 7L) {
+  set.seed(seed)
+  x <- matrix(rnorm(n * f), ncol = f)
+  logit <- 1.5 * x[, 1L] - x[, 2L] + 0.5 * x[, 3L] * x[, 4L]
+  y <- as.numeric(logit + rnorm(n) * 0.5 > 0)
+  list(x = x, y = y)
+}
+
+test_that("binary training reaches low train error and predicts in [0,1]", {
+  d <- .make_binary()
+  bst <- lightgbm(
+    data = d$x, label = d$y,
+    num_leaves = 15L, nrounds = 10L, learning_rate = 0.3,
+    objective = "binary", metric = "binary_error", verbose = -1L
+  )
+  expect_true(inherits(bst, "lgb.Booster"))
+  expect_equal(bst$current_iter(), 10L)
+  p <- predict(bst, d$x)
+  expect_true(all(p >= 0 & p <= 1))
+  err <- mean(as.numeric(p > 0.5) != d$y)
+  expect_lt(err, 0.15)
+})
+
+test_that("multiclass softmax trains and emits one column per class", {
+  set.seed(3L)
+  n <- 300L
+  x <- matrix(rnorm(n * 4L), ncol = 4L)
+  y <- sample(0L:2L, n, replace = TRUE)
+  bst <- lightgbm(
+    data = x, label = y, nrounds = 5L, objective = "multiclass",
+    num_class = 3L, metric = "multi_logloss", verbose = -1L
+  )
+  p <- predict(bst, x)
+  expect_equal(dim(p), c(n, 3L))
+  expect_equal(rowSums(p), rep(1, n), tolerance = 1e-6)
+})
+
+test_that("multiple eval metrics are all recorded", {
+  d <- .make_binary()
+  dtrain <- lgb.Dataset(d$x, label = d$y)
+  bst <- lgb.train(
+    params = list(objective = "binary",
+                  metric = list("binary_error", "binary_logloss"),
+                  verbose = -1L),
+    data = dtrain, nrounds = 5L,
+    valids = list(train = dtrain)
+  )
+  expect_named(bst$record_evals$train,
+               c("binary_error", "binary_logloss"),
+               ignore.order = TRUE)
+})
+
+test_that("training continues from a saved model", {
+  d <- .make_binary()
+  dtrain <- lgb.Dataset(d$x, label = d$y)
+  params <- list(objective = "binary", metric = "binary_logloss",
+                 verbose = -1L)
+  bst1 <- lgb.train(params, dtrain, nrounds = 4L)
+  model_file <- tempfile(fileext = ".txt")
+  lgb.save(bst1, model_file)
+  bst2 <- lgb.train(params, dtrain, nrounds = 4L, init_model = model_file)
+  expect_equal(bst2$current_iter(), 8L)
+  # continued model must not be worse on train logloss
+  eps <- 1e-8
+  ll <- function(b) {
+    p <- predict(b, d$x)
+    -mean(d$y * log(p + eps) + (1 - d$y) * log(1 - p + eps))
+  }
+  expect_lte(ll(bst2), ll(bst1) + 1e-6)
+})
+
+test_that("lgb.cv produces per-round records", {
+  d <- .make_binary()
+  dtrain <- lgb.Dataset(d$x, label = d$y)
+  cv <- lgb.cv(
+    params = list(objective = "binary", metric = "binary_error",
+                  verbose = -1L),
+    data = dtrain, nrounds = 5L, nfold = 3L
+  )
+  expect_false(is.null(cv$record_evals))
+  errs <- unlist(cv$record_evals$valid$binary_error$eval)
+  expect_equal(length(errs), 5L)
+  expect_true(all(errs >= 0 & errs <= 1))
+})

@@ -1,0 +1,41 @@
+# lgb.Dataset behaviors (parity targets:
+# reference R-package/tests/testthat/test_dataset.R).
+
+context("lgb.Dataset")
+
+.mk <- function(n = 500L, f = 6L, seed = 11L) {
+  set.seed(seed)
+  x <- matrix(rnorm(n * f), ncol = f)
+  y <- as.numeric(x[, 1L] + rnorm(n) > 0)
+  list(x = x, y = y)
+}
+
+test_that("construction from a matrix yields a usable handle", {
+  d <- .mk()
+  ds <- lgb.Dataset(d$x, label = d$y)
+  ds$construct()
+  expect_false(is.null(ds$handle))
+  expect_equal(ds$dim(), c(500L, 6L))
+})
+
+test_that("setinfo/getinfo round-trip label and weight", {
+  d <- .mk()
+  ds <- lgb.Dataset(d$x, label = d$y)
+  ds$construct()
+  expect_equal(ds$getinfo("label"), d$y)
+  w <- runif(length(d$y))
+  ds$setinfo("weight", w)
+  expect_equal(ds$getinfo("weight"), w, tolerance = 1e-6)
+})
+
+test_that("a dataset written from file trains identically to in-memory", {
+  d <- .mk()
+  csv <- tempfile(fileext = ".csv")
+  write.table(cbind(d$y, d$x), csv, sep = ",", row.names = FALSE,
+              col.names = FALSE)
+  params <- list(objective = "binary", verbose = -1L)
+  bst_mem <- lgb.train(params, lgb.Dataset(d$x, label = d$y), nrounds = 3L)
+  bst_file <- lgb.train(params, lgb.Dataset(csv), nrounds = 3L)
+  expect_equal(predict(bst_mem, d$x), predict(bst_file, d$x),
+               tolerance = 1e-6)
+})

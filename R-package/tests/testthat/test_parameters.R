@@ -1,0 +1,52 @@
+# Parameter behaviors (parity targets:
+# reference R-package/tests/testthat/test_parameters.R).
+
+context("parameters")
+
+.mk <- function(n = 800L, seed = 5L) {
+  set.seed(seed)
+  x <- matrix(rnorm(n * 4L), ncol = 4L)
+  y <- as.numeric(x[, 1L] + 0.5 * x[, 2L] + rnorm(n) * 0.3 > 0)
+  list(x = x, y = y)
+}
+
+test_that("feature_contri = 0 removes a feature from every split", {
+  d <- .mk()
+  bst <- lgb.train(
+    params = list(objective = "binary", verbose = -1L,
+                  feature_contri = c(0, 1, 1, 1)),
+    data = lgb.Dataset(d$x, label = d$y), nrounds = 5L
+  )
+  imp <- lgb.importance(bst, importance_type = "split")
+  expect_equal(imp[[1L]], 0)
+  expect_gt(sum(imp), 0)
+})
+
+test_that("monotone_constraints produce monotone predictions", {
+  set.seed(13L)
+  n <- 600L
+  x <- matrix(runif(n * 2L), ncol = 2L)
+  y <- x[, 1L] + rnorm(n) * 0.05
+  bst <- lgb.train(
+    params = list(objective = "regression", verbose = -1L,
+                  monotone_constraints = c(1L, 0L)),
+    data = lgb.Dataset(x, label = y), nrounds = 10L
+  )
+  grid <- seq(0.05, 0.95, by = 0.05)
+  probe <- cbind(grid, 0.5)
+  p <- predict(bst, probe)
+  expect_true(all(diff(p) >= -1e-10))
+})
+
+test_that("num_leaves caps the model's leaf count", {
+  d <- .mk()
+  bst <- lgb.train(
+    params = list(objective = "binary", verbose = -1L, num_leaves = 4L),
+    data = lgb.Dataset(d$x, label = d$y), nrounds = 2L
+  )
+  dumped <- bst$dump_model()
+  expect_true(is.character(dumped) || is.list(dumped))
+  leaves <- gregexpr("leaf_value", paste(dumped, collapse = ""))[[1L]]
+  # 2 trees x at most 4 leaves
+  expect_lte(length(leaves), 8L)
+})
