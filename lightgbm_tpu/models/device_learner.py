@@ -1133,6 +1133,10 @@ def plan_histogram_pool(config: Config, dataset: Dataset):
 class DeviceTreeLearner:
     """Drop-in TreeLearner whose Train runs one jitted program per tree."""
 
+    # make_fused_step(goss=...) is implemented (in-program sampling);
+    # subclasses without it override to False
+    supports_fused_goss = True
+
     def __init__(self, config: Config, dataset: Dataset,
                  strategy: Optional[str] = None, device_place: bool = True):
         # device_place=False keeps the compact buffers host-side so a

@@ -222,10 +222,10 @@ class GBDT:
         """Whether the single-program device iteration applies (plain GBDT,
         single-class jittable objective, device learner, plain bagging)."""
         from .device_learner import DeviceTreeLearner
-        if self.__class__ is GOSS and type(self.learner) is not \
-                DeviceTreeLearner:
-            # fused GOSS needs a global top-k; the sharded DP program
-            # does not implement it (falls back to the generic path)
+        if self.__class__ is GOSS and not getattr(
+                self.learner, "supports_fused_goss", False):
+            # learners without in-program GOSS sampling (the feature-
+            # parallel device learner) fall back to the generic path
             return False
         return (self.__class__ in (GBDT, GOSS)
                 and isinstance(self.learner, DeviceTreeLearner)

@@ -534,19 +534,25 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                     scatter_cols=self.scatter_cols,
                     window_step=self.window_step, **self._statics())
 
-    def _sharded_tree_fn(self, with_bag_key: bool, allow_bagging=True):
+    def _sharded_tree_fn(self, with_bag_key: bool, allow_bagging=True,
+                         goss=None):
         """shard_map'd whole-tree program. with_bag_key=True computes the
         per-shard bag weights inside the program (fused path); False takes
         an explicit (n_pad,) weight vector (generic path). allow_bagging
         =False forces full-data growth regardless of bagging params (the
-        GOSS-warmup contract, should fused GOSS ever land here)."""
+        GOSS-warmup contract). goss=(top_rate, other_rate) switches the
+        in-program sampling to per-shard GOSS: each shard keeps its local
+        top rows by |g*h| and amplifies a uniform sample of the rest —
+        the reference's distributed behavior (BaggingHelper runs on each
+        machine's local partition, goss.hpp:60-117 under num_machines>1),
+        so no global top-k collective is needed."""
         from ..models.device_learner import grow_tree_compact_core
         statics = self._grow_statics()
         meta = self._meta
         cfg = self.config
         n = self.dataset.num_data
         local_n = self.local_n
-        bag_on = (allow_bagging and cfg.bagging_freq > 0
+        bag_on = (goss is None and allow_bagging and cfg.bagging_freq > 0
                   and cfg.bagging_fraction < 1.0)
         frac = float(cfg.bagging_fraction)
 
@@ -555,7 +561,36 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
             pos = jnp.arange(local_n, dtype=jnp.int32)
             real = jnp.clip(n - i * local_n, 0, local_n)
             alive = pos < real
-            if with_bag_key:
+            if with_bag_key and goss is not None:
+                top_rate, other_rate = goss
+                realf = real.astype(jnp.float32)
+                top_l = jnp.maximum(1, (realf * top_rate).astype(jnp.int32))
+                other_l = jnp.maximum(
+                    1, (realf * other_rate).astype(jnp.int32))
+                # exact local top_l by |g*h| (rank-based like the
+                # single-chip fused GOSS; pads carry gmag 0 and sit after
+                # equal-key alive rows in the stable sort)
+                gmag = jnp.abs(g_l * h_l) * alive.astype(jnp.float32)
+                ridx = jnp.argsort(-gmag, stable=True)
+                rank_of = jnp.zeros(local_n, jnp.int32).at[ridx].set(pos)
+                is_top = (rank_of < top_l) & alive
+                u = jnp.where(
+                    alive & ~is_top,
+                    jax.random.uniform(
+                        jax.random.fold_in(w_or_key, i), (local_n,)),
+                    jnp.inf)
+                cut = jnp.sort(u)[other_l - 1]
+                # alive/~is_top guard: on a degenerate shard (all padding,
+                # or fewer rest-rows than other_l) cut is inf and a bare
+                # u <= cut would select pad and top rows
+                is_other = (u <= cut) & alive & ~is_top
+                mult = ((realf - top_l.astype(jnp.float32))
+                        / jnp.maximum(other_l, 1).astype(jnp.float32))
+                amp = jnp.where(is_other, mult, 1.0)
+                g_l = g_l * amp
+                h_l = h_l * amp
+                w_l = (is_top | is_other).astype(jnp.float32)
+            elif with_bag_key:
                 if bag_on:
                     # per-shard exact-count bagging over the shard's real
                     # rows (reference bags each machine's local partition,
@@ -640,18 +675,20 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         .make_fused_step): gradients auto-shard over the score, the tree
         grows under shard_map with per-split psum, the score update is
         elementwise over the sharded leaf assignment."""
-        if goss is not None:
-            # device GOSS needs a GLOBAL top-k across shards; not wired
-            # into the sharded program yet (GBDT._fused_eligible gates
-            # GOSS to the single-chip learner, so this is a guard)
-            raise NotImplementedError(
-                "fused GOSS is not supported on the data-parallel learner")
         from ..models.device_learner import leaf_values_from_rec
         n = self.dataset.num_data
         npad = self.n_pad
         L = int(self.config.num_leaves)
+        # fused GOSS runs per shard (local top-k + amplification, the
+        # reference's per-machine BaggingHelper semantics); rates come
+        # from config, counts are derived from each shard's real rows
+        goss_rates = None
+        if goss is not None:
+            goss_rates = (float(self.config.top_rate),
+                          float(self.config.other_rate))
         fn = self._sharded_tree_fn(with_bag_key=True,
-                                   allow_bagging=bagging)
+                                   allow_bagging=bagging,
+                                   goss=goss_rates)
 
         @jax.jit
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
@@ -698,6 +735,8 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
     (feature_parallel_tree_learner.cpp:33-76, SyncUpGlobalBestSplit),
     with the entire leaf-wise tree grown inside one shard_map program
     instead of one host round-trip per split."""
+
+    supports_fused_goss = False   # make_fused_step(goss=...) raises
 
     def __init__(self, config: Config, dataset: Dataset,
                  mesh: Optional[Mesh] = None):

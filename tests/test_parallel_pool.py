@@ -65,14 +65,15 @@ def test_voting_with_lru_pool():
 
 
 def test_goss_on_data_parallel_learner():
-    """GOSS cannot fuse on the sharded learners (global top-k not in the
-    sharded program); it must fall back to the generic path with host
-    sampling and still learn."""
+    """Fused GOSS on the sharded DP learner: per-shard local top-k +
+    amplification inside the shard_map program (the reference's
+    per-machine BaggingHelper semantics, goss.hpp under
+    num_machines > 1) — no generic-path fallback, no host sampling."""
     x, y = make_binary(2000, 8)
     b = _train_pooled(x, y, "data", None, rounds=12, boosting="goss",
                       top_rate=0.3, other_rate=0.2, learning_rate=0.3)
-    assert b._fused_step is None or not b._fused_step, \
-        "GOSS+DP must not take the fused path"
+    assert b._fused_step and True in b._fused_step, \
+        "GOSS+DP must take the fused path (goss-active program compiled)"
     s = b.predict(x, raw_score=True)
     order = np.argsort(s)
     ranks = np.empty(len(s))
