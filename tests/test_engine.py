@@ -811,3 +811,138 @@ def test_init_model_from_file_seeds_scores_and_valids():
     assert abs(first_eval - logloss(bst1)) < 0.05, (first_eval, logloss(bst1))
     # and the final model must improve on the 6-tree model
     assert logloss(bst2) < logloss(bst1) + 1e-9
+
+
+def _dummy_obj(preds, train_data):
+    return np.ones(len(preds)), np.ones(len(preds))
+
+
+def _constant_metric(preds, train_data):
+    return ("error", 0.0, False)
+
+
+def test_metric_aliasing_matrix():
+    """reference: test_engine.py:1072 test_metrics — the params/args/fobj/
+    feval metric-resolution matrix for lgb.cv."""
+    x, y = make_binary(500)
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    pv = {"verbosity": -1}
+    p_obj = {"objective": "binary", "verbosity": -1}
+    p_obj_err = {"objective": "binary", "metric": "binary_error",
+                 "verbosity": -1}
+    p_obj_multi = {"objective": "binary",
+                   "metric": ["binary_logloss", "binary_error"],
+                   "verbosity": -1}
+    p_err = {"metric": "binary_error", "verbosity": -1}
+    p_multi = {"metric": ["binary_logloss", "binary_error"],
+               "verbosity": -1}
+
+    def res(params=p_obj, **kw):
+        return lgb.cv(dict(params), ds, num_boost_round=2, nfold=3,
+                      verbose_eval=False, **kw)
+
+    # no fobj, no feval: default / params / args / args-overwrites-params
+    assert "binary_logloss-mean" in res()
+    assert "binary_error-mean" in res(params=p_obj_err)
+    assert "binary_logloss-mean" in res(metrics="binary_logloss")
+    assert "binary_error-mean" in res(metrics="binary_error")
+    r = res(params=p_obj_multi)
+    assert "binary_logloss-mean" in r and "binary_error-mean" in r
+    r = res(metrics=["binary_logloss", "binary_error"])
+    assert "binary_logloss-mean" in r and "binary_error-mean" in r
+    # 'None' aliases remove the default metric
+    for na in ("None", "na", "null", "custom"):
+        assert len(res(metrics=na)) == 0
+    assert len(res(metrics=["None"])) == 0
+
+    # fobj: no default metric unless requested
+    assert len(res(params=pv, fobj=_dummy_obj)) == 0
+    assert "binary_error-mean" in res(params=p_err, fobj=_dummy_obj)
+    assert "binary_error-mean" in res(params=pv, fobj=_dummy_obj,
+                                      metrics="binary_error")
+    r = res(params=p_multi, fobj=_dummy_obj)
+    assert "binary_logloss-mean" in r and "binary_error-mean" in r
+
+    # feval joins whatever internal metrics resolve
+    r = res(feval=_constant_metric)
+    assert "binary_logloss-mean" in r and "error-mean" in r
+    r = res(params=p_obj_err, feval=_constant_metric)
+    assert "binary_error-mean" in r and "error-mean" in r
+    r = res(params=p_obj_multi, feval=_constant_metric)
+    assert ("binary_logloss-mean" in r and "binary_error-mean" in r
+            and "error-mean" in r)
+    # feval only, internal metrics removed
+    r = res(metrics="None", feval=_constant_metric)
+    assert list(r.keys()) == ["error-mean", "error-stdv"]
+
+
+def test_model_size_many_trees():
+    """reference: test_engine.py:1447 test_model_size — a model string
+    with replicated trees loads, reports the right tree count, and
+    truncated prediction matches. (The reference pads past 2 GiB to probe
+    C-side 32-bit offsets; scaled down here — the engine is not
+    offset-limited, and a 2 GiB string is pure wall on this box.)"""
+    x, y = make_regression(400)
+    bst = lgb.train({"verbosity": -1, "objective": "regression"},
+                    lgb.Dataset(x, y), num_boost_round=2)
+    pred = bst.predict(x)
+    s = bst.model_to_string()
+    one_tree = s[s.find("Tree=1"):s.find("end of trees")]
+    one_tree = one_tree.replace("Tree=1", "Tree={}")
+    multiplier = 100
+    total = multiplier + 2
+    big = (s[:s.find("tree_sizes")]
+           + "\n\n"
+           + s[s.find("Tree=0"):s.find("end of trees")]
+           + (one_tree * multiplier).format(*range(2, total))
+           + s[s.find("end of trees"):]
+           + " " * (1 << 20))
+    bst.model_from_string(big, verbose=False)
+    assert bst.num_trees() == total
+    np.testing.assert_allclose(bst.predict(x, num_iteration=2), pred)
+
+
+def test_mean_average_precision_alias():
+    """reference: config.cpp:104 — 'mean_average_precision' resolves to
+    the map ranking metric; values land in [0, 1] and improve."""
+    x, y, group = make_ranking(40)
+    evals = {}
+    ds = lgb.Dataset(x, y, group=group, free_raw_data=False)
+    vds = lgb.Dataset(x, y, group=group, free_raw_data=False,
+                      reference=ds)
+    lgb.train({"objective": "lambdarank",
+               "metric": "mean_average_precision", "eval_at": [3],
+               "verbosity": -1}, ds, num_boost_round=5,
+              valid_sets=[vds], valid_names=["val"],
+              evals_result=evals, verbose_eval=False)
+    key = [k for k in evals["val"] if k.startswith("map")]
+    assert key, list(evals["val"])
+    vals = evals["val"][key[0]]
+    assert all(0.0 <= v <= 1.0 for v in vals)
+    assert vals[-1] >= vals[0] - 1e-9
+
+
+def test_trivial_features_dropped():
+    """Constant columns never get split on (reference: used_feature
+    filtering in DatasetLoader)."""
+    x, y = make_binary(500)
+    x = np.column_stack([x, np.zeros(500), np.full(500, 3.0)])
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(x, y), num_boost_round=5)
+    imp = bst.feature_importance("split")
+    assert imp[-1] == 0 and imp[-2] == 0
+    assert imp.sum() > 0
+
+
+def test_predict_num_iteration_slices():
+    """Prediction with start_iteration/num_iteration equals summing the
+    per-tree contributions of exactly that slice."""
+    x, y = make_binary(700)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(x, y), num_boost_round=6)
+    full = bst.predict(x, raw_score=True)
+    a = bst.predict(x, raw_score=True, num_iteration=3)
+    b = bst.predict(x, raw_score=True, start_iteration=3, num_iteration=3)
+    base = full - (a + b)
+    # the init score (boost_from_average) rides both slice predictions
+    np.testing.assert_allclose(base, np.full_like(base, base[0]), atol=1e-5)
