@@ -9,6 +9,20 @@ Dataset <- R6::R6Class(
 
     initialize = function(data, params = list(), label = NULL, weight = NULL,
                           group = NULL, init_score = NULL, reference = NULL) {
+      if (!is.null(reference) && !inherits(reference, "lgb.Dataset")) {
+        stop("lgb.Dataset: reference must be an lgb.Dataset")
+      }
+      if (!is.character(data)) {
+        # densify anything matrix-like (incl. Matrix sparse classes)
+        data <- tryCatch(as.matrix(data), error = function(e) {
+          stop("lgb.Dataset: data must be coercible to a numeric ",
+               "matrix or be a file path (got ", class(data)[1L], ")")
+        })
+        if (!is.null(label) && length(label) != NROW(data)) {
+          stop(sprintf("lgb.Dataset: label length %d != %d rows",
+                       length(label), NROW(data)))
+        }
+      }
       private$params <- params
       ref_handle <- if (is.null(reference)) NULL else reference$handle
       if (is.character(data)) {
@@ -25,6 +39,16 @@ Dataset <- R6::R6Class(
       if (!is.null(weight)) self$set_field("weight", weight)
       if (!is.null(group)) self$set_field("group", group)
       if (!is.null(init_score)) self$set_field("init_score", init_score)
+    },
+
+    subset = function(idx) {
+      # native row subset that inherits bin mappers, label, weight and
+      # init_score (reference Dataset$slice -> LGBM_DatasetGetSubset)
+      h <- .Call(LGBMTPU_DatasetGetSubset_R, self$handle,
+                 as.integer(idx) - 1L, lgb.params2str(private$params))
+      d <- Dataset$new(matrix(0, 1L, 1L), private$params)
+      d$handle <- h
+      d
     },
 
     set_field = function(name, data) {

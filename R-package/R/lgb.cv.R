@@ -2,24 +2,36 @@
 
 #' Cross validation for lightgbm.tpu
 #'
-#' Trains `nfold` boosters on stratified-free contiguous folds and reports
-#' the per-iteration mean/sd of the first validation metric.
+#' Trains `nfold` boosters on shuffled folds and reports the
+#' per-iteration mean/sd of every validation metric, in the reference's
+#' record shape: `record_evals$valid[[metric]]$eval` (means) and
+#' `$eval_err` (standard deviations).
 #' @param params list of training parameters
-#' @param data an lgb.Dataset-producing matrix (raw matrix + label), since
-#'   fold subsetting needs the raw rows
+#' @param data an lgb.Dataset (folded via native row subsets that
+#'   inherit its binning and metadata) or a raw matrix + label
 #' @param label label vector when `data` is a matrix
 #' @param nrounds number of boosting rounds
-#' @param nfold number of folds
-#' @param early_stopping_rounds stop when the mean metric stops improving
-#' @return list with fields `record` (iter x c(mean, sd)), `best_iter`,
-#'   `boosters`
+#' @param nfold number of folds (>= 2)
+#' @param early_stopping_rounds stop when the mean of the first metric
+#'   stops improving
+#' @return list with fields `record_evals`, `record` (iter x
+#'   c(mean, sd) of the first metric), `best_iter`, `boosters`
 #' @export
 lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
                    nfold = 5L, early_stopping_rounds = NULL, verbose = 1L,
                    folds = NULL) {
-  data <- as.matrix(data)
-  storage.mode(data) <- "double"
-  n <- nrow(data)
+  nfold <- as.integer(nfold)
+  if (is.na(nfold) || nfold < 2L) {
+    stop("lgb.cv: nfold must be an integer >= 2")
+  }
+  from_dataset <- inherits(data, "lgb.Dataset")
+  if (!from_dataset) {
+    data <- as.matrix(data)
+    storage.mode(data) <- "double"
+    n <- nrow(data)
+  } else {
+    n <- data$dim()[1L]
+  }
   if (is.null(folds)) {
     idx <- sample.int(n)
     folds <- split(idx, rep_len(seq_len(nfold), n))
@@ -28,36 +40,67 @@ lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
   for (k in seq_along(folds)) {
     test_idx <- folds[[k]]
     train_idx <- setdiff(seq_len(n), test_idx)
-    dtrain <- lgb.Dataset(data[train_idx, , drop = FALSE],
-                          label = label[train_idx])
-    dtest <- lgb.Dataset(data[test_idx, , drop = FALSE],
-                         label = label[test_idx], reference = dtrain)
+    if (from_dataset) {
+      # native row subsets inherit the dataset's bin mappers, label,
+      # weight and init_score (reference lgb.cv -> Dataset$slice)
+      dtrain <- data$subset(train_idx)
+      dtest <- data$subset(test_idx)
+    } else {
+      dtrain <- lgb.Dataset(data[train_idx, , drop = FALSE],
+                            label = label[train_idx])
+      dtest <- lgb.Dataset(data[test_idx, , drop = FALSE],
+                           label = label[test_idx], reference = dtrain)
+    }
     bst <- Booster$new(params, train_set = dtrain)
     bst$add_valid(dtest, "valid")
     boosters[[k]] <- bst
   }
+  metric_names <- character(0)
   higher_better <- FALSE
   record <- matrix(NA_real_, nrow = nrounds, ncol = 2L,
                    dimnames = list(NULL, c("mean", "sd")))
+  record_evals <- list(valid = list())
   best_iter <- -1L
   best_score <- Inf
   for (i in seq_len(nrounds)) {
-    scores <- vapply(boosters, function(b) {
+    evs <- lapply(boosters, function(b) {
       b$update()
-      ev <- b$eval(1L)
-      if (length(ev) > 0) ev[[1]] else NA_real_
-    }, numeric(1))
-    if (anyNA(scores)) {
+      b$eval(1L)
+    })
+    n_metrics <- length(evs[[1]])
+    if (n_metrics == 0) {
       # metric="none" / objective without a default metric: nothing to
       # record or stop on, just keep boosting
       next
     }
-    if (i == 1L) {
+    if (length(metric_names) == 0) {
+      metric_names <- tryCatch(boosters[[1]]$eval_names(),
+                               error = function(e) character(0))
+      if (length(metric_names) < n_metrics) {
+        metric_names <- c(metric_names,
+                          paste0("metric_",
+                                 seq(length(metric_names) + 1L,
+                                     n_metrics)))
+      }
       hb <- tryCatch(boosters[[1]]$eval_higher_better(),
                      error = function(e) logical(0))
       higher_better <- length(hb) > 0 && isTRUE(hb[[1]])
     }
-    record[i, ] <- c(mean(scores), stats::sd(scores))
+    for (mi in seq_len(n_metrics)) {
+      vals <- vapply(evs, function(ev) ev[[mi]], numeric(1))
+      mname <- metric_names[[mi]]
+      record_evals$valid[[mname]]$eval <-
+        c(record_evals$valid[[mname]]$eval, mean(vals))
+      record_evals$valid[[mname]]$eval_err <-
+        c(record_evals$valid[[mname]]$eval_err, stats::sd(vals))
+    }
+    first <- vapply(evs, function(ev) ev[[1]], numeric(1))
+    if (anyNA(first) || any(is.nan(first))) {
+      # a degenerate fold (e.g. single-class AUC) yields NaN: nothing to
+      # record or stop on this round, keep boosting
+      next
+    }
+    record[i, ] <- c(mean(first), stats::sd(first))
     if (verbose > 0) {
       message(sprintf("[%d] cv: %.6f + %.6f", i, record[i, 1], record[i, 2]))
     }
@@ -74,5 +117,6 @@ lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
       break
     }
   }
-  list(record = record, best_iter = best_iter, boosters = boosters)
+  list(record_evals = record_evals, record = record,
+       best_iter = best_iter, boosters = boosters)
 }

@@ -18,6 +18,29 @@
 lgb.train <- function(params = list(), data, nrounds = 100L,
                       valids = list(), early_stopping_rounds = NULL,
                       init_model = NULL, verbose = 1L) {
+  if (!is.list(params)) {
+    stop("lgb.train: params must be a named list")
+  }
+  if (!inherits(data, "lgb.Dataset")) {
+    stop("lgb.train: data must be an lgb.Dataset")
+  }
+  nrounds <- as.integer(nrounds)
+  if (is.na(nrounds) || nrounds < 1L) {
+    stop("lgb.train: nrounds must be a positive integer")
+  }
+  if (length(valids) > 0) {
+    if (is.null(names(valids)) || any(names(valids) == "")) {
+      stop("lgb.train: every element of valids must be named")
+    }
+    if (!all(vapply(valids, inherits, logical(1), "lgb.Dataset"))) {
+      stop("lgb.train: valids must contain lgb.Dataset objects")
+    }
+  }
+  if (!is.null(early_stopping_rounds)
+      && (!is.numeric(early_stopping_rounds)
+          || early_stopping_rounds < 1)) {
+    stop("lgb.train: early_stopping_rounds must be a positive number")
+  }
   booster <- Booster$new(params, train_set = data)
   if (!is.null(init_model)) {
     prev <- if (is.character(init_model)) {

@@ -11,9 +11,24 @@
 lightgbm <- function(data, label = NULL, params = list(), nrounds = 100L,
                      objective = NULL, verbose = 1L, ...) {
   if (!is.null(objective)) params$objective <- objective
+  dots <- list(...)
+  if (length(dots) > 0 && (is.null(names(dots)) || any(names(dots) == ""))) {
+    stop("lightgbm: additional arguments must be named")
+  }
+  # dots matching lgb.train's signature (R partial matching included)
+  # pass through; everything else is a training parameter (upstream
+  # lightgbm() behaves the same way)
+  train_formals <- setdiff(names(formals(lgb.train)),
+                           c("params", "data", "nrounds", "verbose"))
+  matched <- pmatch(names(dots), train_formals, duplicates.ok = FALSE)
+  is_train_arg <- !is.na(matched)
+  params[names(dots)[!is_train_arg]] <- dots[!is_train_arg]
+  train_dots <- dots[is_train_arg]
+  names(train_dots) <- train_formals[matched[is_train_arg]]
   dtrain <- lgb.Dataset(data, label = label)
-  lgb.train(params = params, data = dtrain, nrounds = nrounds,
-            verbose = verbose, ...)
+  do.call(lgb.train, c(list(params = params, data = dtrain,
+                            nrounds = nrounds, verbose = verbose),
+                       train_dots))
 }
 
 #' Dump a model to its JSON representation

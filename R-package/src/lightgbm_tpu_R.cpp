@@ -40,6 +40,10 @@ int LGBM_BoosterFree(BoosterHandle handle);
 int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle);
 int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
                          int* out_len, const void** out_ptr, int* out_type);
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices,
+                          const char* parameters, DatasetHandle* out);
 int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
@@ -152,6 +156,17 @@ SEXP LGBMTPU_DatasetSetField_R(SEXP handle, SEXP field, SEXP data) {
               "DatasetSetField");
   }
   return R_NilValue;
+}
+
+SEXP LGBMTPU_DatasetGetSubset_R(SEXP handle, SEXP indices, SEXP params) {
+  int n = Rf_length(indices);
+  std::vector<int32_t> idx(n);
+  for (int i = 0; i < n; ++i) idx[i] = INTEGER(indices)[i];
+  DatasetHandle out = nullptr;
+  CheckCall(LGBM_DatasetGetSubset(R_ExternalPtrAddr(handle), idx.data(), n,
+                                  CHAR(Rf_asChar(params)), &out),
+            "DatasetGetSubset");
+  return WrapHandle(out, DatasetFinalizer);
 }
 
 SEXP LGBMTPU_DatasetGetField_R(SEXP handle, SEXP field) {
@@ -394,6 +409,7 @@ static const R_CallMethodDef CallEntries[] = {
     {"LGBMTPU_DatasetCreateFromMat_R", (DL_FUNC)&LGBMTPU_DatasetCreateFromMat_R, 5},
     {"LGBMTPU_DatasetCreateFromFile_R", (DL_FUNC)&LGBMTPU_DatasetCreateFromFile_R, 3},
     {"LGBMTPU_DatasetSetField_R", (DL_FUNC)&LGBMTPU_DatasetSetField_R, 3},
+    {"LGBMTPU_DatasetGetSubset_R", (DL_FUNC)&LGBMTPU_DatasetGetSubset_R, 3},
     {"LGBMTPU_DatasetGetField_R", (DL_FUNC)&LGBMTPU_DatasetGetField_R, 2},
     {"LGBMTPU_DatasetGetNumData_R", (DL_FUNC)&LGBMTPU_DatasetGetNumData_R, 1},
     {"LGBMTPU_DatasetGetNumFeature_R", (DL_FUNC)&LGBMTPU_DatasetGetNumFeature_R, 1},

@@ -591,6 +591,16 @@ def grow_tree_compact_core(
                                   fmask, child_depth),
                     jnp.zeros((cat_b,), jnp.float32))
 
+        # batched 2-child elected reduction: ONE (2, 2k, B, 3) psum per
+        # split instead of two sequential ones — half the collective
+        # latency on real ICI. XLA:CPU's collective rendezvous fatally
+        # aborts on the batched form under the virtual mesh (hard 40s
+        # timeout, observed round 2), so the lever defaults to
+        # backend-keyed auto. LGBM_TPU_VOTING_BATCHED=0/1 overrides.
+        vb_env = _env("LGBM_TPU_VOTING_BATCHED", "auto")
+        voting_batched = (jax.default_backend() == "tpu"
+                          if vb_env == "auto" else vb_env == "1")
+
         def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
                          child_depth):
             fmask2 = jax.vmap(node_mask)(keys2)
@@ -599,11 +609,19 @@ def grow_tree_compact_core(
             elect2 = jnp.argsort(
                 -votes2, axis=1,
                 stable=True)[:, :n_elect].astype(jnp.int32)
-            return jnp.stack([
-                _elected_scan(col_hist2[i], elect2[i], sg2[i], sh2[i],
-                              cnt2[i], mn2[i], mx2[i], fmask2[i],
-                              child_depth)
-                for i in range(2)]), jnp.zeros((2, cat_b), jnp.float32)
+            if voting_batched:
+                rows2 = jax.vmap(
+                    _elected_scan,
+                    in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None))(
+                    col_hist2, elect2, sg2, sh2, cnt2, mn2, mx2, fmask2,
+                    child_depth)
+            else:
+                rows2 = jnp.stack([
+                    _elected_scan(col_hist2[i], elect2[i], sg2[i], sh2[i],
+                                  cnt2[i], mn2[i], mx2[i], fmask2[i],
+                                  child_depth)
+                    for i in range(2)])
+            return rows2, jnp.zeros((2, cat_b), jnp.float32)
     elif not sliced:
         (node_mask, scan, store_best, scan2, store_best2,
          best_row) = _tree_helpers(

@@ -40,3 +40,10 @@ echo "--- bench 1M pack 28 words (128B rows) ---" >> $RES
 LGBM_TPU_PACK_WORDS=28 BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WARMUP=3 \
   timeout 1500 python bench.py >> $RES 2>&1
 echo "=== extended battery done $(date +%H:%M:%S) ===" >> $RES
+echo "--- bench 1M time-to-AUC (target 0.78, eval every 10) ---" >> $RES
+BENCH_ROWS=1000000 BENCH_ITERS=150 BENCH_WARMUP=3 BENCH_AUC_TARGET=0.78 \
+  BENCH_EVAL_EVERY=10 timeout 2400 python bench.py >> $RES 2>&1
+echo "--- bench 10.5M 60-iter throughput + AUC trajectory ---" >> $RES
+BENCH_ROWS=10500000 BENCH_ITERS=60 BENCH_WARMUP=3 BENCH_AUC_TARGET=0.80 \
+  BENCH_EVAL_EVERY=20 timeout 3600 python bench.py >> $RES 2>&1
+echo "=== r3 extended battery done $(date +%H:%M:%S) ===" >> $RES
