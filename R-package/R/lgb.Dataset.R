@@ -7,29 +7,42 @@ Dataset <- R6::R6Class(
   public = list(
     handle = NULL,
 
-    initialize = function(data, params = list(), label = NULL, weight = NULL,
-                          group = NULL, init_score = NULL, reference = NULL) {
+    initialize = function(data = NULL, params = list(), label = NULL,
+                          weight = NULL, group = NULL, init_score = NULL,
+                          reference = NULL, handle = NULL) {
+      private$params <- params
+      if (!is.null(handle)) {
+        # wrap an existing native handle (internal: Dataset$subset)
+        self$handle <- handle
+        return(invisible(NULL))
+      }
       if (!is.null(reference) && !inherits(reference, "lgb.Dataset")) {
         stop("lgb.Dataset: reference must be an lgb.Dataset")
       }
-      if (!is.character(data)) {
-        # densify anything matrix-like (incl. Matrix sparse classes)
+      is_file <- is.character(data) && length(data) == 1L
+      if (!is_file) {
+        # densify anything matrix-like (incl. Matrix sparse classes);
+        # the result must be numeric — a data.frame with a character
+        # column densifies to a character matrix, which is an error,
+        # not a file path
         data <- tryCatch(as.matrix(data), error = function(e) {
           stop("lgb.Dataset: data must be coercible to a numeric ",
                "matrix or be a file path (got ", class(data)[1L], ")")
         })
+        if (!is.numeric(data)) {
+          stop("lgb.Dataset: data coerced to a non-numeric matrix; ",
+               "encode factors/characters numerically first")
+        }
         if (!is.null(label) && length(label) != NROW(data)) {
           stop(sprintf("lgb.Dataset: label length %d != %d rows",
                        length(label), NROW(data)))
         }
       }
-      private$params <- params
       ref_handle <- if (is.null(reference)) NULL else reference$handle
-      if (is.character(data)) {
+      if (is_file) {
         self$handle <- .Call(LGBMTPU_DatasetCreateFromFile_R, data,
                              lgb.params2str(params), ref_handle)
       } else {
-        data <- as.matrix(data)
         storage.mode(data) <- "double"
         self$handle <- .Call(LGBMTPU_DatasetCreateFromMat_R, data,
                              nrow(data), ncol(data),
@@ -46,9 +59,7 @@ Dataset <- R6::R6Class(
       # init_score (reference Dataset$slice -> LGBM_DatasetGetSubset)
       h <- .Call(LGBMTPU_DatasetGetSubset_R, self$handle,
                  as.integer(idx) - 1L, lgb.params2str(private$params))
-      d <- Dataset$new(matrix(0, 1L, 1L), private$params)
-      d$handle <- h
-      d
+      Dataset$new(params = private$params, handle = h)
     },
 
     set_field = function(name, data) {

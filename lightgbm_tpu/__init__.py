@@ -44,4 +44,6 @@ __all__ = [
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "LightGBMError",
+    "plot_importance", "plot_split_value_histogram", "plot_metric",
+    "plot_tree", "create_tree_digraph",
 ]

@@ -157,6 +157,25 @@ class Dataset:
     def get_label(self):
         return self.get_field("label")
 
+    def get_data(self):
+        """Raw data used for construction (reference: basic.py:1512);
+        None once free_raw_data dropped it."""
+        if self._inner is None:
+            raise LightGBMError("Cannot get data before construct Dataset")
+        return self.data
+
+    def get_feature_penalty(self):
+        """Per-feature gain penalty (feature_contri), None when unset
+        (reference: basic.py:1476)."""
+        contri = self.construct()._inner.config.feature_contri
+        return np.asarray(contri, dtype=np.float64) if contri else None
+
+    def get_monotone_constraints(self):
+        """Per-feature monotone constraints, None when unset
+        (reference: basic.py:1488)."""
+        mono = self.construct()._inner.config.monotone_constraints
+        return np.asarray(mono, dtype=np.int8) if mono else None
+
     def get_weight(self):
         return self.get_field("weight")
 
@@ -563,6 +582,20 @@ class Booster:
     def free_network(self) -> "Booster":
         from .parallel import network
         network.free()
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """Drop the training/validation datasets (and their score
+        buffers) to free memory (reference: basic.py:1799); further
+        update()/eval() calls are invalid."""
+        self.train_set = None
+        self.name_valid_sets = []
+        if self._gbdt is not None:
+            self._gbdt.train_set = None
+            self._gbdt.valid_sets = []
+            self._gbdt.valid_updaters = []
+            self._gbdt.valid_metrics = []
+            self._gbdt.valid_names = []
         return self
 
     def shuffle_models(self, start_iteration=0, end_iteration=-1) -> "Booster":

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Generate docs/Parameters.md from the parameter schema.
+
+The schema (lightgbm_tpu/params_schema.py) is the single source of truth
+extracted from the reference's config doc comments
+(reference: include/LightGBM/config.h, rendered as docs/Parameters.rst);
+this renders the same surface for lightgbm_tpu users. Re-run after any
+schema change: python tools/gen_parameters_doc.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from lightgbm_tpu.params_schema import PARAMS  # noqa: E402
+
+HEADER = """# Parameters
+
+All training, IO and prediction parameters, matching the reference
+LightGBM v2.3.1 surface (aliases included). Pass them as the `params`
+dict of the Python/R APIs, or as `key=value` pairs to the CLI.
+
+Generated from `lightgbm_tpu/params_schema.py` by
+`tools/gen_parameters_doc.py` — edit the schema, not this file.
+
+TPU-specific runtime knobs (environment variables, not params): see
+`docs/DESIGN.md` (`LGBM_TPU_STRATEGY`, `LGBM_TPU_WINDOW_STEP`,
+`LGBM_TPU_PACK_WORDS`, `LGBM_TPU_PALLAS`, `LGBM_TPU_DP_REDUCE`,
+`LGBM_TPU_VOTING_BATCHED`, `LGBM_TPU_HOST_LEARNER`).
+
+| Parameter | Default | Aliases | Constraints | Description |
+|---|---|---|---|---|
+"""
+
+
+def esc(s):
+    return str(s).replace("|", "\\|").replace("\n", " ")
+
+
+def main():
+    out = [HEADER]
+    for p in PARAMS:
+        doc = esc(p.get("doc", ""))
+        if len(doc) > 400:
+            doc = doc[:397] + "..."
+        out.append("| `%s` | `%s` | %s | %s | %s |\n" % (
+            p["name"], esc(p.get("default", "")),
+            ", ".join("`%s`" % a for a in p.get("aliases", [])) or "—",
+            ", ".join("`%s`" % c for c in p.get("check", [])) or "—",
+            doc))
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "Parameters.md")
+    with open(path, "w") as fh:
+        fh.writelines(out)
+    print("wrote %s (%d parameters)" % (path, len(PARAMS)))
+
+
+if __name__ == "__main__":
+    main()
