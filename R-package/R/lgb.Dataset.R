@@ -29,7 +29,7 @@ Dataset <- R6::R6Class(
           stop("lgb.Dataset: data must be coercible to a numeric ",
                "matrix or be a file path (got ", class(data)[1L], ")")
         })
-        if (!is.numeric(data)) {
+        if (!is.numeric(data) && !is.logical(data)) {
           stop("lgb.Dataset: data coerced to a non-numeric matrix; ",
                "encode factors/characters numerically first")
         }

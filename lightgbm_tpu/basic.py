@@ -591,11 +591,17 @@ class Booster:
         self.train_set = None
         self.name_valid_sets = []
         if self._gbdt is not None:
-            self._gbdt.train_set = None
-            self._gbdt.valid_sets = []
-            self._gbdt.valid_updaters = []
-            self._gbdt.valid_metrics = []
-            self._gbdt.valid_names = []
+            g = self._gbdt
+            g.train_set = None
+            g.valid_sets = []
+            g.valid_updaters = []
+            g.valid_metrics = []
+            g.valid_names = []
+            # the dominant allocations: the learner's binned/packed
+            # buffers and the (K, N) score state
+            g.learner = None
+            g.score_updater = None
+            g._fused_step = None
         return self
 
     def shuffle_models(self, start_iteration=0, end_iteration=-1) -> "Booster":

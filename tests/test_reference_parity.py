@@ -339,3 +339,115 @@ def test_lambdarank_query_file_parity(tmp_path):
     ref_on_ours = np.loadtxt(pred_file2)
     np.testing.assert_allclose(bst.predict(x, raw_score=True), ref_on_ours,
                                rtol=2e-5, atol=2e-5)
+
+
+def _write_csv(path, x, y):
+    np.savetxt(path, np.column_stack([y, x]), delimiter=",", fmt="%.8f")
+
+
+def _oracle_predict(workdir, model_path, data_path):
+    out = os.path.join(str(workdir), "op.txt")
+    _run_oracle(str(workdir), "task=predict", f"data={data_path}",
+                f"input_model={model_path}", f"output_result={out}",
+                "verbosity=-1")
+    return np.loadtxt(out)
+
+
+@needs_oracle
+def test_goss_model_interop(tmp_path):
+    """A GOSS-trained model saved here must load in the reference CLI and
+    predict identically (model text carries no trace of the sampler, but
+    the trees it produced must round-trip exactly)."""
+    r = np.random.RandomState(5)
+    x = r.randn(1200, 6)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + r.randn(1200) * 0.3 > 0)
+    bst = lgb.train({"objective": "binary", "boosting": "goss",
+                     "top_rate": 0.3, "other_rate": 0.2,
+                     "learning_rate": 0.3, "verbosity": -1},
+                    lgb.Dataset(x, y.astype(float)), num_boost_round=15)
+    model = tmp_path / "goss.txt"
+    bst.save_model(str(model))
+    data = tmp_path / "d.csv"
+    _write_csv(data, x, y.astype(float))
+    ref_pred = _oracle_predict(tmp_path, model, data)
+    np.testing.assert_allclose(bst.predict(x), ref_pred, rtol=1e-5,
+                               atol=1e-6)
+
+
+@needs_oracle
+def test_dart_model_interop(tmp_path):
+    """DART normalization must land in the saved leaf values such that
+    the reference reproduces our predictions exactly."""
+    r = np.random.RandomState(6)
+    x = r.randn(1000, 5)
+    y = x[:, 0] * 2 + np.sin(x[:, 1]) + r.randn(1000) * 0.1
+    bst = lgb.train({"objective": "regression", "boosting": "dart",
+                     "drop_rate": 0.3, "verbosity": -1,
+                     "learning_rate": 0.2},
+                    lgb.Dataset(x, y), num_boost_round=12)
+    model = tmp_path / "dart.txt"
+    bst.save_model(str(model))
+    data = tmp_path / "d.csv"
+    _write_csv(data, x, y)
+    ref_pred = _oracle_predict(tmp_path, model, data)
+    np.testing.assert_allclose(bst.predict(x), ref_pred, rtol=1e-5,
+                               atol=1e-6)
+
+
+@needs_oracle
+def test_weighted_training_parity(tmp_path):
+    """Row weights via the .weight side file: both implementations train
+    on the same weighted data; quality must match and our model must
+    round-trip through the reference."""
+    r = np.random.RandomState(7)
+    n = 1500
+    x = r.randn(n, 6)
+    y = (x[:, 0] - 0.8 * x[:, 1] + r.randn(n) * 0.4 > 0)
+    w = np.where(y > 0, 2.0, 1.0)  # upweight positives
+    data = tmp_path / "wtrain.csv"
+    _write_csv(data, x, y.astype(float))
+    np.savetxt(str(data) + ".weight", w, fmt="%.4f")
+    params = ("objective=binary", "num_trees=15", "num_leaves=15",
+              "learning_rate=0.2", "min_data_in_leaf=20", "verbosity=-1")
+    model_ref = tmp_path / "wref.txt"
+    _run_oracle(str(tmp_path), "task=train", f"data={data}",
+                *params, f"output_model={model_ref}")
+    ref_pred = _oracle_predict(tmp_path, model_ref, data)
+
+    ds = lgb.Dataset(str(data))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "learning_rate": 0.2, "min_data_in_leaf": 20,
+                     "verbosity": -1}, ds, num_boost_round=15)
+    ours = bst.predict(x)
+    auc_ref = _auc(y, ref_pred)
+    auc_ours = _auc(y, ours)
+    assert abs(auc_ref - auc_ours) < 0.02, (auc_ref, auc_ours)
+    # interop: reference predicts our weighted model identically
+    model = tmp_path / "wours.txt"
+    bst.save_model(str(model))
+    np.testing.assert_allclose(
+        ours, _oracle_predict(tmp_path, model, data), rtol=1e-5, atol=1e-6)
+
+
+@needs_oracle
+def test_monotone_constraints_model_interop(tmp_path):
+    """Monotone-constrained models round-trip; predictions obey the
+    constraint on a probe grid (reference basic mode semantics)."""
+    r = np.random.RandomState(8)
+    n = 1200
+    x = np.column_stack([r.rand(n), r.randn(n)])
+    y = 2.0 * x[:, 0] + 0.2 * np.sin(5 * x[:, 1]) + r.randn(n) * 0.05
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "monotone_constraints": [1, 0],
+                     "learning_rate": 0.2},
+                    lgb.Dataset(x, y), num_boost_round=15)
+    grid = np.column_stack([np.linspace(0.02, 0.98, 40), np.zeros(40)])
+    p = bst.predict(grid)
+    assert (np.diff(p) >= -1e-10).all()
+    model = tmp_path / "mono.txt"
+    bst.save_model(str(model))
+    data = tmp_path / "d.csv"
+    _write_csv(data, x, y)
+    np.testing.assert_allclose(
+        bst.predict(x), _oracle_predict(tmp_path, model, data),
+        rtol=1e-5, atol=1e-6)

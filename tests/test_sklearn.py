@@ -106,3 +106,61 @@ def test_custom_eval_metric():
     m.fit(x[:1500], y[:1500], eval_set=[(x[1500:], y[1500:])],
           eval_metric=brier, verbose=False)
     assert "brier" in m.evals_result_["valid_0"]
+
+
+def test_sample_weight_changes_model():
+    """sample_weight reaches the engine: upweighting one class shifts
+    predicted probabilities toward it (reference test_sklearn weight
+    coverage)."""
+    x, y = make_binary(800)
+    m0 = lgb.LGBMClassifier(n_estimators=10, verbosity=-1).fit(x, y)
+    w = np.where(y > 0, 5.0, 1.0)
+    m1 = lgb.LGBMClassifier(n_estimators=10, verbosity=-1).fit(
+        x, y, sample_weight=w)
+    p0 = m0.predict_proba(x)[:, 1].mean()
+    p1 = m1.predict_proba(x)[:, 1].mean()
+    assert p1 > p0 + 0.02, (p0, p1)
+
+
+def test_feature_importances_and_n_features():
+    x, y = make_binary(600)
+    m = lgb.LGBMClassifier(n_estimators=5, verbosity=-1).fit(x, y)
+    assert m.n_features_ == x.shape[1]
+    imp = m.feature_importances_
+    assert imp.shape == (x.shape[1],) and imp.sum() > 0
+    assert list(m.classes_) == [0.0, 1.0]
+
+
+def test_predict_with_best_iteration_after_early_stop():
+    """After early stopping, predict() defaults to best_iteration_
+    (reference sklearn predict num_iteration handling)."""
+    x, y = make_binary(2000)
+    xt, yt, xv, yv = x[:1400], y[:1400], x[1400:], y[1400:]
+    m = lgb.LGBMClassifier(n_estimators=80, learning_rate=0.3,
+                           verbosity=-1)
+    m.fit(xt, yt, eval_set=[(xv, yv)], early_stopping_rounds=5,
+          verbose=False)
+    assert m.best_iteration_ is not None and m.best_iteration_ > 0
+    full = m.booster_.predict(xv, num_iteration=m.best_iteration_)
+    np.testing.assert_allclose(m.predict_proba(xv)[:, 1], full, rtol=1e-9)
+
+
+def test_regressor_objective_aliases():
+    """Objective aliases resolve identically through the sklearn layer
+    (reference config alias handling)."""
+    x, y = make_regression(500)
+    p1 = lgb.LGBMRegressor(objective="l2", n_estimators=5,
+                           verbosity=-1).fit(x, y).predict(x)
+    p2 = lgb.LGBMRegressor(objective="mean_squared_error", n_estimators=5,
+                           verbosity=-1).fit(x, y).predict(x)
+    np.testing.assert_allclose(p1, p2, rtol=1e-9)
+
+
+def test_sklearn_clone_compatible():
+    """sklearn.base.clone round-trips estimator params (get_params/
+    set_params contract)."""
+    from sklearn.base import clone
+    m = lgb.LGBMClassifier(n_estimators=7, num_leaves=9, verbosity=-1)
+    m2 = clone(m)
+    assert m2.get_params()["n_estimators"] == 7
+    assert m2.get_params()["num_leaves"] == 9
