@@ -29,6 +29,75 @@ def _load_data_from_file(path: str):
     return parse_file(path)
 
 
+def _data_from_pandas(df, categorical_feature, pandas_categorical):
+    """Convert a DataFrame to a float64 matrix, mapping category-dtype
+    columns to their integer codes (reference: basic.py:312
+    _data_from_pandas). For a training frame the category lists are
+    captured; for valid/predict frames the stored lists re-align each
+    column's categories so codes agree with training.
+
+    Returns (matrix, feature_names, categorical_feature, pandas_categorical).
+    """
+    cat_cols = [c for c in df.columns if str(df[c].dtype) == "category"]
+    realign = pandas_categorical is not None
+    if not realign:                       # train frame: capture the lists
+        pandas_categorical = [list(df[c].cat.categories) for c in cat_cols]
+    elif cat_cols and len(cat_cols) != len(pandas_categorical):
+        raise ValueError(
+            "train and valid dataset categorical_feature do not match")
+    if categorical_feature == "auto":
+        # positions, not labels: a column labeled with an int must not be
+        # read as a feature index downstream
+        categorical_feature = [int(df.columns.get_loc(c)) for c in cat_cols]
+    feature_names = [str(c) for c in df.columns]
+    if cat_cols:
+        df = df.copy()
+        if realign:
+            for c, cats in zip(cat_cols, pandas_categorical):
+                df[c] = df[c].cat.set_categories(cats)
+        for c in cat_cols:
+            codes = df[c].cat.codes.values.astype(np.float64)
+            codes[codes == -1] = np.nan    # unseen/missing categories
+            df[c] = codes
+    x = df.astype(np.float64).values
+    return x, feature_names, categorical_feature, pandas_categorical
+
+
+_PANDAS_CAT_PREFIX = "\npandas_categorical:"
+
+
+def _json_default_with_numpy(obj):
+    """numpy scalars -> native JSON types; int categories must stay ints
+    or predict-time set_categories() matches nothing (reference:
+    basic.py json_default_with_numpy)."""
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    return str(obj)
+
+
+def _dump_pandas_categorical(pandas_categorical) -> str:
+    """Model-file trailer recording the category lists (reference:
+    basic.py:366)."""
+    import json
+    return _PANDAS_CAT_PREFIX + json.dumps(
+        pandas_categorical, default=_json_default_with_numpy) + "\n"
+
+
+def _split_pandas_categorical(model_str: str):
+    """(model text without trailer, pandas_categorical or None)."""
+    import json
+    i = model_str.rfind(_PANDAS_CAT_PREFIX)
+    if i < 0:
+        return model_str, None
+    line = model_str[i + len(_PANDAS_CAT_PREFIX):].strip()
+    try:
+        return model_str[:i] + "\n", json.loads(line)
+    except ValueError:
+        return model_str, None
+
+
 class Dataset:
     """Lazily-constructed training data (reference: basic.py:711)."""
 
@@ -48,6 +117,7 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._inner: Optional[_InnerDataset] = None
         self._label_from_file = None
+        self.pandas_categorical = None
 
     # ------------------------------------------------------------------
     def construct(self) -> "Dataset":
@@ -63,13 +133,30 @@ class Dataset:
                 label = y
             if self.group is None and qb is not None:
                 self.group = np.diff(qb)
-        if hasattr(data, "columns"):  # pandas
-            feature_names = [str(c) for c in data.columns]
+        cat_spec = self.categorical_feature
+        if hasattr(data, "columns"):  # pandas: category dtypes -> codes
+            ref_pc = None
+            if self.reference is not None:
+                # the template must be constructed first so its captured
+                # category lists align this frame's codes
+                self.reference.construct()
+                ref_pc = self.reference.pandas_categorical
+            data, feature_names, cat_spec, self.pandas_categorical = \
+                _data_from_pandas(data, cat_spec, ref_pc)
         if isinstance(self.feature_name, (list, tuple)):
             feature_names = list(self.feature_name)
         cats = None
-        if isinstance(self.categorical_feature, (list, tuple)):
-            cats = list(self.categorical_feature)
+        if isinstance(cat_spec, (list, tuple)):
+            # names -> column indices (pandas auto-detection yields names)
+            cats = []
+            for c in cat_spec:
+                if isinstance(c, str):
+                    if feature_names is None or c not in feature_names:
+                        raise LightGBMError(
+                            f"categorical_feature {c!r} not in features")
+                    cats.append(feature_names.index(c))
+                else:
+                    cats.append(int(c))
         cfg = Config(self.params)
         ref_inner = None
         if self.reference is not None:
@@ -328,19 +415,27 @@ class Booster:
         self._gbdt: Optional[GBDT] = None
         self._attr: Dict[str, str] = {}
 
+        self.pandas_categorical = None
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise TypeError("Training data should be Dataset instance")
             train_set._update_params(self.params)
             train_set.construct()
+            self.pandas_categorical = train_set.pandas_categorical
             cfg = train_set._inner.config
             cfg.update(self.params)
             self._gbdt = create_boosting(cfg, train_set._inner)
             self.train_set = train_set
         elif model_file is not None:
-            self._gbdt = GBDT.load_model(model_file, Config(self.params))
+            from .io.file_io import read_text
+            text, self.pandas_categorical = _split_pandas_categorical(
+                read_text(model_file))
+            self._gbdt = GBDT.load_model_from_string(
+                text, Config(self.params))
         elif model_str is not None:
-            self._gbdt = GBDT.load_model_from_string(model_str, Config(self.params))
+            text, self.pandas_categorical = _split_pandas_categorical(
+                model_str)
+            self._gbdt = GBDT.load_model_from_string(text, Config(self.params))
         else:
             raise TypeError("need at least one of train_set, model_file, model_str")
 
@@ -430,7 +525,12 @@ class Booster:
             x, _, _ = _load_data_from_file(data)
         else:
             x = data
-        if hasattr(x, "values"):
+        if hasattr(x, "columns"):
+            # DataFrame: align category columns to the training capture
+            # so codes agree (reference predict-time _data_from_pandas)
+            x, _, _, _ = _data_from_pandas(x, "auto",
+                                           self.pandas_categorical)
+        elif hasattr(x, "values"):
             x = x.values
         try:
             import scipy.sparse as sp
@@ -464,13 +564,26 @@ class Booster:
                    start_iteration=0) -> "Booster":
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
-        self._gbdt.save_model(filename, num_iteration, start_iteration)
+        if self.pandas_categorical:
+            # one write incl. the category-list trailer: append mode is
+            # not supported by all file_io schemes (object stores)
+            from .io.file_io import write_text
+            text = self._gbdt.save_model_to_string(start_iteration,
+                                                   num_iteration)
+            write_text(filename,
+                       text + _dump_pandas_categorical(
+                           self.pandas_categorical))
+        else:
+            self._gbdt.save_model(filename, num_iteration, start_iteration)
         return self
 
     def model_to_string(self, num_iteration=None, start_iteration=0) -> str:
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
-        return self._gbdt.save_model_to_string(start_iteration, num_iteration)
+        s = self._gbdt.save_model_to_string(start_iteration, num_iteration)
+        if self.pandas_categorical:
+            s += _dump_pandas_categorical(self.pandas_categorical)
+        return s
 
     def dump_model(self, num_iteration=None, start_iteration=0) -> dict:
         return self._gbdt.dump_model(num_iteration, start_iteration)
@@ -478,6 +591,8 @@ class Booster:
     def model_from_string(self, model_str: str, verbose=True) -> "Booster":
         """Replace this Booster's model with one loaded from a string
         (reference: basic.py:2241)."""
+        model_str, self.pandas_categorical = _split_pandas_categorical(
+            model_str)
         self._gbdt = GBDT.load_model_from_string(model_str,
                                                  Config(self.params))
         if verbose:

@@ -946,3 +946,70 @@ def test_predict_num_iteration_slices():
     base = full - (a + b)
     # the init score (boost_from_average) rides both slice predictions
     np.testing.assert_allclose(base, np.full_like(base, base[0]), atol=1e-5)
+
+
+def test_pandas_categorical_roundtrip(tmp_path):
+    """reference: test_engine.py test_pandas_categorical — category
+    dtype columns auto-map to categorical features, the category lists
+    ride the model file (pandas_categorical trailer), and prediction on
+    a frame with a DIFFERENT category order still aligns codes."""
+    pd = pytest.importorskip("pandas")
+    r = np.random.RandomState(21)
+    n = 1200
+    cats = ["red", "green", "blue", "black"]
+    c = r.choice(cats, n)
+    xnum = r.randn(n)
+    eff = {"red": 2.0, "green": -1.0, "blue": 0.5, "black": -2.0}
+    y = (np.vectorize(eff.get)(c) + xnum + r.randn(n) * 0.3 > 0).astype(float)
+    df = pd.DataFrame({"c": pd.Categorical(c, categories=cats),
+                       "x": xnum})
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(df, y), num_boost_round=8)
+    pred = bst.predict(df)
+    acc = np.mean((pred > 0.5) == (y > 0))
+    assert acc > 0.85, acc
+
+    # model file carries the category lists
+    path = str(tmp_path / "pcat.txt")
+    bst.save_model(path)
+    assert "pandas_categorical:" in open(path).read()
+    bst2 = lgb.Booster(model_file=path)
+    assert bst2.pandas_categorical == [cats]
+
+    # a frame whose categorical carries a DIFFERENT category order must
+    # re-align to the stored lists, not its own codes
+    df_shuffled = pd.DataFrame({
+        "c": pd.Categorical(c, categories=list(reversed(cats))),
+        "x": xnum})
+    np.testing.assert_allclose(bst2.predict(df_shuffled), pred, rtol=1e-6)
+
+    # unseen category at predict time -> missing (NaN), not a crash
+    df_unseen = df.head(10).copy()
+    df_unseen["c"] = pd.Categorical(["purple"] * 10,
+                                    categories=["purple"])
+    p_unseen = bst2.predict(df_unseen)
+    assert np.isfinite(p_unseen).all()
+
+
+def test_pandas_categorical_int_categories(tmp_path):
+    """Integer category values must survive the JSON trailer as ints:
+    after save/load, predict on the original frame is unchanged (string-
+    ified categories would re-align to nothing -> all-missing)."""
+    pd = pytest.importorskip("pandas")
+    r = np.random.RandomState(4)
+    n = 800
+    c = r.choice([10, 20, 30], n)
+    df = pd.DataFrame({7: pd.Categorical(c), 0: r.randn(n)})
+    y = ((c == 20) | (df[0].values > 1)).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(df, y), num_boost_round=6)
+    pred = bst.predict(df)
+    assert np.mean((pred > 0.5) == (y > 0)) > 0.9
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    assert bst2.pandas_categorical == [[10, 20, 30]]
+    np.testing.assert_allclose(bst2.predict(df), pred, rtol=1e-6)
+    # int-labeled columns: the auto-detected categorical is column 7 at
+    # POSITION 0 — importances must show the categorical, not column 0
+    assert bst.feature_importance("split")[0] > 0
