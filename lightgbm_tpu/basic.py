@@ -42,7 +42,9 @@ def _data_from_pandas(df, categorical_feature, pandas_categorical):
     realign = pandas_categorical is not None
     if not realign:                       # train frame: capture the lists
         pandas_categorical = [list(df[c].cat.categories) for c in cat_cols]
-    elif cat_cols and len(cat_cols) != len(pandas_categorical):
+    elif len(cat_cols) != len(pandas_categorical):
+        # also catches a frame whose categorical column LOST its dtype
+        # (raw values would silently be compared against learned codes)
         raise ValueError(
             "train and valid dataset categorical_feature do not match")
     if categorical_feature == "auto":
@@ -69,12 +71,18 @@ _PANDAS_CAT_PREFIX = "\npandas_categorical:"
 def _json_default_with_numpy(obj):
     """numpy scalars -> native JSON types; int categories must stay ints
     or predict-time set_categories() matches nothing (reference:
-    basic.py json_default_with_numpy)."""
+    basic.py json_default_with_numpy). Anything else fails loudly at
+    save time — a stringified category would silently match nothing on
+    reload."""
+    if isinstance(obj, np.bool_):
+        return bool(obj)
     if isinstance(obj, np.integer):
         return int(obj)
     if isinstance(obj, np.floating):
         return float(obj)
-    return str(obj)
+    raise TypeError(
+        f"pandas category values of type {type(obj).__name__} cannot be "
+        "recorded in the model file; use str/int/float categories")
 
 
 def _dump_pandas_categorical(pandas_categorical) -> str:
@@ -295,6 +303,8 @@ class Dataset:
         sub.reference = self
         sub.feature_name = self.feature_name
         sub.categorical_feature = self.categorical_feature
+        sub.pandas_categorical = self.pandas_categorical
+        sub._label_from_file = None
         inner = copy.copy(self._inner)
         inner.binned = self._inner.binned[used_indices]
         if getattr(self._inner, "bundled", None) is not None:
@@ -440,6 +450,35 @@ class Booster:
             raise TypeError("need at least one of train_set, model_file, model_str")
 
     # ------------------------------------------------------------------
+    # pickling / copying ride the model string (reference: basic.py
+    # Booster.__getstate__/__deepcopy__): the engine holds jitted device
+    # closures that cannot serialize; the reloaded booster predicts and
+    # continues via init_model, but drops the live training state.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_gbdt"] = None
+        state["train_set"] = None
+        state["_model_str"] = (self.model_to_string(num_iteration=-1)
+                               if self._gbdt is not None else None)
+        return state
+
+    def __setstate__(self, state):
+        model_str = state.pop("_model_str", None)
+        self.__dict__.update(state)
+        if model_str is not None:
+            text, pc = _split_pandas_categorical(model_str)
+            self._gbdt = GBDT.load_model_from_string(text,
+                                                     Config(self.params))
+            if pc is not None:
+                self.pandas_categorical = pc
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _):
+        return Booster(model_str=self.model_to_string(num_iteration=-1))
+
+    # ------------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         data.construct()
         self._gbdt.add_valid(data._inner, name)
@@ -568,11 +607,8 @@ class Booster:
             # one write incl. the category-list trailer: append mode is
             # not supported by all file_io schemes (object stores)
             from .io.file_io import write_text
-            text = self._gbdt.save_model_to_string(start_iteration,
-                                                   num_iteration)
             write_text(filename,
-                       text + _dump_pandas_categorical(
-                           self.pandas_categorical))
+                       self.model_to_string(num_iteration, start_iteration))
         else:
             self._gbdt.save_model(filename, num_iteration, start_iteration)
         return self

@@ -1013,3 +1013,83 @@ def test_pandas_categorical_int_categories(tmp_path):
     # int-labeled columns: the auto-detected categorical is column 7 at
     # POSITION 0 — importances must show the categorical, not column 0
     assert bst.feature_importance("split")[0] > 0
+
+
+def test_save_load_copy_pickle():
+    """reference: test_engine.py test_save_load_copy_pickle — pickle,
+    copy and deepcopy all preserve predictions (via the model string;
+    the live training engine is not serializable)."""
+    import copy
+    import pickle
+    x, y = make_binary(600)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(x, y), num_boost_round=4)
+    ref = bst.predict(x)
+    for clone in (pickle.loads(pickle.dumps(bst)), copy.copy(bst),
+                  copy.deepcopy(bst)):
+        np.testing.assert_allclose(clone.predict(x), ref, rtol=1e-9)
+        assert clone.num_trees() == bst.num_trees()
+
+
+def test_sklearn_model_pickles():
+    """Fitted sklearn wrappers must pickle (the most common deployment
+    path for sklearn users)."""
+    import pickle
+    x, y = make_binary(500)
+    m = lgb.LGBMClassifier(n_estimators=4, verbosity=-1).fit(x, y)
+    m2 = pickle.loads(pickle.dumps(m))
+    np.testing.assert_array_equal(m2.predict(x), m.predict(x))
+    np.testing.assert_allclose(m2.predict_proba(x), m.predict_proba(x),
+                               rtol=1e-9)
+
+
+def test_train_on_dataset_subset():
+    """reference: test_engine.py test_init_with_subset / test_sliced_data
+    — a row subset of a constructed Dataset trains with the parent's bin
+    mappers."""
+    x, y = make_binary(1000)
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    ds.construct()
+    idx = np.arange(0, 1000, 2)
+    sub = ds.subset(idx)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    sub, num_boost_round=5)
+    acc = np.mean((bst.predict(x) > 0.5) == (y > 0))
+    assert acc > 0.8, acc
+    assert sub.num_data() == 500
+    # subset rows carry their metadata slice
+    np.testing.assert_array_equal(sub.get_label(), y[idx])
+
+
+def test_max_bin_by_feature():
+    """reference: test_engine.py test_max_bin_by_feature — per-feature
+    bin caps land in the mappers and the model still trains."""
+    x, y = make_binary(800)
+    ds = lgb.Dataset(x, y, params={"max_bin_by_feature":
+                                   [4] + [255] * (x.shape[1] - 1)},
+                     free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=3)
+    nb = [len(m.bin_upper_bound) for m in ds._inner.bin_mappers]
+    assert nb[0] <= 4 and max(nb[1:]) > 4
+    assert bst.num_trees() == 3
+
+
+def test_cv_fpreproc():
+    """reference: test_engine.py test_fpreproc — the preprocessing hook
+    sees each fold's train/valid sets and can rewrite params."""
+    x, y = make_binary(600)
+    seen = []
+
+    def fpreproc(dtrain, dtest, params):
+        seen.append((dtrain.num_data(), dtest.num_data()))
+        params["learning_rate"] = 0.05
+        return dtrain, dtest, params
+
+    res = lgb.cv({"objective": "binary", "verbosity": -1},
+                 lgb.Dataset(x, y, free_raw_data=False),
+                 num_boost_round=3, nfold=3, fpreproc=fpreproc,
+                 verbose_eval=False)
+    assert len(seen) == 3
+    assert all(tr + te == 600 for tr, te in seen)
+    assert "binary_logloss-mean" in res
