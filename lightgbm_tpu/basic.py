@@ -317,14 +317,32 @@ class Dataset:
             md.label = src.label[used_indices]
         if src.weight is not None:
             md.weight = src.weight[used_indices]
+        n_src = self._inner.num_data
         if src.init_score is not None:
-            md.init_score = src.init_score[used_indices]
+            isc = np.asarray(src.init_score)
+            if isc.size == n_src:
+                md.init_score = isc[used_indices]
+            else:
+                # flat multiclass layout is class-major ((K, N) flattened,
+                # see ScoreUpdater): slice every class's block
+                k = isc.size // n_src
+                md.init_score = isc.reshape(k, n_src)[:, used_indices] \
+                    .reshape(-1)
+        group_sizes = None
+        if src.query_boundaries is not None:
+            # per-query row counts among the kept rows (group-aware cv
+            # folds keep whole queries; partial queries shrink)
+            qb = np.asarray(src.query_boundaries)
+            qidx = np.searchsorted(qb, used_indices, side="right") - 1
+            counts = np.bincount(qidx, minlength=len(qb) - 1)
+            group_sizes = counts[counts > 0]
+            md.set_group(group_sizes)
         inner.metadata = md
         inner._device_cache = {}
         sub._inner = inner
         sub.label = md.label
         sub.weight = md.weight
-        sub.group = None
+        sub.group = group_sizes
         sub.init_score = md.init_score
         return sub
 
@@ -466,11 +484,7 @@ class Booster:
         model_str = state.pop("_model_str", None)
         self.__dict__.update(state)
         if model_str is not None:
-            text, pc = _split_pandas_categorical(model_str)
-            self._gbdt = GBDT.load_model_from_string(text,
-                                                     Config(self.params))
-            if pc is not None:
-                self.pandas_categorical = pc
+            self.model_from_string(model_str, verbose=False)
 
     def __copy__(self):
         return self.__deepcopy__(None)

@@ -1093,3 +1093,90 @@ def test_cv_fpreproc():
     assert len(seen) == 3
     assert all(tr + te == 600 for tr, te in seen)
     assert "binary_logloss-mean" in res
+
+
+def test_continue_train_dart():
+    """reference: test_engine.py test_continue_train_dart — DART
+    continuation from an init_model keeps improving."""
+    x, y = make_regression(1200)
+    params = {"objective": "regression", "boosting": "dart",
+              "drop_rate": 0.2, "verbosity": -1, "metric": "l2"}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    b1 = lgb.train(dict(params), ds, num_boost_round=8)
+    b2 = lgb.train(dict(params), ds, num_boost_round=8,
+                   init_model=b1)
+    assert b2.current_iteration() == 16
+    mse1 = float(np.mean((b1.predict(x) - y) ** 2))
+    mse2 = float(np.mean((b2.predict(x) - y) ** 2))
+    assert mse2 < mse1 + 1e-9, (mse1, mse2)
+
+
+def test_continue_train_multiclass():
+    """reference: test_engine.py test_continue_train_multiclass — the
+    per-class tree layout survives continuation."""
+    x, y = make_multiclass(900, k=3)
+    params = {"objective": "multiclass", "num_class": 3,
+              "verbosity": -1}
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    b1 = lgb.train(dict(params), ds, num_boost_round=5)
+    b2 = lgb.train(dict(params), ds, num_boost_round=5, init_model=b1)
+    assert b2.num_trees() == 30       # (5+5) iterations x 3 classes
+    p = b2.predict(x)
+    assert p.shape == (900, 3)
+    acc1 = np.mean(np.argmax(b1.predict(x), axis=1) == y)
+    acc2 = np.mean(np.argmax(p, axis=1) == y)
+    assert acc2 >= acc1 - 1e-9
+
+
+def test_multiclass_prediction_early_stopping():
+    """reference: test_engine.py test_multiclass_prediction_early_stopping
+    — margin-based early stop changes nothing when the margin is huge
+    and stays close with a sane margin."""
+    x, y = make_multiclass(900, k=3)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "verbosity": -1}, lgb.Dataset(x, y),
+                    num_boost_round=10)
+    base = bst.predict(x)
+    p1 = bst.predict(x, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=1.5)
+    assert np.mean(np.argmax(p1, 1) == np.argmax(base, 1)) > 0.95
+    p2 = bst.predict(x, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=1e30)
+    np.testing.assert_allclose(p2, base, rtol=1e-6)
+
+
+def test_contribs_sum_to_raw_prediction():
+    """reference: test_engine.py test_contribs — TreeSHAP contributions
+    (+ expected value column) sum to the raw score for every row."""
+    x, y = make_binary(700)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(x, y), num_boost_round=6)
+    contrib = bst.predict(x[:200], pred_contrib=True)
+    assert contrib.shape == (200, x.shape[1] + 1)
+    np.testing.assert_allclose(contrib.sum(axis=1),
+                               bst.predict(x[:200], raw_score=True),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_subset_preserves_groups_and_multiclass_init_score():
+    """Subset keeps ranking query structure (whole-query folds) and
+    slices a flat class-major multiclass init_score per class block."""
+    x, y, group = make_ranking(30)
+    ds = lgb.Dataset(x, y, group=group, free_raw_data=False)
+    ds.construct()
+    # keep the first 10 whole queries (20 docs each)
+    sub = ds.subset(np.arange(10 * 20))
+    assert np.array_equal(sub.get_group(), np.full(10, 20))
+    bst = lgb.train({"objective": "lambdarank", "verbosity": -1,
+                     "metric": "ndcg", "eval_at": [3]}, sub,
+                    num_boost_round=3)
+    assert bst.num_trees() == 3
+
+    # multiclass flat init_score: class-major blocks slice per class
+    xm, ym = make_multiclass(300, k=3)
+    init = np.arange(900, dtype=np.float64)       # (3, 300) flattened
+    dsm = lgb.Dataset(xm, ym, init_score=init, free_raw_data=False)
+    dsm.construct()
+    subm = dsm.subset(np.arange(0, 300, 2))
+    got = np.asarray(subm.get_init_score()).reshape(3, 150)
+    np.testing.assert_array_equal(got, init.reshape(3, 300)[:, ::2])
