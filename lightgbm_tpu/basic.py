@@ -295,7 +295,9 @@ class Dataset:
     def subset(self, used_indices, params=None) -> "Dataset":
         """Row subset (reference basic.py Dataset.subset)."""
         self.construct()
-        used_indices = np.asarray(used_indices)
+        # row order is normalized like the reference (basic.py subset
+        # sorts); the group reconstruction below depends on it
+        used_indices = np.sort(np.asarray(used_indices))
         sub = Dataset.__new__(Dataset)
         sub.params = params or self.params
         sub.free_raw_data = True

@@ -260,13 +260,19 @@ class BinMapper:
 
     def _apply_forced_bounds(self, forced_bounds, max_bin):
         has_nan = len(self.bin_upper_bound) and math.isnan(self.bin_upper_bound[-1])
-        bounds = [b for b in self.bin_upper_bound if not math.isnan(b)]
-        for fb in forced_bounds:
-            if abs(fb) > ZERO_THRESHOLD and fb not in bounds:
-                bounds.append(float(fb))
-        bounds = sorted(set(bounds))[: max_bin - (1 if has_nan else 0)]
-        if math.inf not in bounds:
-            bounds.append(math.inf)
+        data_bounds = [b for b in self.bin_upper_bound
+                       if not math.isnan(b) and not math.isinf(b)]
+        forced = sorted({float(fb) for fb in forced_bounds
+                         if abs(fb) > ZERO_THRESHOLD and math.isfinite(fb)})
+        # reserve slots for the trailing inf bound (always re-appended)
+        # and the NaN bin, or the total can exceed max_bin; forced
+        # bounds take priority over data-found bounds under truncation
+        # (the reference inserts forced bounds first, bin.cpp forced path)
+        keep = max_bin - 1 - (1 if has_nan else 0)
+        forced = forced[:keep]
+        others = sorted(set(data_bounds) - set(forced))[:keep - len(forced)]
+        bounds = sorted(set(forced) | set(others))
+        bounds.append(math.inf)
         if has_nan:
             bounds.append(math.nan)
         self.bin_upper_bound = bounds

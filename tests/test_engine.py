@@ -1180,3 +1180,75 @@ def test_subset_preserves_groups_and_multiclass_init_score():
     subm = dsm.subset(np.arange(0, 300, 2))
     got = np.asarray(subm.get_init_score()).reshape(3, 150)
     np.testing.assert_array_equal(got, init.reshape(3, 300)[:, ::2])
+
+
+def test_reference_chain():
+    """reference: test_engine.py test_reference_chain — valid sets chained
+    off a train set (and off each other) share one binning and evaluate."""
+    x, y = make_binary(1500)
+    ds = lgb.Dataset(x[:900], y[:900], free_raw_data=False)
+    v1 = lgb.Dataset(x[900:1200], y[900:1200], reference=ds,
+                     free_raw_data=False)
+    v2 = lgb.Dataset(x[1200:], y[1200:], reference=v1,
+                     free_raw_data=False)
+    evals = {}
+    lgb.train({"objective": "binary", "metric": "binary_logloss",
+               "verbosity": -1}, ds, num_boost_round=4,
+              valid_sets=[v1, v2], valid_names=["a", "b"],
+              evals_result=evals, verbose_eval=False)
+    assert len(evals["a"]["binary_logloss"]) == 4
+    assert len(evals["b"]["binary_logloss"]) == 4
+    for m in (v1._inner.bin_mappers, v2._inner.bin_mappers):
+        for ma, mb in zip(ds._inner.bin_mappers, m):
+            assert ma.bin_upper_bound == mb.bin_upper_bound
+
+
+def test_node_level_subcol():
+    """reference: test_engine.py test_node_level_subcol —
+    feature_fraction_bynode changes the model but keeps quality; bynode
+    differs from tree-level sampling."""
+    x, y = make_binary(1200)
+    p = {"objective": "binary", "metric": "binary_logloss",
+         "verbosity": -1, "seed": 5}
+    base = lgb.train(dict(p), lgb.Dataset(x, y, free_raw_data=False),
+                     num_boost_round=8).predict(x)
+    bynode = lgb.train(dict(p, feature_fraction_bynode=0.5),
+                       lgb.Dataset(x, y, free_raw_data=False),
+                       num_boost_round=8).predict(x)
+    bytree = lgb.train(dict(p, feature_fraction=0.5),
+                       lgb.Dataset(x, y, free_raw_data=False),
+                       num_boost_round=8).predict(x)
+    assert not np.allclose(base, bynode)
+    assert not np.allclose(bynode, bytree)
+    for pred in (bynode, bytree):
+        assert np.mean((pred > 0.5) == (y > 0)) > 0.75
+
+
+def test_forced_bins_engine(tmp_path):
+    """reference: test_engine.py test_forced_bins — forced bin
+    boundaries from JSON land in the mappers and steer thresholds,
+    and survive max_bin truncation with priority over data bounds."""
+    import json
+    x, y = make_regression(800)
+    forced = [{"feature": 0, "bin_upper_bound": [-0.5, 0.0, 0.5]}]
+    fpath = str(tmp_path / "forced.json")
+    with open(fpath, "w") as fh:
+        json.dump(forced, fh)
+    ds = lgb.Dataset(x, y, params={"forcedbins_filename": fpath},
+                     free_raw_data=False)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "forcedbins_filename": fpath}, ds,
+                    num_boost_round=3)
+    ub = ds._inner.bin_mappers[0].bin_upper_bound
+    for b in (-0.5, 0.0, 0.5):
+        assert any(abs(u - b) < 1e-12 for u in ub), (b, ub[:8])
+    assert bst.num_trees() == 3
+    # forced bounds survive saturation: tiny max_bin still keeps them
+    ds2 = lgb.Dataset(x, y, params={"forcedbins_filename": fpath,
+                                    "max_bin": 8},
+                      free_raw_data=False)
+    ds2.construct()
+    ub2 = ds2._inner.bin_mappers[0].bin_upper_bound
+    assert len(ub2) <= 8
+    for b in (-0.5, 0.5):
+        assert any(abs(u - b) < 1e-12 for u in ub2), (b, ub2)
