@@ -8,6 +8,7 @@ source of truth, like the reference's helpers/parameter_generator.py flow).
 from __future__ import annotations
 
 import copy
+import operator as _operator
 from typing import Any, Dict, Iterable, Optional
 
 from .params_schema import PARAMS
@@ -140,6 +141,24 @@ def resolve_aliases(params: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+_CHECK_OPS = {">": _operator.gt, ">=": _operator.ge,
+              "<": _operator.lt, "<=": _operator.le}
+
+
+def _check_constraints(name: str, value, schema: dict) -> None:
+    """Enforce the schema's range constraints (the reference's CHECK
+    macros on Config members, include/LightGBM/config.h doc tags)."""
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return
+    for chk in schema.get("check", ()):
+        for op in (">=", "<=", ">", "<"):    # longest match first
+            if chk.startswith(op):
+                if not _CHECK_OPS[op](float(value), float(chk[len(op):])):
+                    log.fatal("Parameter %s=%s should be %s %s",
+                              name, value, op, chk[len(op):])
+                break
+
+
 class Config:
     """All training/IO/prediction parameters as attributes."""
 
@@ -153,10 +172,14 @@ class Config:
 
     def update(self, params: Dict[str, Any]) -> None:
         resolved = resolve_aliases(params)
-        self.raw.update(resolved)
         for name, value in resolved.items():
             schema = _SCHEMA[name]
-            setattr(self, name, _coerce(name, value, schema["type"]))
+            coerced = _coerce(name, value, schema["type"])
+            # validate BEFORE committing: a caught rejection must not
+            # leave an invalid value live on the config
+            _check_constraints(name, coerced, schema)
+            setattr(self, name, coerced)
+        self.raw.update(resolved)
         self._post_process(resolved)
 
     def _post_process(self, resolved: Dict[str, Any]) -> None:
@@ -179,10 +202,8 @@ class Config:
         """Parameter-conflict checks (reference: config.cpp:268 CheckParamConflict)."""
         if self.is_unbalance and self.scale_pos_weight != 1.0:
             log.fatal("Cannot set is_unbalance and scale_pos_weight at the same time")
-        if self.num_leaves > 131072:
-            log.fatal("num_leaves must be <= 131072")
-        if self.bagging_freq > 0 and not (0.0 < self.bagging_fraction <= 1.0):
-            log.fatal("bagging_fraction must be in (0, 1]")
+        # num_leaves / bagging_fraction ranges are owned by the schema
+        # constraint checks (_check_constraints)
         if self.boosting in ("rf", "random_forest"):
             self.boosting = "rf"
             if self.bagging_freq <= 0 or self.bagging_fraction >= 1.0 or self.bagging_fraction <= 0.0:

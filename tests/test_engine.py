@@ -1252,3 +1252,21 @@ def test_forced_bins_engine(tmp_path):
     assert len(ub2) <= 8
     for b in (-0.5, 0.5):
         assert any(abs(u - b) < 1e-12 for u in ub2), (b, ub2)
+
+
+def test_parameter_constraint_validation():
+    """Schema range constraints are enforced like the reference's CHECK
+    macros (config.h doc tags): clear errors, not downstream crashes."""
+    x, y = make_binary(200)
+    for bad in ({"num_leaves": 1}, {"learning_rate": -0.5},
+                {"bagging_fraction": 1.5}, {"feature_fraction": 0.0},
+                {"max_bin": 1}, {"min_data_in_leaf": -3}):
+        with pytest.raises(lgb.LightGBMError, match="Parameter"):
+            lgb.train({"objective": "binary", "verbosity": -1, **bad},
+                      lgb.Dataset(x, y), num_boost_round=1)
+    # boundary values the constraints permit still train
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 2, "bagging_fraction": 1.0,
+                     "feature_fraction": 1.0},
+                    lgb.Dataset(x, y), num_boost_round=1)
+    assert bst.num_trees() == 1
