@@ -728,8 +728,11 @@ class Booster:
         return self._gbdt.max_feature_idx + 1
 
     def reset_parameter(self, params) -> "Booster":
-        self.params.update(params)
+        # validate via the config FIRST (it rejects atomically); only then
+        # persist into self.params, so a caught rejection leaves neither
+        # object mutated
         self._gbdt.config.update(params)
+        self.params.update(params)
         self._gbdt.shrinkage_rate = self._gbdt.config.learning_rate
         # learning_rate rides the fused step as a traced argument; any other
         # param is baked in at trace time, so drop the cached programs

@@ -172,15 +172,29 @@ class Config:
 
     def update(self, params: Dict[str, Any]) -> None:
         resolved = resolve_aliases(params)
+        # two-phase + rollback: coerce/range-check everything first; if
+        # anything (including _post_process conflict checks) rejects, the
+        # config is restored exactly — no partially-applied params, no
+        # skipped post-processing.
+        coerced_all = []
         for name, value in resolved.items():
             schema = _SCHEMA[name]
             coerced = _coerce(name, value, schema["type"])
-            # validate BEFORE committing: a caught rejection must not
-            # leave an invalid value live on the config
             _check_constraints(name, coerced, schema)
-            setattr(self, name, coerced)
-        self.raw.update(resolved)
-        self._post_process(resolved)
+            coerced_all.append((name, coerced))
+        snapshot = {p["name"]: copy.copy(getattr(self, p["name"]))
+                    for p in PARAMS}
+        raw_snapshot = dict(self.raw)
+        try:
+            for name, coerced in coerced_all:
+                setattr(self, name, coerced)
+            self.raw.update(resolved)
+            self._post_process(resolved)
+        except Exception:
+            for name, old in snapshot.items():
+                setattr(self, name, old)
+            self.raw = raw_snapshot
+            raise
 
     def _post_process(self, resolved: Dict[str, Any]) -> None:
         self.objective = _OBJECTIVE_ALIASES.get(

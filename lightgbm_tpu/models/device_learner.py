@@ -52,7 +52,7 @@ from ..ops import split as split_ops
 from ..ops.partition import decide_left
 from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
 from ..utils import log
-from ..utils.envs import use_pallas_env
+from ..utils.envs import use_pallas_env, use_pallas_partition_env
 from .tree import Tree
 
 NEG_INF = split_ops.NEG_INF
@@ -393,8 +393,8 @@ def _unpack_codes(words: jax.Array, c_cols: int, item_bits: int) -> jax.Array:
     jax.jit,
     static_argnames=("c_cols", "item_bits",
                      "num_leaves", "num_bins", "col_bins", "max_depth",
-                     "bynode_k", "use_pallas", "pool_slots",
-                     "window_step", "cat_statics"))
+                     "bynode_k", "use_pallas", "use_pallas_part",
+                     "pool_slots", "window_step", "cat_statics"))
 def grow_tree_compact(
         codes_pack: jax.Array,       # (N, CW) u32: packed column codes
         codes_row: jax.Array,        # (N, C) u8/u16 for the root pass
@@ -407,6 +407,7 @@ def grow_tree_compact(
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
+        use_pallas_part: bool = False,
         pool_slots: int = 0, window_step: int = 4, cat_statics=None):
     return grow_tree_compact_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
@@ -417,7 +418,8 @@ def grow_tree_compact(
         l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
-        use_pallas=use_pallas, axis_name=None, pool_slots=pool_slots,
+        use_pallas=use_pallas, use_pallas_part=use_pallas_part,
+        axis_name=None, pool_slots=pool_slots,
         window_step=window_step, cat_statics=cat_statics)
 
 
@@ -432,6 +434,7 @@ def grow_tree_compact_core(
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
+        use_pallas_part: bool = False,
         axis_name=None, pool_slots: int = 0, scatter_cols: int = 0,
         feature_shards: int = 0, voting_k: int = 0, window_step: int = 4,
         cat_statics=None):
@@ -820,8 +823,14 @@ def grow_tree_compact_core(
             # Split): overrun rows past pcount get key 2, so the stable
             # sort returns them to their original slots untouched
             key3 = jnp.where(valid, jnp.where(go_left, 0, 1), 2)
-            order = jnp.argsort(key3.astype(jnp.int8), stable=True)
-            win_sorted = jnp.take(win, order, axis=0)
+            if use_pallas_part:
+                from ..ops.pallas.partition_kernel import stable_partition3
+                win_sorted = stable_partition3(
+                    win, key3,
+                    interpret=jax.default_backend() != "tpu")
+            else:
+                order = jnp.argsort(key3.astype(jnp.int8), stable=True)
+                win_sorted = jnp.take(win, order, axis=0)
             data = jax.lax.dynamic_update_slice(c.data, win_sorted,
                                                 (begin, 0))
             lphys = jnp.sum(go_left.astype(jnp.int32))
@@ -1219,6 +1228,9 @@ class DeviceTreeLearner:
         # build into the matmul pipeline better than Mosaic schedules it),
         # so the fused XLA path is the default even on TPU.
         self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
+        # partition kernel: opt-in on any backend (interpret mode off-TPU
+        # keeps CI coverage of the integrated path)
+        self._use_pallas_part = use_pallas_partition_env()
         self.strategy = resolve_strategy(config, dataset, strategy)
         self.window_step = max(2, int(_env("LGBM_TPU_WINDOW_STEP", "4")))
         # LRU-capped histogram pool: when the dense (L,C,B,3) pool would
@@ -1418,6 +1430,7 @@ class DeviceTreeLearner:
                 self.f_elide, self.hist_idx, key,
                 c_cols=self.c_cols, item_bits=self.item_bits,
                 pool_slots=self.pool_slots, window_step=self.window_step,
+                use_pallas_part=self._use_pallas_part,
                 **self._statics())
         return grow_tree(
             self.codes_t, grad, hess, w, base_mask,
@@ -1559,7 +1572,8 @@ class DeviceTreeLearner:
                     *meta, tree_key, c_cols=self.c_cols,
                     item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
-                    window_step=self.window_step, **statics)
+                    window_step=self.window_step,
+                    use_pallas_part=self._use_pallas_part, **statics)
                 leaf_o = route_rows_by_rec(
                     jnp.take(self.codes_pack, oob_idx, axis=0), rec, k,
                     self.f_numbins, self.f_missing, self.f_default,
@@ -1575,7 +1589,8 @@ class DeviceTreeLearner:
                     *meta, tree_key, c_cols=self.c_cols,
                     item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
-                    window_step=self.window_step, **statics)
+                    window_step=self.window_step,
+                    use_pallas_part=self._use_pallas_part, **statics)
             else:
                 rec, rec_cat, leaf_id, k, _ = grow(
                     self.codes_t, g, h, w, base_mask, *meta, tree_key,

@@ -532,7 +532,9 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
                     scatter_cols=self.scatter_cols,
-                    window_step=self.window_step, **self._statics())
+                    window_step=self.window_step,
+                    use_pallas_part=self._use_pallas_part,
+                    **self._statics())
 
     def _sharded_tree_fn(self, with_bag_key: bool, allow_bagging=True,
                          goss=None):
@@ -767,7 +769,9 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
                     feature_shards=self.shards,
-                    window_step=self.window_step, **self._statics())
+                    window_step=self.window_step,
+                    use_pallas_part=self._use_pallas_part,
+                    **self._statics())
 
     def _sharded_tree_fn(self):
         from ..models.device_learner import grow_tree_compact_core

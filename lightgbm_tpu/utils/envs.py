@@ -16,6 +16,13 @@ def use_pallas_env() -> bool:
     return flag("LGBM_TPU_PALLAS") or flag("LGBM_TPU_PALLAS_HIST")
 
 
+def use_pallas_partition_env() -> bool:
+    """Opt-in to the Pallas stable-partition kernel for the compact
+    growth loop's window split (replaces argsort+take, which is
+    gather-latency-bound on TPU)."""
+    return flag("LGBM_TPU_PALLAS_PART")
+
+
 def dp_reduce_mode_env() -> str:
     """LGBM_TPU_DP_REDUCE: 'scatter' (reference comm pattern, default) or
     'psum' (replicated histograms) for the data-parallel device learner."""

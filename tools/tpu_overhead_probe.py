@@ -1,0 +1,94 @@
+"""Measure the tunnel/runtime fixed costs that sit OUTSIDE the compiled
+tree program: per-dispatch round-trip latency, D2H/H2D bandwidth, and the
+L=2 grow program's exec wall vs its op-sum. Explains the ~160 ms fixed
+per-tree cost the scaling probe exposed (163 ms at L=2 where the op-sum
+is ~40 ms).
+
+Usage: python tools/tpu_overhead_probe.py [rows]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+print(f"backend={jax.default_backend()} N={N}", flush=True)
+
+
+def timeit(name, fn, reps=20):
+    fn()
+    t0 = time.time()
+    for _ in range(reps):
+        fn()
+    dt = (time.time() - t0) / reps * 1e3
+    print(f"{name:46s} {dt:9.3f} ms", flush=True)
+    return dt
+
+
+tiny = jnp.ones((8,), jnp.float32)
+f_tiny = jax.jit(lambda x: x + 1)
+
+# pure dispatch + tiny D2H sync: the floor every separate program call pays
+timeit("tiny jit call + 1-elem fetch", lambda: np.asarray(f_tiny(tiny)[:1]))
+
+# chained dispatches without host sync in between: does async dispatch
+# pipeline through the tunnel?
+def chain5():
+    x = tiny
+    for _ in range(5):
+        x = f_tiny(x)
+    return np.asarray(x[:1])
+timeit("5 chained tiny calls + 1 fetch", chain5)
+
+big = jnp.ones((N,), jnp.float32)
+f_big = jax.jit(lambda x: x * 2.0)
+timeit("O(N) elementwise + 1-elem fetch", lambda: np.asarray(f_big(big)[:1]))
+
+# D2H bandwidth: fetch 4 MB
+timeit("device_get 4MB (N f32)", lambda: np.asarray(jax.device_get(big)),
+       reps=5)
+
+# H2D bandwidth: put 4 MB
+host4 = np.ones(N, np.float32)
+timeit("device_put 4MB (N f32)",
+       lambda: jax.device_put(host4).block_until_ready(), reps=5)
+
+# the grow program at L=2: exec + small fetch, vs train() with replay
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.io.dataset import Dataset  # noqa: E402
+from lightgbm_tpu.models.device_learner import DeviceTreeLearner  # noqa: E402
+
+r = np.random.RandomState(17)
+F = 28
+x = r.randn(N, F).astype(np.float32)
+g = jnp.asarray((r.rand(N) - 0.5).astype(np.float32))
+h = jnp.asarray((0.1 + r.rand(N)).astype(np.float32))
+
+for leaves in (2, 31):
+    cfg = Config({"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+                  "min_data_in_leaf": 20, "verbosity": -1})
+    ds = Dataset(x, config=cfg,
+                 label=(np.asarray(g) > 0).astype(np.float64))
+    lrn = DeviceTreeLearner(cfg, ds, strategy="compact")
+    ones = jnp.ones(N, jnp.float32)
+    base_mask = jnp.asarray(lrn._feature_mask(np.random.RandomState(0)))
+    key = jax.random.PRNGKey(0)
+    t0 = time.time()
+    out = lrn._run_grow(g, h, ones, base_mask, key)
+    np.asarray(jax.device_get(out[3]))
+    print(f"L={leaves} grow compile+1st {time.time()-t0:.1f}s", flush=True)
+
+    def exec_only():
+        o = lrn._run_grow(g, h, ones, base_mask, key)
+        np.asarray(jax.device_get(o[3]))  # tiny scalar fetch only
+    timeit(f"L={leaves} grow exec + scalar fetch", exec_only, reps=5)
+
+    def full_train():
+        lrn.train(g, h)
+    timeit(f"L={leaves} lrn.train() incl replay fetch", full_train, reps=5)

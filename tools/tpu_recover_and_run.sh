@@ -1,0 +1,27 @@
+#!/bin/bash
+# Poll for TPU tunnel recovery, then run (strictly serialized):
+#   1. tools/tpu_overhead_probe.py  — explains the fixed per-tree cost
+#   2. tools/tpu_battery2.sh        — the bench battery (safe deadlines)
+#   3. tools/profile_iter.py        — fused-iteration phase decomposition
+# All interrupts are SIGINT (clean Python teardown) — never SIGTERM/KILL
+# mid-TPU-op, which is what wedged the tunnel twice.
+cd /root/repo
+ST=/tmp/tpu_status2.log
+RES=/tmp/tpu_bench_results2.log
+while true; do
+  if timeout 150 python -c "import jax; assert jax.default_backend()=='tpu'" \
+      2>/dev/null; then
+    echo "$(date +%H:%M:%S) TPU RECOVERED" >> $ST
+    break
+  fi
+  echo "$(date +%H:%M:%S) tpu down" >> $ST
+  sleep 120
+done
+echo "--- overhead probe $(date +%H:%M:%S) ---" >> $RES
+timeout -s INT -k 120 1200 python tools/tpu_overhead_probe.py >> $RES 2>&1
+echo "--- end overhead probe rc=$? ---" >> $RES
+bash tools/tpu_battery2.sh || { echo "battery aborted (tunnel down); skipping profile" >> $RES; exit 1; }
+echo "--- profile_iter 1M $(date +%H:%M:%S) ---" >> $RES
+timeout -s INT -k 120 1200 python tools/profile_iter.py 1000000 5 >> $RES 2>&1
+echo "--- end profile_iter rc=$? ---" >> $RES
+echo "=== recover-and-run done $(date +%H:%M:%S) ===" >> $RES
