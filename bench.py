@@ -70,6 +70,36 @@ def make_higgs_like(n, f, seed=17, w=None):
     return x, y, w
 
 
+def host_predict_raw(models, x):
+    """Vectorized numpy ensemble traversal (numerical splits, no NaN —
+    exactly this bench's data). Keeps ALL evaluation off the device: a
+    mid-training predict would otherwise compile a fresh ensemble
+    program per tree-count through the TPU tunnel, which round 3
+    observed blocking for >10 min and wedging the axon client."""
+    out = np.zeros(x.shape[0], dtype=np.float64)
+    for t in models:
+        assert not t.cat_boundaries_inner[-1], \
+            "host_predict_raw handles numerical splits only"
+        if t.num_leaves <= 1:
+            out += float(t.leaf_value[0])
+            continue
+        sf = np.asarray(t.split_feature, dtype=np.int32)
+        thr = np.asarray(t.threshold, dtype=np.float64)
+        lc = np.asarray(t.left_child, dtype=np.int32)
+        rc = np.asarray(t.right_child, dtype=np.int32)
+        lv = np.asarray(t.leaf_value, dtype=np.float64)
+        node = np.zeros(x.shape[0], dtype=np.int32)
+        active = np.ones(x.shape[0], dtype=bool)
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            v = x[idx, sf[nd]]
+            node[idx] = np.where(v <= thr[nd], lc[nd], rc[nd])
+            active[idx] = node[idx] >= 0
+        out += lv[~node]
+    return out
+
+
 def main():
     backend = _backend_ready()
     if not backend:
@@ -126,8 +156,12 @@ def main():
 
     booster = lgb.Booster(params=params, train_set=ds)
     t_warm = time.time()
-    for _ in range(WARMUP_ITERS):
+    for wi in range(WARMUP_ITERS):
         booster.update()
+        sys.stderr.write(
+            f"warmup iter {wi+1}/{WARMUP_ITERS} at "
+            f"{time.time()-t_warm:.1f}s\n")
+        sys.stderr.flush()
     warmup_secs = time.time() - t_warm
     sys.stderr.write(
         f"warmup ({WARMUP_ITERS} iters, incl. compile) {warmup_secs:.1f}s\n")
@@ -151,15 +185,23 @@ def main():
     # move the AUC), so it includes the first-jit compile cost.
     t_train = 0.0
     sec_to_auc = None
+    prog_every = 1 if N_ITERS <= 60 else max(1, N_ITERS // 50)
     for i in range(N_ITERS):
         t0 = time.time()
         booster.update()
         t_train += time.time() - t0
+        if (i + 1) % prog_every == 0:
+            # per-iter progress: a killed/deadlined run still leaves a
+            # readable partial-throughput trail in the battery log
+            sys.stderr.write(
+                f"iter {i+1}/{N_ITERS} train_wall={t_train:.1f}s\n")
+            sys.stderr.flush()
         # the final-model eval below is the last scheduled check, so skip
         # the mid-loop one on the last iteration (no duplicate predict)
         if (sec_to_auc is None and EVAL_EVERY and i + 1 < N_ITERS
                 and (i + 1) % EVAL_EVERY == 0):
-            mid_auc = rank_auc(booster.predict(xv, raw_score=True), yv)
+            mid_auc = rank_auc(host_predict_raw(booster._gbdt.models, xv),
+                               yv)
             if mid_auc >= AUC_TARGET:
                 sec_to_auc = round(warmup_secs + t_train, 3)
                 sys.stderr.write(
@@ -169,13 +211,13 @@ def main():
     iters_per_sec = N_ITERS / t_train if t_train > 0 else 0.0
     rowtrees_per_sec = N_ROWS * iters_per_sec
 
-    valid_auc = rank_auc(booster.predict(xv, raw_score=True), yv)
+    valid_auc = rank_auc(host_predict_raw(booster._gbdt.models, xv), yv)
     if sec_to_auc is None and valid_auc >= AUC_TARGET:
         sec_to_auc = round(warmup_secs + t_train, 3)
     sys.stderr.write(f"valid AUC ({len(yv)} held-out): {valid_auc:.4f}\n")
     # sanity: the model must actually learn
-    train_auc = rank_auc(booster.predict(x[:100_000], raw_score=True),
-                         y[:100_000])
+    train_auc = rank_auc(
+        host_predict_raw(booster._gbdt.models, x[:100_000]), y[:100_000])
     sys.stderr.write(f"train AUC (100k sample): {train_auc:.4f}\n")
     assert train_auc > 0.60, "model failed to learn"
 

@@ -29,10 +29,12 @@ mode); depth limits gate stored gains. Categorical splits run INSIDE the
 whole-tree program (one-hot and sorted k-vs-rest, the device analog of
 feature_histogram.hpp:118-279): each leaf's scan merges the numerical and
 categorical winners, the winning left-bin mask lives in a (L, B) store and
-is recorded per split for host replay into bitset tree nodes. Forced splits
-and CEGB fall back to the host-loop learner (create_tree_learner picks);
-the parallel device learners keep categorical gated (their supports() call
-passes categorical_ok=False).
+is recorded per split for host replay into bitset tree nodes. The sharded
+modes carry categoricals too: psum/voting scan replicated reduced
+histograms (masks replicate for free), and the sliced scatter/feature-
+parallel elections transport the winner's mask inside the candidate
+payload. Forced splits and CEGB fall back to the host-loop learner
+(create_tree_learner picks).
 """
 from __future__ import annotations
 
@@ -88,6 +90,37 @@ class _Carry(NamedTuple):
     rec: jax.Array           # (L-1, 13) f32
     rec_cat: jax.Array       # (L-1, B|1) f32
     key: jax.Array
+
+
+def _merge_num_cat(res: split_ops.SplitResult, cres) -> tuple:
+    """Merge the numerical and categorical split candidates of one leaf —
+    the in-program analog of SerialTreeLearner._merge_categorical: the
+    better gain wins. Returns (merged SplitResult, (B,) f32 left-bin mask)
+    where the mask is all-zero when the numerical candidate wins (the
+    store/transport convention shared by every growth mode)."""
+    cat_wins = cres.gain > res.gain
+    merged = split_ops.SplitResult(
+        gain=jnp.where(cat_wins, cres.gain, res.gain),
+        feature=jnp.where(cat_wins, cres.feature, res.feature),
+        threshold=jnp.where(cat_wins, 0, res.threshold),
+        default_left=jnp.where(cat_wins, False, res.default_left),
+        left_sum_grad=jnp.where(
+            cat_wins, cres.left_sum_grad, res.left_sum_grad),
+        left_sum_hess=jnp.where(
+            cat_wins, cres.left_sum_hess, res.left_sum_hess),
+        left_count=jnp.where(cat_wins, cres.left_count, res.left_count),
+        right_sum_grad=jnp.where(
+            cat_wins, cres.right_sum_grad, res.right_sum_grad),
+        right_sum_hess=jnp.where(
+            cat_wins, cres.right_sum_hess, res.right_sum_hess),
+        right_count=jnp.where(
+            cat_wins, cres.right_count, res.right_count),
+        left_output=jnp.where(
+            cat_wins, cres.left_output, res.left_output),
+        right_output=jnp.where(
+            cat_wins, cres.right_output, res.right_output))
+    cm = jnp.where(cat_wins, cres.left_mask.astype(jnp.float32), 0.0)
+    return merged, cm
 
 
 def _hist_t(codes_t, gh, num_bins, use_pallas):
@@ -158,29 +191,7 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
         cres = split_ops.materialize_cat_split(
             cfeat, crel, caux, hist, sg, sh, cnt, mn, mx,
             l1=l1, l2=l2, cat_l2=cat_l2, max_delta_step=max_delta_step)
-        cat_wins = cres.gain > res.gain
-        merged = split_ops.SplitResult(
-            gain=jnp.where(cat_wins, cres.gain, res.gain),
-            feature=jnp.where(cat_wins, cres.feature, res.feature),
-            threshold=jnp.where(cat_wins, 0, res.threshold),
-            default_left=jnp.where(cat_wins, False, res.default_left),
-            left_sum_grad=jnp.where(
-                cat_wins, cres.left_sum_grad, res.left_sum_grad),
-            left_sum_hess=jnp.where(
-                cat_wins, cres.left_sum_hess, res.left_sum_hess),
-            left_count=jnp.where(cat_wins, cres.left_count, res.left_count),
-            right_sum_grad=jnp.where(
-                cat_wins, cres.right_sum_grad, res.right_sum_grad),
-            right_sum_hess=jnp.where(
-                cat_wins, cres.right_sum_hess, res.right_sum_hess),
-            right_count=jnp.where(
-                cat_wins, cres.right_count, res.right_count),
-            left_output=jnp.where(
-                cat_wins, cres.left_output, res.left_output),
-            right_output=jnp.where(
-                cat_wins, cres.right_output, res.right_output))
-        cm = jnp.where(cat_wins, cres.left_mask.astype(jnp.float32), 0.0)
-        return merged, cm
+        return _merge_num_cat(res, cres)
 
     def _best_row(res: split_ops.SplitResult, child_depth) -> jax.Array:
         gain = res.gain
@@ -508,8 +519,6 @@ def grow_tree_compact_core(
         # features' histograms are reduced — O(2k*B) communication per
         # split instead of O(F*B). Deterministic and replicated on every
         # shard, so no best-split broadcast is needed.
-        assert not has_cat, \
-            "categorical splits are not wired into voting mode"
         f_all = int(f_numbins.shape[0])
         assert f_all == c_cols, \
             "voting mode requires identity feature->column mapping"
@@ -533,6 +542,24 @@ def grow_tree_compact_core(
             min_data_in_leaf=min_data_in_leaf,
             min_sum_hessian=min_sum_hessian,
             min_gain_to_split=min_gain_to_split)
+        if has_cat:
+            # categorical candidates ride the same vote/elect/reduce
+            # pipeline: local rel gains merge the categorical search
+            # (scaled gates, like the numerical local config) and the
+            # elected global scan re-runs both searches on the psum'd
+            # histograms. Every shard computes the identical elected
+            # scan, so the winning left-bin mask is replicated — no
+            # mask transport is needed in voting mode.
+            is_cat_v = f_categorical != 0
+            cat_l2_v, cat_smooth_v, max_cat_threshold_v, \
+                max_cat_to_onehot_v, min_data_per_group_v = cat_statics
+            cat_extra = dict(
+                cat_l2=cat_l2_v, cat_smooth=cat_smooth_v,
+                max_cat_threshold=max_cat_threshold_v,
+                max_cat_to_onehot=max_cat_to_onehot_v,
+                min_data_per_group=min_data_per_group_v)
+            cat_kwargs_local = dict(scan_kwargs_local, **cat_extra)
+            cat_kwargs_global = dict(scan_kwargs_global, **cat_extra)
 
         def _local_rel(col_hist_l, fmask):
             """Per-feature local best gains from the shard's histograms."""
@@ -541,8 +568,15 @@ def grow_tree_compact_core(
                 col_hist_l, lt, hist_idx, f_elide, f_default)
             rel, _, _, _ = split_ops.per_feature_best(
                 hist, lt[0], lt[1], lt[2], f_numbins, f_missing, f_default,
-                fmask, f_monotone, jnp.float32(-np.inf),
+                fmask & ~is_cat_v if has_cat else fmask, f_monotone,
+                jnp.float32(-np.inf),
                 jnp.float32(np.inf), f_penalty, None, **scan_kwargs_local)
+            if has_cat:
+                crel, _ = split_ops.per_feature_best_categorical(
+                    hist, lt[0], lt[1], lt[2], f_numbins, f_missing,
+                    fmask & is_cat_v, jnp.float32(-np.inf),
+                    jnp.float32(np.inf), f_penalty, **cat_kwargs_local)
+                rel = jnp.maximum(rel, crel)
             return rel                            # (F,)
 
         def _vote(rel):
@@ -567,19 +601,37 @@ def grow_tree_compact_core(
             hist_f = bundle_ops.expand_column_hist(
                 hist_e, jnp.stack([sg, sh, cnt]), hi_e,
                 jnp.take(f_elide, elect), jnp.take(f_default, elect))
+            fmask_e = jnp.take(fmask, elect)
+            if has_cat:
+                is_cat_e = jnp.take(is_cat_v, elect)
             rel, t, use_m1, prefix = split_ops.per_feature_best(
                 hist_f, sg, sh, cnt, nb_e, jnp.take(f_missing, elect),
-                jnp.take(f_default, elect), jnp.take(fmask, elect),
+                jnp.take(f_default, elect),
+                fmask_e & ~is_cat_e if has_cat else fmask_e,
                 jnp.take(f_monotone, elect), mn, mx,
                 jnp.take(f_penalty, elect), None, **scan_kwargs_global)
             fe = jnp.argmax(rel).astype(jnp.int32)
             res = split_ops.materialize_split(
                 fe, rel, t, use_m1, prefix, sg, sh, cnt, mn, mx,
                 l1=l1, l2=l2, max_delta_step=max_delta_step)
+            if has_cat:
+                crel, caux = split_ops.per_feature_best_categorical(
+                    hist_f, sg, sh, cnt, nb_e, jnp.take(f_missing, elect),
+                    fmask_e & is_cat_e, mn, mx,
+                    jnp.take(f_penalty, elect), **cat_kwargs_global)
+                cfe = jnp.argmax(crel).astype(jnp.int32)
+                cres = split_ops.materialize_cat_split(
+                    cfe, crel, caux, hist_f, sg, sh, cnt, mn, mx,
+                    l1=l1, l2=l2, cat_l2=cat_l2_v,
+                    max_delta_step=max_delta_step)
+                res, cm = _merge_num_cat(res, cres)
+            else:
+                cm = jnp.zeros((cat_b,), jnp.float32)
             row = best_row(res, child_depth)
             # map the elected-subset index back to the real feature id
+            sub_f = res.feature.astype(jnp.int32)
             return row.at[B_FEAT].set(
-                jnp.take(elect, fe).astype(jnp.float32))
+                jnp.take(elect, sub_f).astype(jnp.float32)), cm
 
         def reduce_hist(h):
             return h                               # stays local
@@ -590,9 +642,8 @@ def grow_tree_compact_core(
             votes = jax.lax.psum(_vote(rel), axis_name)
             elect = jnp.argsort(
                 -votes, stable=True)[:n_elect].astype(jnp.int32)
-            return (_elected_scan(col_hist, elect, sg, sh, cnt, mn, mx,
-                                  fmask, child_depth),
-                    jnp.zeros((cat_b,), jnp.float32))
+            return _elected_scan(col_hist, elect, sg, sh, cnt, mn, mx,
+                                 fmask, child_depth)
 
         # batched 2-child elected reduction: ONE (2, 2k, B, 3) psum per
         # split instead of two sequential ones — half the collective
@@ -613,18 +664,20 @@ def grow_tree_compact_core(
                 -votes2, axis=1,
                 stable=True)[:, :n_elect].astype(jnp.int32)
             if voting_batched:
-                rows2 = jax.vmap(
+                rows2, cm2 = jax.vmap(
                     _elected_scan,
                     in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None))(
                     col_hist2, elect2, sg2, sh2, cnt2, mn2, mx2, fmask2,
                     child_depth)
             else:
-                rows2 = jnp.stack([
+                pairs = [
                     _elected_scan(col_hist2[i], elect2[i], sg2[i], sh2[i],
                                   cnt2[i], mn2[i], mx2[i], fmask2[i],
                                   child_depth)
-                    for i in range(2)])
-            return rows2, jnp.zeros((2, cat_b), jnp.float32)
+                    for i in range(2)]
+                rows2 = jnp.stack([p[0] for p in pairs])
+                cm2 = jnp.stack([p[1] for p in pairs])
+            return rows2, cm2
     elif not sliced:
         (node_mask, scan, store_best, scan2, store_best2,
          best_row) = _tree_helpers(
@@ -650,8 +703,6 @@ def grow_tree_compact_core(
         # feature-sliced scan: every shard searches only the columns it
         # owns (after the reduce-scatter in scatter mode; built directly
         # in feature-parallel mode), then candidates are elected
-        assert not has_cat, \
-            "categorical splits are not wired into sliced modes"
         D = scatter_cols if scatter else feature_shards
         f_all = int(f_numbins.shape[0])
         assert f_all == c_cols, \
@@ -679,6 +730,7 @@ def grow_tree_compact_core(
         mono_sl = sl(pad1(f_monotone, 0))
         pen_sl = sl(pad1(f_penalty, 1.0))
         elide_sl = sl(pad1(f_elide, 0))
+        cat_sl = sl(pad1(f_categorical, 0)) if has_cat else None
         # local expansion gather for the slice's flattened (cs*B + 1)
         # column histogram (identity mapping: feature j bin b -> j*B + b)
         hi_local = (jnp.arange(cs, dtype=jnp.int32)[:, None] * col_bins
@@ -688,7 +740,8 @@ def grow_tree_compact_core(
             hi_local, cs * col_bins)
         (_, scan_sl, _, _, _, best_row) = _tree_helpers(
             mask_sl, nb_sl, miss_sl, def_sl, mono_sl, pen_sl, elide_sl,
-            hi_local, **helper_kwargs)
+            hi_local, f_categorical=cat_sl, cat_statics=cat_statics,
+            **helper_kwargs)
 
         if scatter:
             def reduce_hist(h):
@@ -699,27 +752,36 @@ def grow_tree_compact_core(
             def reduce_hist(h):
                 return h     # already the local slice over ALL rows
 
-        def _elect(row):
-            rows = jax.lax.all_gather(row, axis_name)        # (D, 12)
-            return rows[jnp.argmax(rows[:, B_GAIN])]
+        def _elect(row, cm):
+            # the candidate row carries its (B,) categorical left-bin
+            # mask through the election so every shard can route the
+            # partition on a categorical winner it does not own
+            # (SyncUpGlobalBestSplit's serialized cat_threshold role,
+            # split_info.hpp:22-193)
+            payload = jnp.concatenate([row, cm])     # (12 + cat_b,)
+            rows = jax.lax.all_gather(payload, axis_name)
+            win = rows[jnp.argmax(rows[:, B_GAIN])]
+            return win[:12], win[12:]
 
         def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
-            res, _ = scan_sl(col_hist, sg, sh, cnt, mn, mx, mask_sl)
+            res, cm = scan_sl(col_hist, sg, sh, cnt, mn, mx, mask_sl)
             row = best_row(res, child_depth)
             row = row.at[B_FEAT].add(start.astype(jnp.float32))
-            return _elect(row), jnp.zeros((cat_b,), jnp.float32)
+            return _elect(row, cm)
 
         def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
                          child_depth):
-            res2, _ = jax.vmap(scan_sl)(
+            res2, cm2 = jax.vmap(scan_sl)(
                 col_hist2, sg2, sh2, cnt2, mn2, mx2,
                 jnp.broadcast_to(mask_sl, (2,) + mask_sl.shape))
             rows = jax.vmap(
                 functools.partial(best_row, child_depth=child_depth))(res2)
             rows = rows.at[:, B_FEAT].add(start.astype(jnp.float32))
-            g = jax.lax.all_gather(rows, axis_name)          # (D, 2, 12)
+            payload = jnp.concatenate([rows, cm2], axis=1)   # (2, 12+cat_b)
+            g = jax.lax.all_gather(payload, axis_name)       # (D, 2, .)
             win = jnp.argmax(g[:, :, B_GAIN], axis=0)        # (2,)
-            return g[win, jnp.arange(2)], jnp.zeros((2, cat_b), jnp.float32)
+            sel = g[win, jnp.arange(2)]
+            return sel[:, :12], sel[:, 12:]
 
     hist_cols = cs if fp else c_cols   # width of branch-built histograms
     if fp:
@@ -1317,9 +1379,10 @@ class DeviceTreeLearner:
                  strategy: Optional[str] = None,
                  categorical_ok: bool = True) -> bool:
         """Static capability check; unsupported configs use the host-loop
-        learner (create_tree_learner falls back). categorical_ok=False is
-        the parallel device learners' gate — categorical scan/routing is
-        wired into the single-chip program only."""
+        learner (create_tree_learner falls back). categorical_ok=False
+        lets a caller opt out of device categorical handling (no in-tree
+        caller does since round 3 wired categoricals into every sharded
+        mode; kept for API stability)."""
         if not categorical_ok and any(
                 dataset.bin_mappers[fr].bin_type == BIN_CATEGORICAL
                 for fr in dataset.used_features):

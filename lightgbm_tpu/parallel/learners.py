@@ -489,12 +489,6 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                  mesh: Optional[Mesh] = None):
         super().__init__(config, dataset, strategy="compact",
                          device_place=False)
-        # create_tree_learner gates with categorical_ok=False; a direct
-        # construction must not silently drop the cat masks (the local()
-        # wrapper discards rec_cat)
-        assert not self._has_cat, \
-            "categorical features are not supported on the sharded " \
-            "device learners; use the host parallel learners"
         self.mesh = mesh or make_mesh(axis_name="data")
         self.shards = int(self.mesh.devices.size)
         # reduce-scatter mode needs the identity feature->column mapping
@@ -614,19 +608,24 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                     w_l = alive.astype(jnp.float32)
             else:
                 w_l = w_or_key * alive.astype(jnp.float32)
-            rec, _rec_cat, leaf_id, ks, tot = grow_tree_compact_core(
+            rec, rec_cat, leaf_id, ks, tot = grow_tree_compact_core(
                 cp_l, cr_l, g_l, h_l, w_l, base_mask, *meta, key,
                 axis_name="data", **statics)
-            # rec_cat is None here (categorical is gated off the parallel
-            # learners, see supports(categorical_ok=False))
-            return rec, leaf_id, ks, tot
+            # rec_cat (the categorical winners' left-bin masks) is
+            # replicated: psum mode scans identical reduced histograms
+            # everywhere, scatter mode transports the mask through the
+            # candidate election. Placeholder zeros keep the output
+            # pytree uniform when the dataset has no categoricals.
+            if rec_cat is None:
+                rec_cat = jnp.zeros((rec.shape[0], 1), jnp.float32)
+            return rec, rec_cat, leaf_id, ks, tot
 
         w_spec = P() if with_bag_key else P("data")
         return shard_map(
             local, mesh=self.mesh,
             in_specs=(P("data", None), P("data", None), P("data"),
                       P("data"), w_spec, P(), P()),
-            out_specs=(P(), P("data"), P(), P()), check_vma=False)
+            out_specs=(P(), P(), P("data"), P(), P()), check_vma=False)
 
     # ------------------------------------------------------------------
     def train(self, grad: jax.Array, hess: jax.Array,
@@ -646,8 +645,7 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
             self._bag_mask_host = wv[:n] > 0
         rng = np.random.RandomState(
             (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
-        base_mask = jnp.asarray(self._feature_mask(rng)
-                                & np.asarray(self.f_categorical == 0))
+        base_mask = jnp.asarray(self._feature_mask(rng))
         key = jax.random.PRNGKey(iter_seed)
         if self._tree_w_fn is None:
             fn = self._sharded_tree_fn(with_bag_key=False)
@@ -657,19 +655,23 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
             def run(cp, cr, g, h, w, mask, k):
                 g = jnp.pad(g, (0, npad - nn))
                 h = jnp.pad(h, (0, npad - nn))
-                rec, leaf_id, ks, tot = fn(cp, cr, g, h, w, mask, k)
-                return rec, leaf_id[:nn], ks, tot
+                rec, rec_cat, leaf_id, ks, tot = fn(cp, cr, g, h, w, mask, k)
+                return rec, rec_cat, leaf_id[:nn], ks, tot
             self._tree_w_fn = run
-        rec, leaf_id, n_splits, _ = self._tree_w_fn(
+        rec, rec_cat, leaf_id, n_splits, _ = self._tree_w_fn(
             self.codes_pack, self.codes_row, grad, hess, jnp.asarray(wv),
             base_mask, key)
         self.last_leaf_id = leaf_id
         self._leaf_id_host = None
-        rec_h, k = jax.device_get((rec, n_splits))
+        if self._has_cat:
+            rec_h, rec_cat_h, k = jax.device_get((rec, rec_cat, n_splits))
+        else:
+            rec_h, k = jax.device_get((rec, n_splits))
+            rec_cat_h = None
         k = int(k)
         if k == 0:
             log.warning("No further splits with positive gain")
-        return self.replay_tree(rec_h, k)
+        return self.replay_tree(rec_h, k, rec_cat_h)
 
     # ------------------------------------------------------------------
     def make_fused_step(self, objective, goss=None, bagging=True):
@@ -692,17 +694,21 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                                    allow_bagging=bagging,
                                    goss=goss_rates)
 
+        has_cat = self._has_cat
+
         @jax.jit
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
             g, h = objective.get_gradients(score_row)
             g = jnp.pad(g, (0, npad - n))
             h = jnp.pad(h, (0, npad - n))
-            rec, leaf_id_pad, k, _ = fn(self.codes_pack, self.codes_row,
-                                        g, h, bag_key, base_mask, tree_key)
+            rec, rec_cat, leaf_id_pad, k, _ = fn(
+                self.codes_pack, self.codes_row,
+                g, h, bag_key, base_mask, tree_key)
             leaf_id = leaf_id_pad[:n]
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
-            return score_row + delta, rec, None, leaf_id, k
+            return (score_row + delta, rec, rec_cat if has_cat else None,
+                    leaf_id, k)
 
         return step
 
@@ -744,9 +750,6 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                  mesh: Optional[Mesh] = None):
         super().__init__(config, dataset, strategy="compact",
                          device_place=False)
-        assert not self._has_cat, \
-            "categorical features are not supported on the sharded " \
-            "device learners; use the host parallel learners"
         self.mesh = mesh or make_mesh(axis_name="feature")
         self.shards = int(self.mesh.devices.size)
         cs = padded_shard_cols(self.c_cols, self.shards, self.item_bits)
@@ -779,21 +782,26 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         meta = self._meta
 
         def local(cp, cr, g, h, w, base_mask, key):
-            rec, _rec_cat, leaf_id, ks, tot = grow_tree_compact_core(
+            rec, rec_cat, leaf_id, ks, tot = grow_tree_compact_core(
                 cp, cr, g, h, w, base_mask, *meta, key,
                 axis_name="feature", **statics)
-            return rec, leaf_id, ks, tot
+            # replicated: the elected candidate row carries the winning
+            # categorical mask (see _elect in grow_tree_compact_core)
+            if rec_cat is None:
+                rec_cat = jnp.zeros((rec.shape[0], 1), jnp.float32)
+            return rec, rec_cat, leaf_id, ks, tot
 
         reps = (P(),) * 7
         return shard_map(local, mesh=self.mesh, in_specs=reps,
-                         out_specs=(P(), P(), P(), P()), check_vma=False)
+                         out_specs=(P(), P(), P(), P(), P()),
+                         check_vma=False)
 
     def _run_grow(self, grad, hess, w, base_mask, key):
         if self._tree_fn is None:
             self._tree_fn = jax.jit(self._sharded_tree_fn())
-        rec, leaf_id, k, tot = self._tree_fn(
+        rec, rec_cat, leaf_id, k, tot = self._tree_fn(
             self.codes_pack, self.codes_row, grad, hess, w, base_mask, key)
-        return rec, None, leaf_id, k, tot
+        return (rec, rec_cat if self._has_cat else None, leaf_id, k, tot)
 
     def make_fused_step(self, objective, goss=None, bagging=True):
         """Fused boosting iteration over the feature mesh: one sharded
@@ -812,6 +820,8 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         bag_k = max(1, int(n * cfg.bagging_fraction))
         fn = self._sharded_tree_fn()
 
+        has_cat = self._has_cat
+
         @jax.jit
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
             g, h = objective.get_gradients(score_row)
@@ -820,11 +830,13 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                 w = exact_k_bag_weights(bag_key, n, bag_k)
             else:
                 w = jnp.ones((n,), jnp.float32)
-            rec, leaf_id, k, _ = fn(self.codes_pack, self.codes_row,
-                                    g, h, w, base_mask, tree_key)
+            rec, rec_cat, leaf_id, k, _ = fn(self.codes_pack,
+                                             self.codes_row,
+                                             g, h, w, base_mask, tree_key)
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
-            return score_row + delta, rec, None, leaf_id, k
+            return (score_row + delta, rec, rec_cat if has_cat else None,
+                    leaf_id, k)
 
         return step
 
@@ -851,16 +863,14 @@ def create_tree_learner(config: Config, dataset: Dataset,
                 and dataset.bundle_arrays() is None
                 and not (0.0 < config.feature_fraction_bynode < 1.0)
                 and DeviceTreeLearner.supports(config, dataset,
-                                               strategy="compact",
-                                               categorical_ok=False)):
+                                               strategy="compact")):
             return DeviceFeatureParallelTreeLearner(config, dataset, mesh)
         return FeatureParallelTreeLearner(config, dataset, mesh)
     if name in ("data", "data_parallel"):
         # the DP device learner always runs the compact strategy; check
         # the learner that will actually be built
         if not host_only and DeviceTreeLearner.supports(
-                config, dataset, strategy="compact",
-                categorical_ok=False):
+                config, dataset, strategy="compact"):
             return DeviceDataParallelTreeLearner(config, dataset, mesh)
         return DataParallelTreeLearner(config, dataset, mesh)
     if name in ("voting", "voting_parallel"):
@@ -874,8 +884,7 @@ def create_tree_learner(config: Config, dataset: Dataset,
                 and dataset.num_features > 2 * max(1, int(config.top_k))
                 and n_shards > 1
                 and DeviceTreeLearner.supports(config, dataset,
-                                               strategy="compact",
-                                               categorical_ok=False)):
+                                               strategy="compact")):
             return DeviceVotingParallelTreeLearner(config, dataset, mesh)
         return VotingParallelTreeLearner(config, dataset, mesh)
     log.fatal("Unknown tree learner %s", name)

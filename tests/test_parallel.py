@@ -31,12 +31,13 @@ def _auc(y, s):
                  / (pos.sum() * (~pos).sum()))
 
 
-def _train(x, y, tree_learner, rounds=8, **extra):
+def _train(x, y, tree_learner, rounds=8, categorical_feature=None, **extra):
     params = {"objective": "binary", "tree_learner": tree_learner,
               "verbosity": -1, "num_leaves": 15, "min_data_in_leaf": 5}
     params.update(extra)
     cfg = Config(params)
-    ds = InnerDataset(x, config=cfg, label=y)
+    ds = InnerDataset(x, config=cfg, label=y,
+                      categorical_feature=categorical_feature)
     b = create_boosting(cfg, ds)
     for _ in range(rounds):
         b.train_one_iter()
@@ -228,3 +229,90 @@ def test_data_parallel_empty_shard_bagging():
     t = bd.models[0]
     assert t.num_leaves > 1
     assert int(t.internal_count[0]) <= 49
+
+
+# ---------------------------------------------------------------------------
+# Categorical splits on the sharded device learners (round 3): the sliced
+# elections transport the winning (B,) left-bin mask inside the candidate
+# payload; psum/voting modes scan replicated reduced histograms. All modes
+# must agree with the serial learner on categorical-heavy data, exactly as
+# the reference's SyncUpGlobalBestSplit serializes cat thresholds
+# (split_info.hpp:22-193).
+# ---------------------------------------------------------------------------
+
+def _cat_data(n=2000, seed=11):
+    """Mixed data: one-hot-mode cat, sorted-mode cat, six numericals (the
+    wide-ish feature count keeps the 8-shard column slices non-trivial)."""
+    r = np.random.RandomState(seed)
+    c_small = r.randint(0, 3, n)
+    c_big = r.randint(0, 25, n)
+    x_num = r.randn(n, 6)
+    logit = (np.where(c_small == 1, 1.1, -0.5) + 0.15 * (c_big % 6) - 0.4
+             + 0.7 * x_num[:, 0] - 0.5 * x_num[:, 1])
+    y = (logit + 0.9 * r.randn(n) > 0).astype(np.float64)
+    return np.column_stack([c_small, c_big, x_num]).astype(np.float64), y
+
+
+def _has_cat_split(b, n_trees):
+    return any(t._is_categorical(i)
+               for t in b.models[:n_trees]
+               for i in range(t.num_leaves - 1))
+
+
+def test_data_parallel_categorical_matches_serial():
+    from lightgbm_tpu.parallel.learners import DeviceDataParallelTreeLearner
+    x, y = _cat_data()
+    bs = _train(x, y, "serial", rounds=6, categorical_feature=[0, 1])
+    bd = _train(x, y, "data", rounds=6, categorical_feature=[0, 1])
+    assert isinstance(bd.learner, DeviceDataParallelTreeLearner)
+    # the reduce-scatter election (mask transport) must be active
+    assert bd.learner.scatter_cols == 8
+    assert _has_cat_split(bd, 6), "no categorical split exercised"
+    assert_trees_structurally_equal(bs, bd, 6, "dp-categorical")
+    np.testing.assert_allclose(bs.predict(x, raw_score=True),
+                               bd.predict(x, raw_score=True),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_data_parallel_categorical_scatter_matches_psum():
+    import os
+    x, y = _cat_data(1600, seed=5)
+    bd_scatter = _train(x, y, "data", rounds=6, categorical_feature=[0, 1])
+    os.environ["LGBM_TPU_DP_REDUCE"] = "psum"
+    try:
+        bd_psum = _train(x, y, "data", rounds=6, categorical_feature=[0, 1])
+    finally:
+        os.environ.pop("LGBM_TPU_DP_REDUCE", None)
+    assert bd_psum.learner.scatter_cols == 0
+    assert bd_scatter.learner.scatter_cols == 8
+    assert_trees_structurally_equal(bd_psum, bd_scatter, 6,
+                                    "cat-scatter-vs-psum")
+    np.testing.assert_allclose(bd_psum.predict(x, raw_score=True),
+                               bd_scatter.predict(x, raw_score=True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_feature_parallel_categorical_matches_serial():
+    from lightgbm_tpu.parallel.learners import (
+        DeviceFeatureParallelTreeLearner)
+    x, y = _cat_data()
+    bs = _train(x, y, "serial", rounds=6, categorical_feature=[0, 1])
+    bf = _train(x, y, "feature", rounds=6, categorical_feature=[0, 1])
+    assert isinstance(bf.learner, DeviceFeatureParallelTreeLearner)
+    assert _has_cat_split(bf, 6), "no categorical split exercised"
+    assert_trees_structurally_equal(bs, bf, 6, "fp-categorical")
+    np.testing.assert_allclose(bs.predict(x, raw_score=True),
+                               bf.predict(x, raw_score=True),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_voting_categorical_quality():
+    from lightgbm_tpu.parallel.learners import (
+        DeviceVotingParallelTreeLearner)
+    x, y = _cat_data(2400, seed=29)
+    bv = _train(x, y, "voting", rounds=12, top_k=3,
+                categorical_feature=[0, 1])
+    assert isinstance(bv.learner, DeviceVotingParallelTreeLearner)
+    assert _has_cat_split(bv, 12), "no categorical split exercised"
+    auc = _auc(y, bv.predict(x, raw_score=True))
+    assert auc > 0.85

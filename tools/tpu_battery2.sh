@@ -1,15 +1,22 @@
 #!/bin/bash
 # Round-3 revised bench battery.
 #
-# Lessons encoded from the two tunnel wedges (round 2, round 3 first run):
+# Lessons encoded from the tunnel wedges (round 2, round 3 runs 1-2):
 #  * NEVER SIGTERM/SIGKILL a process mid-TPU-op: every step gets an
 #    INTERNAL deadline (bench.py's BENCH_DEADLINE -> SIGALRM -> clean
 #    Python exception -> axon client shuts down orderly). The outer
 #    `timeout -k` is a last resort at ~2x the internal deadline.
 #  * Probe the backend between steps; if the tunnel died mid-battery,
 #    stop immediately instead of burning hours in CPU fallback.
-#  * Highest-value runs first: headline 1M, then the A/B levers, then
-#    reference scale.
+#  * This box has ONE cpu core: the axon client's host loop starves (and
+#    the tunnel can wedge) if anything heavy runs beside it. The battery
+#    must own the core; run 3's stall began the minute a full pytest
+#    run started beside the bench.
+#  * bench.py evaluates AUC with a numpy traversal (host_predict_raw) —
+#    a device predict would compile a fresh ensemble program per
+#    tree-count through the tunnel (observed blocking >10 min).
+#  * Small first step (10 iters) for fast signal; bench.py emits
+#    per-iter progress lines so even a deadlined run leaves data.
 cd /root/repo
 RES=/tmp/tpu_bench_results2.log
 probe() {
@@ -29,17 +36,18 @@ step() {  # step <name> <internal_deadline_s> <env...>
 }
 
 echo "=== battery2 start $(date +%H:%M:%S) ===" >> $RES
-step "bench 1M default"  900 BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WARMUP=3
+step "bench 1M default"  900 BENCH_ROWS=1000000 BENCH_ITERS=10 \
+  BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 step "bench 1M pallas-part" 900 LGBM_TPU_PALLAS_PART=1 BENCH_ROWS=1000000 \
-  BENCH_ITERS=20 BENCH_WARMUP=3
+  BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 step "bench 1M window step 2" 1200 LGBM_TPU_WINDOW_STEP=2 \
-  BENCH_ROWS=1000000 BENCH_ITERS=20 BENCH_WARMUP=3
+  BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 step "bench 1M pallas hist" 900 LGBM_TPU_PALLAS=1 BENCH_ROWS=1000000 \
-  BENCH_ITERS=20 BENCH_WARMUP=3
-step "bench 10.5M ref scale" 2400 BENCH_ROWS=10500000 BENCH_ITERS=20 \
-  BENCH_WARMUP=3
+  BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+step "bench 10.5M ref scale" 2400 BENCH_ROWS=10500000 BENCH_ITERS=10 \
+  BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 step "bench 1M masked" 900 LGBM_TPU_STRATEGY=masked BENCH_ROWS=1000000 \
-  BENCH_ITERS=10 BENCH_WARMUP=2
+  BENCH_ITERS=10 BENCH_WARMUP=2 BENCH_EVAL_EVERY=0
 step "bench 1M time-to-auc" 1800 BENCH_ROWS=1000000 BENCH_ITERS=150 \
   BENCH_WARMUP=3 BENCH_AUC_TARGET=0.78 BENCH_EVAL_EVERY=10
 echo "=== battery2 done $(date +%H:%M:%S) ===" >> $RES
