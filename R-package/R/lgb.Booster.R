@@ -36,6 +36,20 @@ Booster <- R6::R6Class(
       .Call(LGBMTPU_BoosterUpdateOneIter_R, self$handle)
     },
 
+    # custom-objective boosting step: caller supplies the gradient and
+    # hessian of its loss at the current scores (reference
+    # lgb.Booster.R update(fobj=...) -> LGBM_BoosterUpdateOneIterCustom)
+    update_custom = function(grad, hess) {
+      .Call(LGBMTPU_BoosterUpdateOneIterCustom_R, self$handle,
+            as.double(grad), as.double(hess))
+    },
+
+    # current raw scores of the idx-th dataset (0 = train, 1.. = valids
+    # in add_valid order) — what a custom objective/eval consumes
+    get_predict = function(data_idx = 0L) {
+      .Call(LGBMTPU_BoosterGetPredict_R, self$handle, as.integer(data_idx))
+    },
+
     rollback_one_iter = function() {
       .Call(LGBMTPU_BoosterRollbackOneIter_R, self$handle)
       invisible(self)
@@ -43,6 +57,10 @@ Booster <- R6::R6Class(
 
     current_iter = function() {
       .Call(LGBMTPU_BoosterGetCurrentIteration_R, self$handle)
+    },
+
+    num_classes = function() {
+      .Call(LGBMTPU_BoosterGetNumClasses_R, self$handle)
     },
 
     eval = function(data_idx = 0L) {
@@ -114,11 +132,32 @@ lgb.save <- function(booster, filename, num_iteration = -1L) {
 }
 
 #' Split/gain feature importance
+#'
+#' Returns the reference's ranked importance table shape (Feature, Gain,
+#' Frequency; rows with zero splits dropped, ordered by Gain). Cover is
+#' not tracked by this implementation and is omitted. `percentage`
+#' normalizes each measure to sum to 1 like the upstream default.
 #' @export
 lgb.importance <- function(booster, num_iteration = -1L,
-                           importance_type = c("split", "gain")) {
-  importance_type <- match.arg(importance_type)
-  itype <- if (importance_type == "split") 0L else 1L
-  .Call(LGBMTPU_BoosterFeatureImportance_R, booster$handle,
-        as.integer(num_iteration), itype)
+                           percentage = TRUE) {
+  gain <- .Call(LGBMTPU_BoosterFeatureImportance_R, booster$handle,
+                as.integer(num_iteration), 1L)
+  freq <- .Call(LGBMTPU_BoosterFeatureImportance_R, booster$handle,
+                as.integer(num_iteration), 0L)
+  df <- data.frame(
+    Feature = paste0("Column_", seq_along(gain) - 1L),
+    Gain = as.numeric(gain),
+    Frequency = as.numeric(freq),
+    stringsAsFactors = FALSE
+  )
+  df <- df[df$Frequency > 0, , drop = FALSE]
+  if (percentage && nrow(df) > 0L) {
+    if (sum(df$Gain) > 0) df$Gain <- df$Gain / sum(df$Gain)
+    if (sum(df$Frequency) > 0) {
+      df$Frequency <- df$Frequency / sum(df$Frequency)
+    }
+  }
+  df <- df[order(-df$Gain), , drop = FALSE]
+  rownames(df) <- NULL
+  df
 }

@@ -14,12 +14,37 @@
 #' @param init_model path to a saved model, or an lgb.Booster, to continue
 #'   training from (reference lgb.train init_model)
 #' @param verbose verbosity
+#' @param obj custom objective: function(preds, dtrain) returning
+#'   list(grad = ..., hess = ...) evaluated at the current raw scores;
+#'   the booster then runs objective = "none" and boosts the supplied
+#'   gradients (reference lgb.train obj-as-function ->
+#'   LGBM_BoosterUpdateOneIterCustom). For multiclass boosters, preds
+#'   arrive class-major ([all rows class 0, all rows class 1, ...], the
+#'   reference's internal score layout) and grad/hess must be returned
+#'   in the same layout.
+#' @param feval custom eval: function(preds, dtrain) returning
+#'   list(name = ..., value = ..., higher_better = ...); recorded into
+#'   record_evals next to (or instead of) built-in metrics
 #' @export
 lgb.train <- function(params = list(), data, nrounds = 100L,
                       valids = list(), early_stopping_rounds = NULL,
-                      init_model = NULL, verbose = 1L) {
+                      init_model = NULL, verbose = 1L,
+                      obj = NULL, feval = NULL) {
   if (!is.list(params)) {
     stop("lgb.train: params must be a named list")
+  }
+  if (is.function(params$objective)) {
+    obj <- params$objective
+    params$objective <- NULL
+  }
+  if (!is.null(obj)) {
+    if (!is.function(obj)) {
+      stop("lgb.train: obj must be a function(preds, dtrain)")
+    }
+    params$objective <- "none"
+  }
+  if (!is.null(feval) && !is.function(feval)) {
+    stop("lgb.train: feval must be a function(preds, dtrain)")
   }
   if (!inherits(data, "lgb.Dataset")) {
     stop("lgb.train: data must be an lgb.Dataset")
@@ -66,8 +91,29 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   eval_sign <- 1
   start_iter <- booster$current_iter()
   stopped <- FALSE
+  nclass <- booster$num_classes()
+  # raw scores cross the ABI row-major ((N, K) flattened by row); the
+  # custom-gradient side of the ABI expects class-major
+  # ([all rows class 0, all rows class 1, ...], the reference's internal
+  # score layout) — hand preds to obj/feval class-major so the grad/hess
+  # the user computes from them line up element-for-element
+  .scores <- function(data_idx) {
+    v <- booster$get_predict(data_idx)
+    if (nclass > 1L) {
+      v <- as.vector(matrix(v, ncol = nclass, byrow = TRUE))
+    }
+    v
+  }
   for (i in seq_len(nrounds)) {
-    finished <- booster$update()
+    if (is.null(obj)) {
+      finished <- booster$update()
+    } else {
+      gh <- obj(.scores(0L), data)
+      if (!is.list(gh) || is.null(gh$grad) || is.null(gh$hess)) {
+        stop("lgb.train: obj must return list(grad = ..., hess = ...)")
+      }
+      finished <- booster$update_custom(gh$grad, gh$hess)
+    }
     if (length(valids) > 0) {
       if (length(metric_names) == 0) {
         metric_names <- tryCatch(booster$eval_names(),
@@ -77,25 +123,50 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
         if (length(hb) > 0 && isTRUE(hb[[1]])) eval_sign <- -1
       }
       for (vi in seq_along(valids)) {
-        ev <- booster$eval(vi)
-        if (length(ev) == 0) next
         vname <- vnames[[vi]]
-        for (mi in seq_along(ev)) {
-          mname <- if (mi <= length(metric_names)) {
-            metric_names[[mi]]
-          } else {
-            paste0("metric_", mi)
+        ev <- booster$eval(vi)
+        stop_val <- NULL            # signed so smaller-is-better
+        if (length(ev) > 0) {
+          for (mi in seq_along(ev)) {
+            mname <- if (mi <= length(metric_names)) {
+              metric_names[[mi]]
+            } else {
+              paste0("metric_", mi)
+            }
+            booster$record_evals[[vname]][[mname]]$eval <-
+              c(booster$record_evals[[vname]][[mname]]$eval, ev[[mi]])
           }
-          booster$record_evals[[vname]][[mname]]$eval <-
-            c(booster$record_evals[[vname]][[mname]]$eval, ev[[mi]])
+          if (verbose > 0) {
+            message(sprintf("[%d] %s: %s", i, vname,
+                            paste(signif(ev, 6), collapse = ", ")))
+          }
+          stop_val <- eval_sign * ev[[1]]
         }
-        if (verbose > 0) {
-          message(sprintf("[%d] %s: %s", i, vname,
-                          paste(signif(ev, 6), collapse = ", ")))
+        if (!is.null(feval)) {
+          fe <- feval(.scores(vi), valids[[vi]])
+          if (!is.list(fe) || is.null(fe$name) || is.null(fe$value)) {
+            stop("lgb.train: feval must return ",
+                 "list(name = ..., value = ..., higher_better = ...)")
+          }
+          # a feval named like a built-in metric must not interleave
+          # into that metric's history
+          fname <- if (fe$name %in% metric_names) {
+            paste0(fe$name, "_custom")
+          } else {
+            fe$name
+          }
+          booster$record_evals[[vname]][[fname]]$eval <-
+            c(booster$record_evals[[vname]][[fname]]$eval, fe$value)
+          if (is.null(stop_val)) {
+            # no built-in metric (e.g. custom objective): the feval
+            # drives early stopping, honoring its direction
+            stop_val <- if (isTRUE(fe$higher_better)) -fe$value else fe$value
+          }
         }
-        if (vi == 1L && !is.null(early_stopping_rounds)) {
-          if (eval_sign * ev[[1]] < best_score) {
-            best_score <- eval_sign * ev[[1]]
+        if (vi == 1L && !is.null(early_stopping_rounds)
+            && !is.null(stop_val)) {
+          if (stop_val < best_score) {
+            best_score <- stop_val
             best_iter <- i
           } else if (i - best_iter >= early_stopping_rounds) {
             # absolute iteration: init_model trees count (start_iter),

@@ -46,6 +46,12 @@ int LGBM_DatasetGetSubset(const DatasetHandle handle,
                           const char* parameters, DatasetHandle* out);
 int LGBM_BoosterAddValidData(BoosterHandle handle, DatasetHandle valid_data);
 int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished);
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result);
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
 int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
@@ -248,6 +254,55 @@ SEXP LGBMTPU_BoosterUpdateOneIter_R(SEXP handle) {
   return Rf_ScalarLogical(finished);
 }
 
+SEXP LGBMTPU_BoosterGetNumClasses_R(SEXP handle) {
+  int out = 0;
+  CheckCall(LGBM_BoosterGetNumClasses(R_ExternalPtrAddr(handle), &out),
+            "BoosterGetNumClasses");
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBMTPU_BoosterUpdateOneIterCustom_R(SEXP handle, SEXP grad, SEXP hess) {
+  int n = Rf_length(grad);
+  if (Rf_length(hess) != n) {
+    Rf_error("grad and hess must have the same length");
+  }
+  // the C API reads exactly num_data * num_class floats (its train-set
+  // score length); a shorter R vector would be read past its end
+  int64_t want = 0;
+  CheckCall(LGBM_BoosterGetNumPredict(R_ExternalPtrAddr(handle), 0, &want),
+            "BoosterGetNumPredict");
+  if ((int64_t)n != want) {
+    Rf_error("grad/hess length %d != num_data * num_class (%lld)", n,
+             (long long)want);
+  }
+  std::vector<float> g(n), h(n);
+  double* gs = REAL(grad);
+  double* hs = REAL(hess);
+  for (int i = 0; i < n; ++i) {
+    g[i] = (float)gs[i];
+    h[i] = (float)hs[i];
+  }
+  int finished = 0;
+  CheckCall(LGBM_BoosterUpdateOneIterCustom(R_ExternalPtrAddr(handle),
+                                            g.data(), h.data(), &finished),
+            "BoosterUpdateOneIterCustom");
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP LGBMTPU_BoosterGetPredict_R(SEXP handle, SEXP data_idx) {
+  int64_t len = 0;
+  CheckCall(LGBM_BoosterGetNumPredict(R_ExternalPtrAddr(handle),
+                                      Rf_asInteger(data_idx), &len),
+            "BoosterGetNumPredict");
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)len));
+  int64_t got = 0;
+  CheckCall(LGBM_BoosterGetPredict(R_ExternalPtrAddr(handle),
+                                   Rf_asInteger(data_idx), &got, REAL(out)),
+            "BoosterGetPredict");
+  UNPROTECT(1);
+  return out;
+}
+
 SEXP LGBMTPU_BoosterRollbackOneIter_R(SEXP handle) {
   CheckCall(LGBM_BoosterRollbackOneIter(R_ExternalPtrAddr(handle)),
             "BoosterRollbackOneIter");
@@ -418,6 +473,9 @@ static const R_CallMethodDef CallEntries[] = {
     {"LGBMTPU_BoosterAddValidData_R", (DL_FUNC)&LGBMTPU_BoosterAddValidData_R, 2},
     {"LGBMTPU_BoosterMerge_R", (DL_FUNC)&LGBMTPU_BoosterMerge_R, 2},
     {"LGBMTPU_BoosterUpdateOneIter_R", (DL_FUNC)&LGBMTPU_BoosterUpdateOneIter_R, 1},
+    {"LGBMTPU_BoosterUpdateOneIterCustom_R", (DL_FUNC)&LGBMTPU_BoosterUpdateOneIterCustom_R, 3},
+    {"LGBMTPU_BoosterGetPredict_R", (DL_FUNC)&LGBMTPU_BoosterGetPredict_R, 2},
+    {"LGBMTPU_BoosterGetNumClasses_R", (DL_FUNC)&LGBMTPU_BoosterGetNumClasses_R, 1},
     {"LGBMTPU_BoosterRollbackOneIter_R", (DL_FUNC)&LGBMTPU_BoosterRollbackOneIter_R, 1},
     {"LGBMTPU_BoosterGetCurrentIteration_R", (DL_FUNC)&LGBMTPU_BoosterGetCurrentIteration_R, 1},
     {"LGBMTPU_BoosterGetEval_R", (DL_FUNC)&LGBMTPU_BoosterGetEval_R, 2},

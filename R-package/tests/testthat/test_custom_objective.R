@@ -1,0 +1,82 @@
+# Custom objective / eval functions (parity targets:
+# reference R-package/tests/testthat/test_custom_objective.R).
+
+context("custom objective and eval")
+
+.make_binary <- function(n = 1000L, f = 6L, seed = 11L) {
+  set.seed(seed)
+  x <- matrix(rnorm(n * f), ncol = f)
+  logit <- 1.2 * x[, 1L] - 0.8 * x[, 2L]
+  y <- as.numeric(logit + rnorm(n) * 0.6 > 0)
+  list(x = x, y = y)
+}
+
+# hand-written binary logloss gradients at raw scores
+.logregobj <- function(preds, dtrain) {
+  labels <- dtrain$getinfo("label")
+  p <- 1 / (1 + exp(-preds))
+  list(grad = p - labels, hess = p * (1 - p))
+}
+
+.evalerror <- function(preds, dtrain) {
+  labels <- dtrain$getinfo("label")
+  err <- mean(as.numeric(preds > 0) != labels)
+  list(name = "error", value = err, higher_better = FALSE)
+}
+
+test_that("custom objective trains and matches built-in binary closely", {
+  d <- .make_binary()
+  dtrain <- lgb.Dataset(d$x, label = d$y)
+  common <- list(num_leaves = 15L, learning_rate = 0.2, verbose = -1L,
+                 metric = "none")
+  bst_custom <- lgb.train(c(common, list()), dtrain, nrounds = 8L,
+                          obj = .logregobj)
+  expect_equal(bst_custom$current_iter(), 8L)
+  dtrain2 <- lgb.Dataset(d$x, label = d$y)
+  bst_builtin <- lgb.train(c(common, list(objective = "binary")),
+                           dtrain2, nrounds = 8L)
+  p_custom <- predict(bst_custom, d$x, raw_score = TRUE)
+  p_builtin <- predict(bst_builtin, d$x, raw_score = TRUE)
+  # same gradients modulo boost_from_average's initial score: rank
+  # agreement must be near-perfect
+  expect_gt(cor(p_custom, p_builtin, method = "spearman"), 0.98)
+  err <- mean(as.numeric(p_custom > 0) != d$y)
+  expect_lt(err, 0.2)
+})
+
+test_that("objective passed as a function inside params works", {
+  d <- .make_binary(600L)
+  dtrain <- lgb.Dataset(d$x, label = d$y)
+  bst <- lgb.train(list(objective = .logregobj, num_leaves = 7L,
+                        verbose = -1L, metric = "none"),
+                   dtrain, nrounds = 3L)
+  expect_equal(bst$current_iter(), 3L)
+})
+
+test_that("feval records per-round values for every valid set", {
+  d <- .make_binary()
+  dtrain <- lgb.Dataset(d$x, label = d$y)
+  dvalid <- lgb.Dataset(d$x, label = d$y)
+  bst <- lgb.train(list(num_leaves = 7L, verbose = -1L, metric = "none"),
+                   dtrain, nrounds = 5L,
+                   valids = list(valid = dvalid),
+                   obj = .logregobj, feval = .evalerror)
+  errs <- unlist(bst$record_evals$valid$error$eval)
+  expect_equal(length(errs), 5L)
+  # boosting on a custom objective must reduce the custom error
+  expect_lte(errs[[5L]], errs[[1L]])
+})
+
+test_that("malformed obj / feval returns are rejected", {
+  d <- .make_binary(300L)
+  dtrain <- lgb.Dataset(d$x, label = d$y)
+  expect_error(
+    lgb.train(list(verbose = -1L), dtrain, nrounds = 2L,
+              obj = function(preds, dtrain) list(gradient = 1)),
+    "grad"
+  )
+  expect_error(
+    lgb.train(list(verbose = -1L), dtrain, nrounds = 2L, obj = "binary"),
+    "function"
+  )
+})

@@ -1,0 +1,43 @@
+# Feature importance table (parity targets:
+# reference R-package/tests/testthat/test_lgb.importance.R).
+
+context("lgb.importance")
+
+.imp_fixture <- function() {
+  set.seed(42L)
+  n <- 800L
+  x <- matrix(rnorm(n * 5L), ncol = 5L)
+  # only columns 1 and 2 carry signal; 3-5 are noise
+  y <- as.numeric(2 * x[, 1L] - x[, 2L] + rnorm(n) * 0.3 > 0)
+  lightgbm(data = x, label = y, nrounds = 10L, num_leaves = 15L,
+           objective = "binary", verbose = -1L)
+}
+
+test_that("importance has the reference shape and ranks signal first", {
+  bst <- .imp_fixture()
+  imp <- lgb.importance(bst)
+  expect_true(is.data.frame(imp))
+  expect_true(all(c("Feature", "Gain", "Frequency") %in% names(imp)))
+  expect_gt(nrow(imp), 0L)
+  # normalized: each measure sums to 1
+  expect_equal(sum(imp$Gain), 1, tolerance = 1e-6)
+  expect_equal(sum(imp$Frequency), 1, tolerance = 1e-6)
+  # ordered by Gain, and the top feature is one of the two signal columns
+  expect_true(all(diff(imp$Gain) <= 1e-12))
+  expect_true(imp$Feature[[1L]] %in% c("Column_0", "Column_1"))
+})
+
+test_that("percentage = FALSE returns raw counts and gains", {
+  bst <- .imp_fixture()
+  imp <- lgb.importance(bst, percentage = FALSE)
+  # raw split counts are integers >= 1 for used features
+  expect_true(all(imp$Frequency >= 1))
+  expect_true(all(imp$Gain > 0))
+})
+
+test_that("num_iteration restricts the trees counted", {
+  bst <- .imp_fixture()
+  imp_all <- lgb.importance(bst, percentage = FALSE)
+  imp_1 <- lgb.importance(bst, num_iteration = 1L, percentage = FALSE)
+  expect_lte(sum(imp_1$Frequency), sum(imp_all$Frequency))
+})

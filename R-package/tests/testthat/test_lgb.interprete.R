@@ -1,0 +1,49 @@
+# Per-prediction interpretation (parity targets:
+# reference R-package/tests/testthat/test_lgb.interprete.R).
+
+context("lgb.interprete")
+
+.int_fixture <- function() {
+  set.seed(9L)
+  n <- 600L
+  x <- matrix(rnorm(n * 4L), ncol = 4L)
+  y <- as.numeric(x[, 1L] - 0.5 * x[, 2L] + rnorm(n) * 0.4 > 0)
+  list(x = x, y = y,
+       bst = lightgbm(data = x, label = y, nrounds = 8L, num_leaves = 7L,
+                      objective = "binary", verbose = -1L))
+}
+
+test_that("interpretation returns one ranked frame per requested row", {
+  f <- .int_fixture()
+  out <- lgb.interprete(f$bst, f$x, idxset = 1L:3L)
+  expect_equal(length(out), 3L)
+  for (df in out) {
+    expect_true(is.data.frame(df))
+    expect_equal(names(df), c("Feature", "Contribution"))
+    expect_equal(nrow(df), 4L)
+    # ranked by absolute contribution
+    expect_true(all(diff(abs(df$Contribution)) <= 1e-12))
+  }
+})
+
+test_that("contributions plus bias reproduce the raw score (TreeSHAP)", {
+  f <- .int_fixture()
+  contrib <- f$bst$predict(f$x[1L:5L, , drop = FALSE], predcontrib = TRUE)
+  if (is.null(dim(contrib))) {
+    contrib <- matrix(contrib, nrow = 5L, byrow = TRUE)
+  }
+  raw <- f$bst$predict(f$x[1L:5L, , drop = FALSE], raw_score = TRUE)
+  expect_equal(rowSums(contrib), as.numeric(raw), tolerance = 1e-5)
+})
+
+test_that("multiclass interpretation emits one column per class", {
+  set.seed(4L)
+  n <- 400L
+  x <- matrix(rnorm(n * 3L), ncol = 3L)
+  y <- sample(0L:2L, n, replace = TRUE)
+  bst <- lightgbm(data = x, label = y, nrounds = 4L,
+                  objective = "multiclass", num_class = 3L, verbose = -1L)
+  out <- lgb.interprete(bst, x, idxset = 1L)
+  df <- out[[1L]]
+  expect_equal(ncol(df), 4L)   # Feature + one column per class
+})

@@ -1,0 +1,43 @@
+# Plot smoke tests (parity targets: reference
+# R-package/tests/testthat/test_lgb.plot.interpretation.R).
+
+context("plot helpers")
+
+.plot_fixture <- function() {
+  set.seed(21L)
+  n <- 500L
+  x <- matrix(rnorm(n * 5L), ncol = 5L)
+  y <- as.numeric(x[, 1L] + rnorm(n) * 0.5 > 0)
+  list(x = x,
+       bst = lightgbm(data = x, label = y, nrounds = 6L, num_leaves = 7L,
+                      objective = "binary", verbose = -1L))
+}
+
+test_that("lgb.plot.interpretation draws and returns the plotted values", {
+  f <- .plot_fixture()
+  interp <- lgb.interprete(f$bst, f$x, idxset = 1L)[[1L]]
+  grDevices::pdf(NULL)
+  on.exit(grDevices::dev.off())
+  vals <- lgb.plot.interpretation(interp, top_n = 3L)
+  expect_equal(length(vals), 3L)
+  expect_named(vals)
+})
+
+test_that("lgb.plot.importance accepts the importance data.frame", {
+  f <- .plot_fixture()
+  imp <- lgb.importance(f$bst)
+  grDevices::pdf(NULL)
+  on.exit(grDevices::dev.off())
+  vals <- lgb.plot.importance(imp, top_n = 2L, measure = "Gain")
+  expect_lte(length(vals), 2L)
+  expect_true(all(vals >= 0))
+})
+
+test_that("lgb.model.dt.tree tabulates every tree's nodes", {
+  f <- .plot_fixture()
+  dt <- lgb.model.dt.tree(f$bst)
+  expect_true(is.data.frame(dt))
+  expect_true(all(c("tree_index", "split_feature", "split_gain")
+                  %in% names(dt)))
+  expect_equal(length(unique(dt$tree_index)), 6L)
+})
