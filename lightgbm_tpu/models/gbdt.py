@@ -454,11 +454,12 @@ class GBDT:
         models = self._used_models(num_iteration, start_iteration)
         if not models:
             return np.zeros((x.shape[0], self.num_class))
-        arrays = predict_ops.trees_to_arrays(models)
-        tree_class = jnp.asarray(
-            np.arange(len(models), dtype=np.int32) % self.num_tree_per_iteration)
+        arrays = predict_ops.trees_to_arrays(models, bucket=True)
+        tc = predict_ops.padded_tree_class(
+            arrays,
+            np.arange(len(models)) % self.num_tree_per_iteration)
         out = predict_ops.predict_raw_ensemble(
-            jnp.asarray(x), arrays, tree_class,
+            jnp.asarray(x), arrays, tc,
             max_depth=arrays.max_depth, num_class=self.num_class)
         out = np.asarray(jax.device_get(out), dtype=np.float64)
         if self.average_output:
@@ -485,6 +486,9 @@ class GBDT:
             if len(active) == 0:
                 break
             chunk = models[start:start + step]
+            # no bucketing here: x[active] shrinks every round, so the
+            # changing row count forces a recompile regardless — padded
+            # trees would only add traversal work
             arrays = predict_ops.trees_to_arrays(chunk)
             tree_class = jnp.asarray(
                 (np.arange(len(chunk), dtype=np.int32) + start) % k)

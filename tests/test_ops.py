@@ -230,3 +230,39 @@ def test_pallas_histogram_transposed_layout_interpret():
         jnp.asarray(binned.T.copy()), jnp.asarray(gh), b, interpret=interpret))
     want = _ref_histogram(binned, gh[:, 0], gh[:, 1], np.ones(n, bool), b)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_bucketed_predict_matches_unbucketed():
+    """Shape-bucketed ensemble tensorization (compile-cache reuse across
+    growing tree counts) must not change predictions: padding trees are
+    single-leaf zeros."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.ops import predict as predict_ops
+
+    r = np.random.RandomState(3)
+    x = r.randn(400, 5).astype(np.float32)
+    y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(np.float64)
+    ds = lgb.Dataset(x, y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "metric": "none"}, ds,
+                    num_boost_round=5)
+    models = bst._gbdt.models
+    a_plain = predict_ops.trees_to_arrays(models)
+    a_bucket = predict_ops.trees_to_arrays(models, bucket=True)
+    # 5 trees bucket to 8; node/leaf axes to powers of two
+    assert a_bucket.split_feature.shape[0] == 8
+    assert a_plain.split_feature.shape[0] == 5
+    tc_plain = jnp.zeros(5, jnp.int32)
+    tc_bucket = jnp.zeros(8, jnp.int32)
+    out_p = predict_ops.predict_raw_ensemble(
+        jnp.asarray(x), a_plain, tc_plain,
+        max_depth=a_plain.max_depth, num_class=1)
+    out_b = predict_ops.predict_raw_ensemble(
+        jnp.asarray(x), a_bucket, tc_bucket,
+        max_depth=a_bucket.max_depth, num_class=1)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_b),
+                               rtol=1e-6, atol=1e-7)
+    # the public predict path (bucketed) agrees with per-row host replay
+    pred = bst.predict(x, raw_score=True)
+    host = np.array([sum(t.predict_row(row) for t in models) for row in x])
+    np.testing.assert_allclose(pred, host, rtol=1e-5, atol=1e-6)
