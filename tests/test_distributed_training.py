@@ -114,10 +114,65 @@ if rank == 0:
     np.testing.assert_allclose(np.asarray(tot_1), np.asarray(tot),
                                rtol=1e-5)
 
+# ---- categorical step: the winner's (B,) left-bin mask rides the ----
+# ---- candidate election across REAL process boundaries           ----
+r2 = np.random.RandomState(23)
+# the categorical column carries real signal so the k-vs-rest search
+# WINS some splits — otherwise the mask transport would go unexercised
+cat_col = (y * 4 + r2.randint(0, 4, n)).astype(np.float64)
+xc = np.column_stack([cat_col, x])
+cfgc = Config({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+               "max_bin": 63, "min_data_in_leaf": 20})
+dsc = Dataset(xc, config=cfgc, label=y, categorical_feature=[0])
+lrnc = DeviceTreeLearner(cfgc, dsc, strategy="compact", device_place=False)
+assert dsc.bundle_arrays() is None
+metac = (lrnc.f_numbins, lrnc.f_missing, lrnc.f_default, lrnc.f_monotone,
+         lrnc.f_penalty, lrnc.f_categorical, lrnc.f_col, lrnc.f_base,
+         lrnc.f_elide, lrnc.hist_idx)
+staticsc = dict(c_cols=lrnc.c_cols, item_bits=lrnc.item_bits,
+                pool_slots=lrnc.pool_slots, scatter_cols=shards,
+                window_step=lrnc.window_step, **lrnc._statics())
+assert staticsc["cat_statics"] is not None
+
+def localc(cp_l, cr_l, g_l, h_l, w_l, mask, key):
+    rec, rec_cat, _leaf, k, tot = grow_tree_compact_core(
+        cp_l, cr_l, g_l, h_l, w_l, mask, *metac, key,
+        axis_name="data", **staticsc)
+    return rec, rec_cat, k, tot
+
+maskc_np = np.ones(xc.shape[1], bool)
+cpc = gshard(np.asarray(lrnc.codes_pack))
+crc = gshard(np.asarray(lrnc.codes_row))
+maskc_g = grep(maskc_np)
+fnc = jax.jit(shard_map(
+    localc, mesh=mesh,
+    in_specs=(P("data", None), P("data", None), P("data"), P("data"),
+              P("data"), P(), P()),
+    out_specs=(P(), P(), P(), P()), check_vma=False))
+recc, recc_cat, kc, totc = jax.device_get(
+    fnc(cpc, crc, gg, hh, ww, maskc_g, key_g))
+
+recc_s = kc_s = recc_cat_s = None
+if rank == 0:
+    rc_1, rcc_1, _leaf, kc_1, _t = grow_tree_compact(
+        jnp.asarray(lrnc.codes_pack), jnp.asarray(lrnc.codes_row),
+        jnp.asarray(g), jnp.asarray(h), jnp.asarray(w),
+        jnp.asarray(maskc_np), *metac, jnp.asarray(key_np),
+        c_cols=lrnc.c_cols, item_bits=lrnc.item_bits,
+        pool_slots=lrnc.pool_slots, window_step=lrnc.window_step,
+        **lrnc._statics())
+    recc_s, recc_cat_s, kc_s = jax.device_get((rc_1, rcc_1, kc_1))
+
 with open(out, "wb") as fh:
     pickle.dump({"rec": np.asarray(rec), "k": int(k),
                  "rec_s": None if rec_s is None else np.asarray(rec_s),
-                 "k_s": None if k_s is None else int(k_s)}, fh)
+                 "k_s": None if k_s is None else int(k_s),
+                 "recc": np.asarray(recc),
+                 "recc_cat": np.asarray(recc_cat), "kc": int(kc),
+                 "recc_s": None if recc_s is None else np.asarray(recc_s),
+                 "recc_cat_s": (None if recc_cat_s is None
+                                else np.asarray(recc_cat_s)),
+                 "kc_s": None if kc_s is None else int(kc_s)}, fh)
 """
 
 
@@ -170,3 +225,24 @@ def test_two_process_data_parallel_training_step(tmp_path):
                 or rec[i, R_THR] != rec_s[i, R_THR]):
             assert abs(gd - gs) <= 2e-5 * max(1.0, abs(gs)), \
                 (i, "split differs beyond a tie plateau")
+
+    # categorical step: replicated records + masks across processes,
+    # at least one elected categorical winner, single-device agreement
+    assert r0["kc"] == r1["kc"] > 0
+    np.testing.assert_array_equal(r0["recc"], r1["recc"])
+    np.testing.assert_array_equal(r0["recc_cat"], r1["recc_cat"])
+    recc, kc = r0["recc"], r0["kc"]
+    cat_rows = [i for i in range(kc)
+                if recc[i, R_FEAT] == 0 and r0["recc_cat"][i].sum() > 0]
+    assert cat_rows, "no categorical split crossed the election"
+    assert kc == r0["kc_s"]
+    for i in range(kc):
+        gd, gs = recc[i, R_GAIN], r0["recc_s"][i, R_GAIN]
+        assert abs(gd - gs) <= 1e-4 * max(1.0, abs(gs)), (i, gd, gs)
+        if (recc[i, R_FEAT] == r0["recc_s"][i, R_FEAT] == 0
+                and not np.array_equal(r0["recc_cat"][i],
+                                       r0["recc_cat_s"][i])):
+            # differing left-bin subsets are legal only on an equal-gain
+            # plateau (same escape as the numeric block above)
+            assert abs(gd - gs) <= 2e-5 * max(1.0, abs(gs)), \
+                (i, "cat mask differs beyond a tie plateau")
