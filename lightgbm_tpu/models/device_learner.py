@@ -54,7 +54,7 @@ from ..ops import split as split_ops
 from ..ops.partition import decide_left
 from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
 from ..utils import log
-from ..utils.envs import use_pallas_env, use_pallas_partition_env
+from ..utils.envs import partition_mode_env, use_pallas_env
 from .tree import Tree
 
 NEG_INF = split_ops.NEG_INF
@@ -404,7 +404,7 @@ def _unpack_codes(words: jax.Array, c_cols: int, item_bits: int) -> jax.Array:
     jax.jit,
     static_argnames=("c_cols", "item_bits",
                      "num_leaves", "num_bins", "col_bins", "max_depth",
-                     "bynode_k", "use_pallas", "use_pallas_part",
+                     "bynode_k", "use_pallas", "partition",
                      "pool_slots", "window_step", "cat_statics"))
 def grow_tree_compact(
         codes_pack: jax.Array,       # (N, CW) u32: packed column codes
@@ -418,7 +418,7 @@ def grow_tree_compact(
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
-        use_pallas_part: bool = False,
+        partition: str = "sort",
         pool_slots: int = 0, window_step: int = 4, cat_statics=None):
     return grow_tree_compact_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
@@ -429,7 +429,7 @@ def grow_tree_compact(
         l1=l1, l2=l2, max_delta_step=max_delta_step,
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
-        use_pallas=use_pallas, use_pallas_part=use_pallas_part,
+        use_pallas=use_pallas, partition=partition,
         axis_name=None, pool_slots=pool_slots,
         window_step=window_step, cat_statics=cat_statics)
 
@@ -445,7 +445,7 @@ def grow_tree_compact_core(
         l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
-        use_pallas_part: bool = False,
+        partition: str = "sort",
         axis_name=None, pool_slots: int = 0, scatter_cols: int = 0,
         feature_shards: int = 0, voting_k: int = 0, window_step: int = 4,
         cat_statics=None):
@@ -885,11 +885,26 @@ def grow_tree_compact_core(
             # Split): overrun rows past pcount get key 2, so the stable
             # sort returns them to their original slots untouched
             key3 = jnp.where(valid, jnp.where(go_left, 0, 1), 2)
-            if use_pallas_part:
+            if partition == "pallas":
                 from ..ops.pallas.partition_kernel import stable_partition3
                 win_sorted = stable_partition3(
                     win, key3,
                     interpret=jax.default_backend() != "tpu")
+            elif partition == "scan":
+                # sort-free stable partition: each row's destination is
+                # its exclusive rank within its key class (two cumsums),
+                # then ONE row scatter. Rows past pcount all carry key 2
+                # and sit contiguously at the window tail, so dest=pos
+                # keeps them in place; every slot is written exactly once.
+                pos_w = jnp.arange(wsz, dtype=jnp.int32)
+                il = go_left.astype(jnp.int32)
+                ir = (valid & ~go_left).astype(jnp.int32)
+                dl = jnp.cumsum(il) - 1
+                dr = jnp.sum(il) + jnp.cumsum(ir) - 1
+                dest = jnp.where(go_left, dl,
+                                 jnp.where(valid, dr, pos_w))
+                win_sorted = jnp.zeros_like(win).at[dest].set(
+                    win, unique_indices=True)
             else:
                 order = jnp.argsort(key3.astype(jnp.int8), stable=True)
                 win_sorted = jnp.take(win, order, axis=0)
@@ -1290,9 +1305,10 @@ class DeviceTreeLearner:
         # build into the matmul pipeline better than Mosaic schedules it),
         # so the fused XLA path is the default even on TPU.
         self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
-        # partition kernel: opt-in on any backend (interpret mode off-TPU
-        # keeps CI coverage of the integrated path)
-        self._use_pallas_part = use_pallas_partition_env()
+        # partition formulation: sort | scan | pallas (opt-in on any
+        # backend; pallas runs interpret mode off-TPU so CI covers the
+        # integrated path)
+        self._partition_mode = partition_mode_env()
         self.strategy = resolve_strategy(config, dataset, strategy)
         self.window_step = max(2, int(_env("LGBM_TPU_WINDOW_STEP", "4")))
         # LRU-capped histogram pool: when the dense (L,C,B,3) pool would
@@ -1493,7 +1509,7 @@ class DeviceTreeLearner:
                 self.f_elide, self.hist_idx, key,
                 c_cols=self.c_cols, item_bits=self.item_bits,
                 pool_slots=self.pool_slots, window_step=self.window_step,
-                use_pallas_part=self._use_pallas_part,
+                partition=self._partition_mode,
                 **self._statics())
         return grow_tree(
             self.codes_t, grad, hess, w, base_mask,
@@ -1636,7 +1652,7 @@ class DeviceTreeLearner:
                     item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
                     window_step=self.window_step,
-                    use_pallas_part=self._use_pallas_part, **statics)
+                    partition=self._partition_mode, **statics)
                 leaf_o = route_rows_by_rec(
                     jnp.take(self.codes_pack, oob_idx, axis=0), rec, k,
                     self.f_numbins, self.f_missing, self.f_default,
@@ -1653,7 +1669,7 @@ class DeviceTreeLearner:
                     item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
                     window_step=self.window_step,
-                    use_pallas_part=self._use_pallas_part, **statics)
+                    partition=self._partition_mode, **statics)
             else:
                 rec, rec_cat, leaf_id, k, _ = grow(
                     self.codes_t, g, h, w, base_mask, *meta, tree_key,

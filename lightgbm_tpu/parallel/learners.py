@@ -527,7 +527,7 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                     pool_slots=self.pool_slots,
                     scatter_cols=self.scatter_cols,
                     window_step=self.window_step,
-                    use_pallas_part=self._use_pallas_part,
+                    partition=self._partition_mode,
                     **self._statics())
 
     def _sharded_tree_fn(self, with_bag_key: bool, allow_bagging=True,
@@ -773,7 +773,7 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                     pool_slots=self.pool_slots,
                     feature_shards=self.shards,
                     window_step=self.window_step,
-                    use_pallas_part=self._use_pallas_part,
+                    partition=self._partition_mode,
                     **self._statics())
 
     def _sharded_tree_fn(self):

@@ -16,11 +16,21 @@ def use_pallas_env() -> bool:
     return flag("LGBM_TPU_PALLAS") or flag("LGBM_TPU_PALLAS_HIST")
 
 
-def use_pallas_partition_env() -> bool:
-    """Opt-in to the Pallas stable-partition kernel for the compact
-    growth loop's window split (replaces argsort+take, which is
-    gather-latency-bound on TPU)."""
-    return flag("LGBM_TPU_PALLAS_PART")
+def partition_mode_env() -> str:
+    """LGBM_TPU_PARTITION selects the compact window-split formulation:
+    'sort' (argsort+take — latency-bound on TPU: the sort's O(W log W)
+    passes dominate small windows, the row gather runs at 3-10 GB/s),
+    'scan' (destination = cumsum of the partition flags + one row
+    scatter — two linear passes, no sort), or 'pallas' (the block-
+    streaming one-hot-matmul kernel, ops/pallas/partition_kernel.py).
+    LGBM_TPU_PALLAS_PART=1 is the round-2 spelling of 'pallas'."""
+    mode = os.environ.get("LGBM_TPU_PARTITION", "").strip().lower()
+    if mode in ("sort", "scan", "pallas"):
+        return mode
+    if mode:
+        from . import log
+        log.warning("Unknown LGBM_TPU_PARTITION=%r; using default", mode)
+    return "pallas" if flag("LGBM_TPU_PALLAS_PART") else "sort"
 
 
 def dp_reduce_mode_env() -> str:

@@ -88,6 +88,38 @@ def test_compact_learner_identical_trees_with_kernel(monkeypatch):
     assert base == with_kernel
 
 
+def test_compact_learner_identical_trees_with_scan_partition(monkeypatch):
+    # the sort-free cumsum+scatter partition (LGBM_TPU_PARTITION=scan)
+    # must grow the IDENTICAL tree as the argsort+take default
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.device_learner import DeviceTreeLearner
+
+    r = np.random.RandomState(23)
+    n, f = 3000, 6
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g = jnp.asarray((r.rand(n) - 0.5).astype(np.float32))
+    h = jnp.asarray((0.1 + r.rand(n)).astype(np.float32))
+
+    def grow(mode):
+        monkeypatch.delenv("LGBM_TPU_PALLAS_PART", raising=False)
+        if mode:
+            monkeypatch.setenv("LGBM_TPU_PARTITION", mode)
+        else:
+            monkeypatch.delenv("LGBM_TPU_PARTITION", raising=False)
+        cfg = Config({"objective": "binary", "num_leaves": 15,
+                      "max_bin": 63, "min_data_in_leaf": 20,
+                      "verbosity": -1})
+        ds = Dataset(x, config=cfg, label=y)
+        lrn = DeviceTreeLearner(cfg, ds, strategy="compact")
+        tree = lrn.train(g, h)
+        return tree.to_string()
+
+    base = grow(None)
+    assert grow("scan") == base
+
+
 def test_fused_training_path_honors_kernel_flag(monkeypatch):
     # the bench/default training path goes through make_fused_step, which
     # must also thread use_pallas_part (review catch: it once silently

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-3 battery #3: partition-formulation A/B + the decomposition
+# probes the first chain lost. Same hygiene as tpu_battery2.sh: internal
+# deadlines (SIGALRM inside bench.py), probe between steps, outer
+# timeout only as a last resort, battery owns the single CPU core.
+cd /root/repo
+RES=/tmp/tpu_bench_results3.log
+probe() {
+  timeout 150 python -c "import jax; assert jax.default_backend()=='tpu'" \
+    2>/dev/null
+}
+run() {  # run <name> <outer_timeout_s> <cmd...>
+  if ! probe; then
+    echo "!! tunnel down before '$1' — battery stops" >> $RES
+    exit 1
+  fi
+  echo "--- $1 ---" >> $RES
+  shift
+  timeout -s INT -k 120 "$@" >> $RES 2>&1
+  echo "--- end rc=$? $(date +%H:%M:%S) ---" >> $RES
+}
+bench() {  # bench <name> <internal_deadline_s> <env...>
+  local name="$1" dl="$2"; shift 2
+  if ! probe; then
+    echo "!! tunnel down before bench '$name' — battery stops" >> $RES
+    exit 1
+  fi
+  echo "--- $name ---" >> $RES
+  env "$@" BENCH_DEADLINE=$dl timeout -s INT -k 120 $((dl + 300)) \
+    python bench.py >> $RES 2>&1
+  echo "--- end $name rc=$? $(date +%H:%M:%S) ---" >> $RES
+}
+
+echo "=== battery3 start $(date +%H:%M:%S) ===" >> $RES
+run "split parts decomposition" 1500 1200 \
+  python tools/microbench_split_parts.py 1048576 20
+run "scaling probe 1M" 2000 1800 python tools/scaling_probe.py 1000000
+bench "bench 1M partition=scan" 900 LGBM_TPU_PARTITION=scan \
+  BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+bench "bench 1M partition=pallas" 900 LGBM_TPU_PARTITION=pallas \
+  BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+echo "=== battery3 done $(date +%H:%M:%S) ===" >> $RES
