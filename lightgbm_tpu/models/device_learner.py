@@ -912,16 +912,12 @@ def grow_tree_compact_core(
                                                 (begin, 0))
             lphys = jnp.sum(go_left.astype(jnp.int32))
             rphys = pcount - lphys
-
-            pos = jnp.arange(wsz, dtype=jnp.int32)
-            old_slice = jax.lax.dynamic_slice(c.pos_leaf, (begin,), (wsz,))
-            new_slice = jnp.where(pos < lphys, l,
-                                  jnp.where(pos < pcount, new_id, old_slice))
-            pos_leaf = jax.lax.dynamic_update_slice(
-                c.pos_leaf, new_slice, (begin,))
-
-            leaf_begin = c.leaf_begin.at[new_id].set(begin + lphys)
-            leaf_phys = c.leaf_phys.at[l].set(lphys).at[new_id].set(rphys)
+            # pos_leaf / leaf_begin / leaf_phys updates happen OUTSIDE the
+            # switch (the body computes them from lphys): fewer branch
+            # outputs means fewer carry buffers crossing the conditional
+            # boundary, where XLA's copy insertion is conservative — the
+            # (N,)-sized pos_leaf update in particular cost a full-array
+            # copy per split here
 
             # LOCAL histogram of the GLOBALLY smaller child (all shards
             # must hist the same side so the cross-shard sum is one
@@ -986,8 +982,7 @@ def grow_tree_compact_core(
             else:
                 hist_other = jnp.zeros((hist_cols, col_bins, 3),
                                        jnp.float32)
-            return data, pos_leaf, leaf_begin, leaf_phys, hist_small, \
-                hist_other
+            return data, lphys, hist_small, hist_other
         return branch
 
     branches = [make_branch(wsz) for wsz in classes]
@@ -1002,8 +997,19 @@ def grow_tree_compact_core(
         slot_l = c.slot_of[l]
         have_parent = slot_l >= 0
         j = jnp.sum((pcount > thresholds).astype(jnp.int32))
-        data, pos_leaf, leaf_begin, leaf_phys, hist_small, hist_other = \
+        data, lphys, hist_small, hist_other = \
             jax.lax.switch(j, branches, (c, l, row, new_id, ~have_parent))
+        begin = c.leaf_begin[l]
+        rphys = pcount - lphys
+        leaf_begin = c.leaf_begin.at[new_id].set(begin + lphys)
+        leaf_phys = c.leaf_phys.at[l].set(lphys).at[new_id].set(rphys)
+        # O(N) elementwise pos_leaf rewrite (fuses to one in-place pass;
+        # cheaper than carrying the update through the conditional)
+        posv = jnp.arange(n + wmax, dtype=jnp.int32)
+        pos_leaf = jnp.where(
+            (posv >= begin) & (posv < begin + lphys), l,
+            jnp.where((posv >= begin + lphys) & (posv < begin + pcount),
+                      new_id, c.pos_leaf))
         if axis_name is not None:
             # cross-shard histogram reduction: psum replicates (dense
             # equivalent of the reference's reduce-scatter, scan runs

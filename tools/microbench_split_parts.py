@@ -57,16 +57,23 @@ def part_sort(i, a):
 
 
 def part_scan(i, a):
+    # full 3-way rank computation (invalid rows ranked after the valid
+    # streams) so destinations stay a true permutation under the rolled
+    # key pattern; production (device_learner) has invalid rows at the
+    # tail and skips the third cumsum — this measures a slight superset
     win, key3 = a
     k = jnp.roll(key3, i)
     go_left = k == 0
     valid = k < 2
-    pos_w = jnp.arange(win.shape[0], dtype=jnp.int32)
     il = go_left.astype(jnp.int32)
     ir = (valid & ~go_left).astype(jnp.int32)
+    iv = (~valid).astype(jnp.int32)
+    n0 = jnp.sum(il)
+    n1 = jnp.sum(ir)
     dl = jnp.cumsum(il) - 1
-    dr = jnp.sum(il) + jnp.cumsum(ir) - 1
-    dest = jnp.where(go_left, dl, jnp.where(valid, dr, pos_w))
+    dr = n0 + jnp.cumsum(ir) - 1
+    dv = n0 + n1 + jnp.cumsum(iv) - 1
+    dest = jnp.where(go_left, dl, jnp.where(valid, dr, dv))
     return jnp.zeros_like(win).at[dest].set(
         win, unique_indices=True).astype(jnp.float32)
 

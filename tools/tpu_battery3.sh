@@ -10,13 +10,13 @@ probe() {
     2>/dev/null
 }
 run() {  # run <name> <outer_timeout_s> <cmd...>
+  local name="$1" to="$2"; shift 2
   if ! probe; then
-    echo "!! tunnel down before '$1' — battery stops" >> $RES
+    echo "!! tunnel down before '$name' — battery stops" >> $RES
     exit 1
   fi
-  echo "--- $1 ---" >> $RES
-  shift
-  timeout -s INT -k 120 "$@" >> $RES 2>&1
+  echo "--- $name ---" >> $RES
+  timeout -s INT -k 120 "$to" "$@" >> $RES 2>&1
   echo "--- end rc=$? $(date +%H:%M:%S) ---" >> $RES
 }
 bench() {  # bench <name> <internal_deadline_s> <env...>
@@ -32,9 +32,9 @@ bench() {  # bench <name> <internal_deadline_s> <env...>
 }
 
 echo "=== battery3 start $(date +%H:%M:%S) ===" >> $RES
-run "split parts decomposition" 1500 1200 \
+run "split parts decomposition" 1500 \
   python tools/microbench_split_parts.py 1048576 20
-run "scaling probe 1M" 2000 1800 python tools/scaling_probe.py 1000000
+run "scaling probe 1M" 2400 python tools/scaling_probe.py 1000000
 bench "bench 1M partition=scan" 900 LGBM_TPU_PARTITION=scan \
   BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 bench "bench 1M partition=pallas" 900 LGBM_TPU_PARTITION=pallas \

@@ -20,6 +20,7 @@ done
 echo "--- overhead probe $(date +%H:%M:%S) ---" >> $RES
 timeout -s INT -k 120 1200 python tools/tpu_overhead_probe.py >> $RES 2>&1
 echo "--- end overhead probe rc=$? ---" >> $RES
+bash tools/tpu_battery3.sh || { echo "battery3 aborted (tunnel down)" >> $RES; exit 1; }
 bash tools/tpu_battery2.sh || { echo "battery aborted (tunnel down); skipping profile" >> $RES; exit 1; }
 echo "--- profile_iter 1M $(date +%H:%M:%S) ---" >> $RES
 timeout -s INT -k 120 1200 python tools/profile_iter.py 1000000 5 >> $RES 2>&1
