@@ -882,32 +882,11 @@ def grow_tree_compact_core(
                 cat_mask=c.best_cat[l] if has_cat else None) & valid
 
             # stable partition of the window (reference DataPartition::
-            # Split): overrun rows past pcount get key 2, so the stable
-            # sort returns them to their original slots untouched
+            # Split): overrun rows past pcount get key 2; the full 3-way
+            # compaction is identity on them (they are already tail-
+            # contiguous), so they return to their slots untouched
             key3 = jnp.where(valid, jnp.where(go_left, 0, 1), 2)
-            if partition == "pallas":
-                from ..ops.pallas.partition_kernel import stable_partition3
-                win_sorted = stable_partition3(
-                    win, key3,
-                    interpret=jax.default_backend() != "tpu")
-            elif partition == "scan":
-                # sort-free stable partition: each row's destination is
-                # its exclusive rank within its key class (two cumsums),
-                # then ONE row scatter. Rows past pcount all carry key 2
-                # and sit contiguously at the window tail, so dest=pos
-                # keeps them in place; every slot is written exactly once.
-                pos_w = jnp.arange(wsz, dtype=jnp.int32)
-                il = go_left.astype(jnp.int32)
-                ir = (valid & ~go_left).astype(jnp.int32)
-                dl = jnp.cumsum(il) - 1
-                dr = jnp.sum(il) + jnp.cumsum(ir) - 1
-                dest = jnp.where(go_left, dl,
-                                 jnp.where(valid, dr, pos_w))
-                win_sorted = jnp.zeros_like(win).at[dest].set(
-                    win, unique_indices=True)
-            else:
-                order = jnp.argsort(key3.astype(jnp.int8), stable=True)
-                win_sorted = jnp.take(win, order, axis=0)
+            win_sorted = partition_window(win, key3, partition)
             data = jax.lax.dynamic_update_slice(c.data, win_sorted,
                                                 (begin, 0))
             lphys = jnp.sum(go_left.astype(jnp.int32))
@@ -1110,6 +1089,292 @@ def grow_tree_compact_core(
             leaf_id, out.k, totals)
 
 
+class _CarryK(NamedTuple):
+    k: jax.Array
+    data: jax.Array          # (N + CH, D) u32 packed rows grouped by leaf
+    scratch: jax.Array       # (N + CH, D) u32 right-segment staging
+    pos_leaf: jax.Array      # (N + CH,) leaf id per physical position
+    leaf_begin: jax.Array    # (L,)
+    leaf_phys: jax.Array     # (L,)
+    pool: jax.Array          # (L, C, B, 3) dense histogram pool
+    depth: jax.Array
+    leaf_min: jax.Array
+    leaf_max: jax.Array
+    best: jax.Array          # (L, 12) f32
+    best_cat: jax.Array      # (L, B|1) f32
+    rec: jax.Array           # (L-1, 13) f32
+    rec_cat: jax.Array       # (L-1, B|1) f32
+    key: jax.Array
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("c_cols", "item_bits",
+                     "num_leaves", "num_bins", "col_bins", "max_depth",
+                     "bynode_k", "use_pallas", "partition",
+                     "chunk_rows", "cat_statics"))
+def grow_tree_chunk(
+        codes_pack: jax.Array, codes_row: jax.Array,
+        grad: jax.Array, hess: jax.Array, w: jax.Array,
+        base_mask: jax.Array,
+        f_numbins, f_missing, f_default, f_monotone, f_penalty,
+        f_categorical, f_col, f_base, f_elide, hist_idx, rng_key,
+        *, c_cols: int, item_bits: int,
+        num_leaves: int, num_bins: int, col_bins: int, max_depth: int,
+        l1: float, l2: float, max_delta_step: float,
+        min_data_in_leaf: int, min_sum_hessian: float,
+        min_gain_to_split: float, bynode_k: int, use_pallas: bool,
+        partition: str = "sort", chunk_rows: int = 65536,
+        cat_statics=None):
+    """Switch-free whole-tree growth over fixed-size chunks.
+
+    The compact strategy resolves dynamic leaf sizes with a lax.switch
+    over padded window classes; XLA's copy insertion around that
+    conditional copies the packed working buffer once per split, and
+    every class duplicates the branch program. This variant removes the
+    conditional entirely: a split of a p-row leaf runs ceil(p / CH)
+    iterations of fixed-(CH, D)-shaped inner fori loops, so every carry
+    update is an unconditional dynamic_update_slice XLA aliases in
+    place, one traced partition program serves every leaf size, and the
+    per-split fixed cost is a handful of chunk passes instead of the
+    branch machinery.
+
+    Correctness of the in-place movement (reference DataPartition::Split
+    semantics, stable 3-way):
+      * pass B (forward over chunks): chunk i's rows are read before any
+        write that can touch them — left writes land in
+        [begin, begin+loff[i]+CH) which never reaches past chunk i's own
+        region (loff[i] <= i*CH), and are merge-masked to exactly
+        lcnt[i] rows so rows of later chunks are preserved; right
+        segments stage front-aligned at chunk i's own location in a
+        scratch buffer.
+      * pass C (forward): staged right segments place at
+        begin + L_tot + roff[i], merge-masked to rcnt[i] rows, so the
+        garbage tail never leaks into the next leaf.
+      * rows past the leaf end (other leaves' rows in the final chunk)
+        carry partition key 2 and are never written.
+    The smaller child's histogram accumulates over its chunks after the
+    move (sibling = parent - smaller, FeatureHistogram::Subtract).
+    Sharded modes and the LRU-capped pool stay on the compact strategy.
+    """
+    from ..ops.histogram import build_histogram
+    n = grad.shape[0]
+    cw = codes_pack.shape[1]
+    L = num_leaves
+    CH = int(chunk_rows)
+    maxch = -(-n // CH)
+    has_cat = cat_statics is not None
+    cat_b = num_bins if has_cat else 1
+    gh = jnp.stack([grad * w, hess * w, w], axis=1)
+    d_cols = cw + 4
+    helper_kwargs = dict(
+        num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
+        max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
+        bynode_k=bynode_k)
+    (node_mask, scan, store_best, scan2, store_best2,
+     best_row) = _tree_helpers(
+        base_mask, f_numbins, f_missing, f_default, f_monotone,
+        f_penalty, f_elide, hist_idx,
+        f_categorical=f_categorical, cat_statics=cat_statics,
+        **helper_kwargs)
+
+    gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)
+    ids = jnp.arange(n, dtype=jnp.uint32)[:, None]
+    data0 = jnp.concatenate([codes_pack, gh_u, ids], axis=1)
+    data0 = jnp.concatenate(
+        [data0, jnp.zeros((CH, d_cols), jnp.uint32)], axis=0)
+
+    hist0 = build_histogram(codes_row, gh, col_bins, use_pallas=use_pallas)
+    totals = hist0[0].sum(axis=0)
+    root_key, loop_key = jax.random.split(rng_key)
+    root_res, root_cm = scan(hist0, totals[0], totals[1], totals[2],
+                             jnp.float32(-np.inf), jnp.float32(np.inf),
+                             node_mask(root_key))
+    best = jnp.full((L, 12), NEG_INF, jnp.float32).at[:, B_FEAT:].set(0.0)
+    best_cat = jnp.zeros((L, cat_b), jnp.float32)
+    best, best_cat = store_best(best, best_cat, 0, root_res, root_cm,
+                                jnp.int32(0))
+    zi = functools.partial(jnp.zeros, dtype=jnp.int32)
+    carry = _CarryK(
+        k=jnp.int32(0), data=data0, scratch=jnp.zeros_like(data0),
+        pos_leaf=jnp.zeros(n + CH, jnp.int32),
+        leaf_begin=zi(L), leaf_phys=zi(L).at[0].set(n),
+        pool=jnp.zeros((L, c_cols, col_bins, 3), jnp.float32).at[0]
+            .set(hist0),
+        depth=zi(L),
+        leaf_min=jnp.full((L,), -np.inf, jnp.float32),
+        leaf_max=jnp.full((L,), np.inf, jnp.float32),
+        best=best, best_cat=best_cat,
+        rec=jnp.zeros((L - 1, 13), jnp.float32),
+        rec_cat=jnp.zeros((L - 1, cat_b), jnp.float32), key=loop_key)
+
+    iota_ch = jnp.arange(CH, dtype=jnp.int32)
+
+    def cond(c: _CarryK):
+        return (c.k < L - 1) & (jnp.max(c.best[:, B_GAIN]) > 1e-10)
+
+    def body(c: _CarryK) -> _CarryK:
+        b = c.best
+        l = jnp.argmax(b[:, B_GAIN]).astype(jnp.int32)
+        row = b[l]
+        new_id = c.k + 1
+        feat = row[B_FEAT].astype(jnp.int32)
+        thr = row[B_THR].astype(jnp.int32)
+        dleft = row[B_DLEFT] > 0.5
+        cmask = c.best_cat[l] if has_cat else None
+        begin = c.leaf_begin[l]
+        p = c.leaf_phys[l]
+        nch = -(-p // CH)
+
+        # pass B: per chunk — read, decide, local 3-way stable partition,
+        # exact-write lefts forward into data, stage rights in scratch
+        def pass_b(i, acc):
+            data, scratch, lrun, rcnt = acc
+            start = begin + i * CH
+            win = jax.lax.dynamic_slice(data, (start, 0), (CH, d_cols))
+            valid = iota_ch < (p - i * CH)
+            gl = packed_go_left(
+                win, feat, thr, dleft, f_numbins, f_missing, f_default,
+                f_col, f_base, f_elide, item_bits=item_bits,
+                f_categorical=f_categorical if has_cat else None,
+                cat_mask=cmask) & valid
+            key3 = jnp.where(gl, 0, jnp.where(valid, 1, 2))
+            win_s = partition_window(win, key3, partition)
+            lc = jnp.sum(gl.astype(jnp.int32))
+            vc = jnp.sum(valid.astype(jnp.int32))
+            d_old = jax.lax.dynamic_slice(
+                data, (begin + lrun, 0), (CH, d_cols))
+            merged = jnp.where((iota_ch < lc)[:, None], win_s, d_old)
+            data = jax.lax.dynamic_update_slice(
+                data, merged, (begin + lrun, 0))
+            win_pad = jnp.concatenate(
+                [win_s, jnp.zeros((CH, d_cols), jnp.uint32)], axis=0)
+            rights = jax.lax.dynamic_slice(
+                win_pad, (lc, 0), (CH, d_cols))
+            scratch = jax.lax.dynamic_update_slice(
+                scratch, rights, (start, 0))
+            return data, scratch, lrun + lc, rcnt.at[i].set(vc - lc)
+
+        data, scratch, lphys, rcnt = jax.lax.fori_loop(
+            0, nch, pass_b,
+            (c.data, c.scratch, jnp.int32(0), zi(maxch)))
+        rphys = p - lphys
+        roff = jnp.cumsum(rcnt) - rcnt
+
+        # pass C: place staged right segments after the left block
+        def pass_c(i, data):
+            seg = jax.lax.dynamic_slice(
+                scratch, (begin + i * CH, 0), (CH, d_cols))
+            dst = begin + lphys + roff[i]
+            d_old = jax.lax.dynamic_slice(data, (dst, 0), (CH, d_cols))
+            merged = jnp.where((iota_ch < rcnt[i])[:, None], seg, d_old)
+            return jax.lax.dynamic_update_slice(data, merged, (dst, 0))
+
+        data = jax.lax.fori_loop(0, nch, pass_c, data)
+
+        # smaller-child histogram over its chunks (post-move layout)
+        left_small = row[B_LCNT] <= row[B_RCNT]
+        sb = begin + jnp.where(left_small, 0, lphys)
+        sc = jnp.where(left_small, lphys, rphys)
+
+        def pass_h(i, hist):
+            start = sb + i * CH
+            win = jax.lax.dynamic_slice(data, (start, 0), (CH, d_cols))
+            v = (iota_ch < (sc - i * CH)).astype(jnp.float32)
+            codes = _unpack_codes(win[:, :cw], c_cols, item_bits)
+            ghw = jax.lax.bitcast_convert_type(
+                win[:, cw:cw + 3], jnp.float32) * v[:, None]
+            return hist + build_histogram(codes, ghw, col_bins,
+                                          use_pallas=use_pallas)
+
+        hist_small = jax.lax.fori_loop(
+            0, -(-sc // CH), pass_h,
+            jnp.zeros((c_cols, col_bins, 3), jnp.float32))
+
+        sibling = c.pool[l] - hist_small
+        hist_l = jnp.where(left_small, hist_small, sibling)
+        hist_r = jnp.where(left_small, sibling, hist_small)
+        pool = c.pool.at[l].set(hist_l).at[new_id].set(hist_r)
+
+        leaf_begin = c.leaf_begin.at[new_id].set(begin + lphys)
+        leaf_phys = c.leaf_phys.at[l].set(lphys).at[new_id].set(rphys)
+        posv = jnp.arange(n + CH, dtype=jnp.int32)
+        pos_leaf = jnp.where(
+            (posv >= begin) & (posv < begin + lphys), l,
+            jnp.where((posv >= begin + lphys) & (posv < begin + p),
+                      new_id, c.pos_leaf))
+
+        mono_f = f_monotone[feat]
+        mid = (row[B_LOUT] + row[B_ROUT]) * 0.5
+        pmin, pmax = c.leaf_min[l], c.leaf_max[l]
+        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
+        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
+        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
+        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
+        leaf_min = c.leaf_min.at[l].set(lmin).at[new_id].set(rmin)
+        leaf_max = c.leaf_max.at[l].set(lmax).at[new_id].set(rmax)
+        child_depth = c.depth[l] + 1
+        depth = c.depth.at[l].set(child_depth).at[new_id].set(child_depth)
+
+        rec_row = jnp.concatenate([
+            jnp.stack([l.astype(jnp.float32), row[B_FEAT], row[B_THR],
+                       row[B_DLEFT], row[B_GAIN]]),
+            row[B_LSG:]])
+        rec2 = c.rec.at[c.k].set(rec_row)
+        rec_cat2 = c.rec_cat.at[c.k].set(c.best_cat[l])
+
+        key, kl, kr = jax.random.split(c.key, 3)
+        res2, cm2 = scan2(jnp.stack([hist_l, hist_r]),
+                          jnp.stack([row[B_LSG], row[B_RSG]]),
+                          jnp.stack([row[B_LSH], row[B_RSH]]),
+                          jnp.stack([row[B_LCNT], row[B_RCNT]]),
+                          jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
+                          jnp.stack([kl, kr]))
+        best2, best_cat2 = store_best2(b, c.best_cat,
+                                       jnp.stack([l, new_id]), res2, cm2,
+                                       child_depth)
+        return _CarryK(new_id, data, scratch, pos_leaf, leaf_begin,
+                       leaf_phys, pool, depth, leaf_min, leaf_max,
+                       best2, best_cat2, rec2, rec_cat2, key)
+
+    out = jax.lax.while_loop(cond, body, carry)
+    row_ids = out.data[:n, d_cols - 1].astype(jnp.int32)
+    leaf_id = jnp.zeros(n, jnp.int32).at[row_ids].set(
+        out.pos_leaf[:n], unique_indices=True)
+    return (out.rec, out.rec_cat if has_cat else None,
+            leaf_id, out.k, totals)
+
+
+def partition_window(win: jax.Array, key3: jax.Array,
+                     partition: str) -> jax.Array:
+    """Stable 3-way reorder of a (W, D) u32 window by key3 in {0,1,2} —
+    the ONE dispatch over the partition formulations (reference
+    DataPartition::Split role), shared by the compact branches and the
+    chunk passes. 'sort' = argsort+take; 'scan' = per-class exclusive
+    ranks via cumsum + one row scatter (no sort passes); 'pallas' = the
+    block-streaming one-hot-matmul kernel."""
+    if partition == "pallas":
+        from ..ops.pallas.partition_kernel import stable_partition3
+        return stable_partition3(
+            win, key3, interpret=jax.default_backend() != "tpu")
+    if partition == "scan":
+        is0 = key3 == 0
+        is1 = key3 == 1
+        i0 = is0.astype(jnp.int32)
+        i1 = is1.astype(jnp.int32)
+        i2 = (key3 == 2).astype(jnp.int32)
+        n0 = jnp.sum(i0)
+        n1 = jnp.sum(i1)
+        d0 = jnp.cumsum(i0) - 1
+        d1 = n0 + jnp.cumsum(i1) - 1
+        d2 = n0 + n1 + jnp.cumsum(i2) - 1
+        dest = jnp.where(is0, d0, jnp.where(is1, d1, d2))
+        return jnp.zeros_like(win).at[dest].set(win, unique_indices=True)
+    order = jnp.argsort(key3.astype(jnp.int8), stable=True)
+    return jnp.take(win, order, axis=0)
+
+
 def packed_go_left(win: jax.Array, feat, thr, dleft,
                    f_numbins, f_missing, f_default, f_col, f_base, f_elide,
                    *, item_bits: int, f_categorical=None,
@@ -1210,10 +1475,19 @@ def resolve_strategy(config: Config, dataset: Dataset,
                      forced: Optional[str] = None) -> str:
     """Growth-strategy selection shared by __init__ and supports():
     compaction pays off once O(N)-per-split masked passes dominate;
-    small data stays on the simpler masked program."""
+    small data stays on the simpler masked program. 'chunk' is the
+    switch-free fixed-chunk formulation (opt-in pending on-chip A/B);
+    it requires the dense histogram pool, so LRU-capped configs fall
+    back to compact."""
     strat = forced or _env("LGBM_TPU_STRATEGY", "auto")
     if strat == "auto":
         strat = "compact" if dataset.num_data >= 65536 else "masked"
+    if strat == "chunk":
+        _, pool_slots = plan_histogram_pool(config, dataset)
+        if pool_slots > 0:
+            # silent here: supports() probes this speculatively; __init__
+            # logs the actual fallback once
+            strat = "compact"
     return strat
 
 
@@ -1315,13 +1589,18 @@ class DeviceTreeLearner:
         # backend; pallas runs interpret mode off-TPU so CI covers the
         # integrated path)
         self._partition_mode = partition_mode_env()
+        requested = strategy or _env("LGBM_TPU_STRATEGY", "auto")
         self.strategy = resolve_strategy(config, dataset, strategy)
+        if requested == "chunk" and self.strategy != "chunk":
+            log.warning("chunk strategy needs the dense histogram pool; "
+                        "using compact (LRU-capped) instead")
         self.window_step = max(2, int(_env("LGBM_TPU_WINDOW_STEP", "4")))
+        self.chunk_rows = max(8192, int(_env("LGBM_TPU_CHUNK", "65536")))
         # LRU-capped histogram pool: when the dense (L,C,B,3) pool would
         # exceed the budget, the compact strategy runs with K LRU slots
         # and rebuilds sibling histograms on miss
         _, self.pool_slots = plan_histogram_pool(config, dataset)
-        if self.strategy == "compact":
+        if self.strategy in ("compact", "chunk"):
             host_codes = (dataset.bundled if dataset.bundled is not None
                           else dataset.binned)
             host_codes = np.asarray(host_codes)
@@ -1503,20 +1782,29 @@ class DeviceTreeLearner:
             log.warning("No further splits with positive gain")
         return self.replay_tree(rec_h, k, rec_cat_h)
 
+    def _grow_fn_kwargs(self):
+        """(grow fn, strategy-specific kwargs) for the packed strategies."""
+        if self.strategy == "chunk":
+            return grow_tree_chunk, dict(
+                c_cols=self.c_cols, item_bits=self.item_bits,
+                chunk_rows=self.chunk_rows,
+                partition=self._partition_mode)
+        return grow_tree_compact, dict(
+            c_cols=self.c_cols, item_bits=self.item_bits,
+            pool_slots=self.pool_slots, window_step=self.window_step,
+            partition=self._partition_mode)
+
     def _run_grow(self, grad, hess, w, base_mask, key):
         """The grow-program invocation; sharded subclasses override this
         single hook and inherit the rest of train()."""
-        if self.strategy == "compact":
-            return grow_tree_compact(
+        if self.strategy in ("compact", "chunk"):
+            grow, kw = self._grow_fn_kwargs()
+            return grow(
                 self.codes_pack, self.codes_row, grad, hess, w, base_mask,
                 self.f_numbins, self.f_missing, self.f_default,
                 self.f_monotone, self.f_penalty, self.f_categorical,
                 self.f_col, self.f_base,
-                self.f_elide, self.hist_idx, key,
-                c_cols=self.c_cols, item_bits=self.item_bits,
-                pool_slots=self.pool_slots, window_step=self.window_step,
-                partition=self._partition_mode,
-                **self._statics())
+                self.f_elide, self.hist_idx, key, **kw, **self._statics())
         return grow_tree(
             self.codes_t, grad, hess, w, base_mask,
             self.f_numbins, self.f_missing, self.f_default,
@@ -1588,8 +1876,11 @@ class DeviceTreeLearner:
         statics = self._statics()
         n = self.dataset.num_data
         cfg = self.config
-        use_compact = self.strategy == "compact"
-        grow = grow_tree_compact if use_compact else grow_tree
+        use_compact = self.strategy in ("compact", "chunk")
+        if use_compact:
+            grow, grow_kw = self._grow_fn_kwargs()
+        else:
+            grow, grow_kw = grow_tree, {}
         meta = (self.f_numbins, self.f_missing, self.f_default,
                 self.f_monotone, self.f_penalty, self.f_categorical,
                 self.f_col, self.f_base,
@@ -1654,11 +1945,7 @@ class DeviceTreeLearner:
                     jnp.take(self.codes_row, bag_idx, axis=0),
                     jnp.take(g, bag_idx), jnp.take(h, bag_idx),
                     jnp.ones((bag_k,), jnp.float32), base_mask,
-                    *meta, tree_key, c_cols=self.c_cols,
-                    item_bits=self.item_bits,
-                    pool_slots=self.pool_slots,
-                    window_step=self.window_step,
-                    partition=self._partition_mode, **statics)
+                    *meta, tree_key, **grow_kw, **statics)
                 leaf_o = route_rows_by_rec(
                     jnp.take(self.codes_pack, oob_idx, axis=0), rec, k,
                     self.f_numbins, self.f_missing, self.f_default,
@@ -1671,11 +1958,7 @@ class DeviceTreeLearner:
             elif use_compact:
                 rec, rec_cat, leaf_id, k, _ = grow(
                     self.codes_pack, self.codes_row, g, h, w, base_mask,
-                    *meta, tree_key, c_cols=self.c_cols,
-                    item_bits=self.item_bits,
-                    pool_slots=self.pool_slots,
-                    window_step=self.window_step,
-                    partition=self._partition_mode, **statics)
+                    *meta, tree_key, **grow_kw, **statics)
             else:
                 rec, rec_cat, leaf_id, k, _ = grow(
                     self.codes_t, g, h, w, base_mask, *meta, tree_key,

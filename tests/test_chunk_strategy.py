@@ -1,0 +1,101 @@
+"""Chunk strategy (switch-free fixed-chunk growth) vs the compact oracle.
+
+Histogram accumulation order differs between the strategies (per-chunk
+partial sums vs one windowed pass), so the equality tests use
+exact-arithmetic gradients — multiples of 0.25 with unit hessians keep
+every partial sum exactly representable in f32 (and in the bf16 hi/lo
+split, whose lo part is exactly zero) — making trees bit-identical
+whenever the algorithms agree.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.device_learner import DeviceTreeLearner
+
+
+def exact_grads(r, n):
+    g = jnp.asarray((r.randint(-8, 9, n) * 0.25).astype(np.float32))
+    h = jnp.asarray(np.ones(n, np.float32))
+    return g, h
+
+
+def grow_tree_with(monkeypatch, strategy, x, y, g, h, params=None,
+                   chunk=8192):
+    monkeypatch.setenv("LGBM_TPU_CHUNK", str(chunk))
+    cfg = Config(dict({"objective": "binary", "num_leaves": 31,
+                       "max_bin": 63, "min_data_in_leaf": 20,
+                       "verbosity": -1}, **(params or {})))
+    ds = Dataset(x, config=cfg, label=y)
+    lrn = DeviceTreeLearner(cfg, ds, strategy=strategy)
+    assert lrn.strategy == strategy
+    return lrn.train(g, h).to_string()
+
+
+def test_chunk_matches_compact_multichunk(monkeypatch):
+    # CH=8192 at n=70000 -> up to 9 chunks per split at the root
+    r = np.random.RandomState(3)
+    n, f = 70000, 7
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+    a = grow_tree_with(monkeypatch, "compact", x, y, g, h)
+    b = grow_tree_with(monkeypatch, "chunk", x, y, g, h)
+    assert a == b
+
+
+def test_chunk_matches_compact_categorical(monkeypatch):
+    r = np.random.RandomState(9)
+    n = 70000
+    x = np.stack([
+        r.randn(n).astype(np.float32),
+        r.randint(0, 12, n).astype(np.float32),   # categorical
+        r.randn(n).astype(np.float32),
+    ], axis=1)
+    y = ((x[:, 0] + (x[:, 1] % 3 == 0) + 0.3 * r.randn(n)) > 0.7) \
+        .astype(np.float64)
+    g, h = exact_grads(r, n)
+    params = {"categorical_feature": "1"}
+    a = grow_tree_with(monkeypatch, "compact", x, y, g, h, params)
+    b = grow_tree_with(monkeypatch, "chunk", x, y, g, h, params)
+    assert a == b
+
+
+def test_chunk_matches_compact_with_missing(monkeypatch):
+    r = np.random.RandomState(4)
+    n, f = 66000, 5
+    x = r.randn(n, f).astype(np.float32)
+    x[r.rand(n, f) < 0.15] = np.nan
+    y = ((np.nan_to_num(x[:, 0]) + 0.4 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+    a = grow_tree_with(monkeypatch, "compact", x, y, g, h)
+    b = grow_tree_with(monkeypatch, "chunk", x, y, g, h)
+    assert a == b
+
+
+def test_chunk_fused_training_end_to_end(monkeypatch):
+    # the production path: lgb.train -> make_fused_step with bagging;
+    # sanity (learns + roundtrips), not bit-parity (sigmoid gradients
+    # are order-sensitive)
+    import lightgbm_tpu as lgb
+    monkeypatch.setenv("LGBM_TPU_STRATEGY", "chunk")
+    monkeypatch.setenv("LGBM_TPU_CHUNK", "16384")
+    r = np.random.RandomState(12)
+    n, f = 70000, 6
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] + 0.5 * x[:, 2] + 0.5 * r.randn(n)) > 0).astype(np.float64)
+    ds = lgb.Dataset(x, y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "bagging_fraction": 0.7,
+                     "bagging_freq": 1}, ds, num_boost_round=4)
+    p = bst.predict(x[:20000])
+    lbl = y[:20000]
+    auc_ranks = np.argsort(np.argsort(p))
+    pos = lbl > 0
+    auc = (auc_ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2) / max(
+        pos.sum() * (~pos).sum(), 1)
+    assert auc > 0.75
+    b2 = lgb.Booster(model_str=bst.model_to_string())
+    assert np.allclose(p, b2.predict(x[:20000]))
