@@ -224,6 +224,59 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
     return node_mask, scan, store_best, scan2, store_best2, _best_row
 
 
+def search2_simple(scan2, best_row):
+    """The unsharded 2-child search: scan both children, format best
+    rows. Sharded modes replace this with election-aware variants of the
+    same signature (search2_rows in grow_tree_compact_core)."""
+    def search2(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2, child_depth):
+        res2, cm2 = scan2(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2)
+        rows = jax.vmap(
+            functools.partial(best_row, child_depth=child_depth))(res2)
+        return rows, cm2
+    return search2
+
+
+def split_epilogue(*, k, key, l, new_id, row, mono_f, best_cat_l,
+                   leaf_min, leaf_max, depth, rec, rec_cat, best, best_cat,
+                   hist_l, hist_r, search2):
+    """The split bookkeeping every growth strategy shares (one copy;
+    divergence here silently forks the strategies): monotone-constraint
+    propagation (basic mode, serial_tree_learner.cpp:771-852), depth
+    update, split-record append, and the two children's re-scan via
+    `search2` (which carries the sharded modes' election when present).
+    Returns the updated (key, leaf_min, leaf_max, depth, rec, rec_cat,
+    best, best_cat)."""
+    mid = (row[B_LOUT] + row[B_ROUT]) * 0.5
+    pmin, pmax = leaf_min[l], leaf_max[l]
+    lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
+    lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
+    rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
+    rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
+    leaf_min = leaf_min.at[l].set(lmin).at[new_id].set(rmin)
+    leaf_max = leaf_max.at[l].set(lmax).at[new_id].set(rmax)
+    child_depth = depth[l] + 1
+    depth = depth.at[l].set(child_depth).at[new_id].set(child_depth)
+
+    rec_row = jnp.concatenate([
+        jnp.stack([l.astype(jnp.float32), row[B_FEAT], row[B_THR],
+                   row[B_DLEFT], row[B_GAIN]]),
+        row[B_LSG:]])
+    rec = rec.at[k].set(rec_row)
+    rec_cat = rec_cat.at[k].set(best_cat_l)
+
+    key, kl, kr = jax.random.split(key, 3)
+    rows2, cm2 = search2(jnp.stack([hist_l, hist_r]),
+                         jnp.stack([row[B_LSG], row[B_RSG]]),
+                         jnp.stack([row[B_LSH], row[B_RSH]]),
+                         jnp.stack([row[B_LCNT], row[B_RCNT]]),
+                         jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
+                         jnp.stack([kl, kr]), child_depth)
+    i2 = jnp.stack([l, new_id])
+    best = best.at[i2].set(rows2)
+    best_cat = best_cat.at[i2].set(cm2)
+    return key, leaf_min, leaf_max, depth, rec, rec_cat, best, best_cat
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "col_bins", "max_depth",
@@ -250,7 +303,7 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
     has_cat = cat_statics is not None
     cat_b = num_bins if has_cat else 1
     gh = jnp.stack([grad * w, hess * w, w], axis=1)     # (N, 3)
-    node_mask, scan, store_best, scan2, store_best2, _ = _tree_helpers(
+    node_mask, scan, store_best, scan2, store_best2, best_row = _tree_helpers(
         base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
         f_elide, hist_idx,
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
@@ -318,36 +371,14 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
         hist_r = c.pool[l] - hist_l
         pool = c.pool.at[l].set(hist_l).at[new_id].set(hist_r)
 
-        # monotone constraint propagation (basic mode)
-        mono_f = f_monotone[feat]
-        mid = (row[B_LOUT] + row[B_ROUT]) * 0.5
-        pmin, pmax = c.leaf_min[l], c.leaf_max[l]
-        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
-        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
-        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
-        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
-        leaf_min = c.leaf_min.at[l].set(lmin).at[new_id].set(rmin)
-        leaf_max = c.leaf_max.at[l].set(lmax).at[new_id].set(rmax)
-        child_depth = c.depth[l] + 1
-        depth = c.depth.at[l].set(child_depth).at[new_id].set(child_depth)
-
-        rec_row = jnp.concatenate([
-            jnp.stack([l.astype(jnp.float32), row[B_FEAT], row[B_THR],
-                       row[B_DLEFT], row[B_GAIN]]),
-            row[B_LSG:]])
-        rec2 = c.rec.at[c.k].set(rec_row)
-        rec_cat2 = c.rec_cat.at[c.k].set(c.best_cat[l])
-
-        key, kl, kr = jax.random.split(c.key, 3)
-        res2, cm2 = scan2(jnp.stack([hist_l, hist_r]),
-                          jnp.stack([row[B_LSG], row[B_RSG]]),
-                          jnp.stack([row[B_LSH], row[B_RSH]]),
-                          jnp.stack([row[B_LCNT], row[B_RCNT]]),
-                          jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
-                          jnp.stack([kl, kr]))
-        best2, best_cat2 = store_best2(b, c.best_cat,
-                                       jnp.stack([l, new_id]), res2, cm2,
-                                       child_depth)
+        (key, leaf_min, leaf_max, depth, rec2, rec_cat2, best2,
+         best_cat2) = split_epilogue(
+            k=c.k, key=c.key, l=l, new_id=new_id, row=row,
+            mono_f=f_monotone[feat], best_cat_l=c.best_cat[l],
+            leaf_min=c.leaf_min, leaf_max=c.leaf_max, depth=c.depth,
+            rec=c.rec, rec_cat=c.rec_cat, best=b, best_cat=c.best_cat,
+            hist_l=hist_l, hist_r=hist_r,
+            search2=search2_simple(scan2, best_row))
         return _Carry(new_id, leaf_id, pool, depth, leaf_min, leaf_max,
                       best2, best_cat2, rec2, rec_cat2, key)
 
@@ -1044,37 +1075,13 @@ def grow_tree_compact_core(
             slot_owner, slot_last = c.slot_owner, c.slot_last
         pool = c.pool.at[s_l].set(hist_l).at[s_r].set(hist_r)
 
-        # monotone propagation + depth (same as masked strategy)
-        mono_f = f_monotone[feat]
-        mid = (row[B_LOUT] + row[B_ROUT]) * 0.5
-        pmin, pmax = c.leaf_min[l], c.leaf_max[l]
-        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
-        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
-        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
-        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
-        leaf_min = c.leaf_min.at[l].set(lmin).at[new_id].set(rmin)
-        leaf_max = c.leaf_max.at[l].set(lmax).at[new_id].set(rmax)
-        child_depth = c.depth[l] + 1
-        depth = c.depth.at[l].set(child_depth).at[new_id].set(child_depth)
-
-        rec_row = jnp.concatenate([
-            jnp.stack([l.astype(jnp.float32), row[B_FEAT], row[B_THR],
-                       row[B_DLEFT], row[B_GAIN]]),
-            row[B_LSG:]])
-        rec2 = c.rec.at[c.k].set(rec_row)
-        rec_cat2 = c.rec_cat.at[c.k].set(c.best_cat[l])
-
-        key, kl, kr = jax.random.split(c.key, 3)
-        rows2, cm2 = search2_rows(jnp.stack([hist_l, hist_r]),
-                                  jnp.stack([row[B_LSG], row[B_RSG]]),
-                                  jnp.stack([row[B_LSH], row[B_RSH]]),
-                                  jnp.stack([row[B_LCNT], row[B_RCNT]]),
-                                  jnp.stack([lmin, rmin]),
-                                  jnp.stack([lmax, rmax]),
-                                  jnp.stack([kl, kr]), child_depth)
-        i2 = jnp.stack([l, new_id])
-        best2 = b.at[i2].set(rows2)
-        best_cat2 = c.best_cat.at[i2].set(cm2)
+        (key, leaf_min, leaf_max, depth, rec2, rec_cat2, best2,
+         best_cat2) = split_epilogue(
+            k=c.k, key=c.key, l=l, new_id=new_id, row=row,
+            mono_f=f_monotone[feat], best_cat_l=c.best_cat[l],
+            leaf_min=c.leaf_min, leaf_max=c.leaf_max, depth=c.depth,
+            rec=c.rec, rec_cat=c.rec_cat, best=b, best_cat=c.best_cat,
+            hist_l=hist_l, hist_r=hist_r, search2=search2_rows)
         return _CarryC(new_id, data, pos_leaf, leaf_begin, leaf_phys,
                        pool, slot_of, slot_owner, slot_last,
                        depth, leaf_min, leaf_max, best2, best_cat2,
@@ -1305,35 +1312,14 @@ def grow_tree_chunk(
             jnp.where((posv >= begin + lphys) & (posv < begin + p),
                       new_id, c.pos_leaf))
 
-        mono_f = f_monotone[feat]
-        mid = (row[B_LOUT] + row[B_ROUT]) * 0.5
-        pmin, pmax = c.leaf_min[l], c.leaf_max[l]
-        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
-        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
-        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
-        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
-        leaf_min = c.leaf_min.at[l].set(lmin).at[new_id].set(rmin)
-        leaf_max = c.leaf_max.at[l].set(lmax).at[new_id].set(rmax)
-        child_depth = c.depth[l] + 1
-        depth = c.depth.at[l].set(child_depth).at[new_id].set(child_depth)
-
-        rec_row = jnp.concatenate([
-            jnp.stack([l.astype(jnp.float32), row[B_FEAT], row[B_THR],
-                       row[B_DLEFT], row[B_GAIN]]),
-            row[B_LSG:]])
-        rec2 = c.rec.at[c.k].set(rec_row)
-        rec_cat2 = c.rec_cat.at[c.k].set(c.best_cat[l])
-
-        key, kl, kr = jax.random.split(c.key, 3)
-        res2, cm2 = scan2(jnp.stack([hist_l, hist_r]),
-                          jnp.stack([row[B_LSG], row[B_RSG]]),
-                          jnp.stack([row[B_LSH], row[B_RSH]]),
-                          jnp.stack([row[B_LCNT], row[B_RCNT]]),
-                          jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]),
-                          jnp.stack([kl, kr]))
-        best2, best_cat2 = store_best2(b, c.best_cat,
-                                       jnp.stack([l, new_id]), res2, cm2,
-                                       child_depth)
+        (key, leaf_min, leaf_max, depth, rec2, rec_cat2, best2,
+         best_cat2) = split_epilogue(
+            k=c.k, key=c.key, l=l, new_id=new_id, row=row,
+            mono_f=f_monotone[feat], best_cat_l=c.best_cat[l],
+            leaf_min=c.leaf_min, leaf_max=c.leaf_max, depth=c.depth,
+            rec=c.rec, rec_cat=c.rec_cat, best=b, best_cat=c.best_cat,
+            hist_l=hist_l, hist_r=hist_r,
+            search2=search2_simple(scan2, best_row))
         return _CarryK(new_id, data, scratch, pos_leaf, leaf_begin,
                        leaf_phys, pool, depth, leaf_min, leaf_max,
                        best2, best_cat2, rec2, rec_cat2, key)

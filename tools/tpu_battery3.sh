@@ -39,4 +39,12 @@ bench "bench 1M partition=scan" 900 LGBM_TPU_PARTITION=scan \
   BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 bench "bench 1M partition=pallas" 900 LGBM_TPU_PARTITION=pallas \
   BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+bench "bench 1M chunk" 900 LGBM_TPU_STRATEGY=chunk \
+  BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+bench "bench 1M chunk+scan" 900 LGBM_TPU_STRATEGY=chunk \
+  LGBM_TPU_PARTITION=scan \
+  BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+bench "bench 1M chunk+pallas-part" 900 LGBM_TPU_STRATEGY=chunk \
+  LGBM_TPU_PARTITION=pallas \
+  BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 echo "=== battery3 done $(date +%H:%M:%S) ===" >> $RES
