@@ -215,13 +215,7 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
         fmask2 = jax.vmap(node_mask)(keys2)
         return jax.vmap(scan)(col_hist2, sg2, sh2, cnt2, mn2, mx2, fmask2)
 
-    def store_best2(best, best_cat, i2, res2: split_ops.SplitResult, cm2,
-                    child_depth):
-        rows = jax.vmap(functools.partial(_best_row,
-                                          child_depth=child_depth))(res2)
-        return best.at[i2].set(rows), best_cat.at[i2].set(cm2)
-
-    return node_mask, scan, store_best, scan2, store_best2, _best_row
+    return node_mask, scan, store_best, scan2, _best_row
 
 
 def search2_simple(scan2, best_row):
@@ -303,7 +297,7 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
     has_cat = cat_statics is not None
     cat_b = num_bins if has_cat else 1
     gh = jnp.stack([grad * w, hess * w, w], axis=1)     # (N, 3)
-    node_mask, scan, store_best, scan2, store_best2, best_row = _tree_helpers(
+    node_mask, scan, store_best, scan2, best_row = _tree_helpers(
         base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
         f_elide, hist_idx,
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
@@ -557,7 +551,7 @@ def grow_tree_compact_core(
         # the reference scales the local gates by machine count
         # (voting_parallel_tree_learner.cpp:57-59)
         d_v = jax.lax.psum(1, axis_name)
-        (node_mask, _, _, _, _, best_row) = _tree_helpers(
+        (node_mask, _, _, _, best_row) = _tree_helpers(
             base_mask, f_numbins, f_missing, f_default, f_monotone,
             f_penalty, f_elide, hist_idx, **helper_kwargs)
         scan_kwargs_local = dict(
@@ -710,7 +704,7 @@ def grow_tree_compact_core(
                 cm2 = jnp.stack([p[1] for p in pairs])
             return rows2, cm2
     elif not sliced:
-        (node_mask, scan, store_best, scan2, store_best2,
+        (node_mask, scan, store_best, scan2,
          best_row) = _tree_helpers(
             base_mask, f_numbins, f_missing, f_default, f_monotone,
             f_penalty, f_elide, hist_idx,
@@ -724,12 +718,7 @@ def grow_tree_compact_core(
             res, cm = scan(col_hist, sg, sh, cnt, mn, mx, node_mask(key))
             return best_row(res, child_depth), cm
 
-        def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
-                         child_depth):
-            res2, cm2 = scan2(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2)
-            return jax.vmap(
-                functools.partial(best_row,
-                                  child_depth=child_depth))(res2), cm2
+        search2_rows = search2_simple(scan2, best_row)
     else:
         # feature-sliced scan: every shard searches only the columns it
         # owns (after the reduce-scatter in scatter mode; built directly
@@ -769,7 +758,7 @@ def grow_tree_compact_core(
         hi_local = jnp.where(
             jnp.arange(col_bins, dtype=jnp.int32)[None, :] < nb_sl[:, None],
             hi_local, cs * col_bins)
-        (_, scan_sl, _, _, _, best_row) = _tree_helpers(
+        (_, scan_sl, _, _, best_row) = _tree_helpers(
             mask_sl, nb_sl, miss_sl, def_sl, mono_sl, pen_sl, elide_sl,
             hi_local, f_categorical=cat_sl, cat_statics=cat_statics,
             **helper_kwargs)
@@ -1179,7 +1168,7 @@ def grow_tree_chunk(
         max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
         bynode_k=bynode_k)
-    (node_mask, scan, store_best, scan2, store_best2,
+    (node_mask, scan, store_best, scan2,
      best_row) = _tree_helpers(
         base_mask, f_numbins, f_missing, f_default, f_monotone,
         f_penalty, f_elide, hist_idx,
@@ -1580,7 +1569,10 @@ class DeviceTreeLearner:
         if requested == "chunk" and self.strategy != "chunk":
             log.warning("chunk strategy needs the dense histogram pool; "
                         "using compact (LRU-capped) instead")
-        self.window_step = max(2, int(_env("LGBM_TPU_WINDOW_STEP", "4")))
+        # default 2 measured fastest on-chip (754k vs 679k row-trees/s at
+        # step 4, 1M x 255 leaves — docs/DESIGN.md 6a-r3): the tighter
+        # ladder's lower window inflation beats its extra compile time
+        self.window_step = max(2, int(_env("LGBM_TPU_WINDOW_STEP", "2")))
         self.chunk_rows = max(8192, int(_env("LGBM_TPU_CHUNK", "65536")))
         # LRU-capped histogram pool: when the dense (L,C,B,3) pool would
         # exceed the budget, the compact strategy runs with K LRU slots
