@@ -23,6 +23,15 @@ import time
 
 import numpy as np
 
+# persistent XLA compile cache: repeated bench invocations (the A/B
+# battery, driver re-runs) share compiled programs instead of paying the
+# 2-3 min trace+compile of the growth ladder every process
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".jax_compile_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
 N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 BACKEND_INIT_TIMEOUT = int(os.environ.get("BENCH_BACKEND_TIMEOUT", 120))
 
