@@ -118,44 +118,32 @@ def per_feature_best(
     is_zero = (feature_missing[:, None] == 1)
     default_b = feature_default_bins[:, None]
 
-    g = hist[:, :, 0]
-    h = hist[:, :, 1]
-    c = hist[:, :, 2]
+    # The (grad, hess, count) channels ride one (F, B, 3) array through
+    # the accumulations and the two missing-directions stack into one
+    # leading axis, so the whole sweep is 2 cumsums + one stacked gain
+    # chain instead of 6 + 2 — this chain runs per split inside the
+    # whole-tree loop, where op count is latency (docs/DESIGN.md 6a-r3).
+    # Element-wise order is unchanged, so results are bit-identical.
 
     # Zero-missing mode: the default bin never enters either accumulation,
     # so its mass rides with `parent - accumulated`, i.e. the missing side.
     skip = is_zero & (tgrid == default_b)
-    g_eff = jnp.where(skip, 0.0, g)
-    h_eff = jnp.where(skip, 0.0, h)
-    c_eff = jnp.where(skip, 0.0, c)
+    eff = jnp.where(skip[:, :, None], 0.0, hist)
 
     # dir=+1: left = prefix over bins [0..t]
-    gl1 = jnp.cumsum(g_eff, axis=1)
-    hl1 = jnp.cumsum(h_eff, axis=1)
-    cl1 = jnp.cumsum(c_eff, axis=1)
+    pre = jnp.cumsum(eff, axis=1)                            # (F, B, 3)
 
     # dir=-1: right = suffix over bins [t+1 .. last], where `last` excludes
     # the NaN bin (so NaN goes left). suffix[t] computed via reversed cumsum.
     nan_excl = is_nan & (tgrid >= nbins - 1)                  # NaN bin mask
-    g_m1 = jnp.where(nan_excl, 0.0, g_eff)
-    h_m1 = jnp.where(nan_excl, 0.0, h_eff)
-    c_m1 = jnp.where(nan_excl, 0.0, c_eff)
-    # suffix sums: sum over j > t
-    gr_m1 = jnp.cumsum(g_m1[:, ::-1], axis=1)[:, ::-1] - g_m1
-    hr_m1 = jnp.cumsum(h_m1[:, ::-1], axis=1)[:, ::-1] - h_m1
-    cr_m1 = jnp.cumsum(c_m1[:, ::-1], axis=1)[:, ::-1] - c_m1
+    m1_eff = jnp.where(nan_excl[:, :, None], 0.0, eff)
+    # strict suffix sums: sum over j > t
+    suf = jnp.cumsum(m1_eff[:, ::-1, :], axis=1)[:, ::-1, :] - m1_eff
 
-    def eval_dir(left_g, left_h, left_c, t_valid):
-        right_g = sum_grad - left_g
-        right_h = sum_hess - left_h
-        right_c = num_data - left_c
-        ok = (t_valid
-              & (left_c >= min_data_in_leaf) & (right_c >= min_data_in_leaf)
-              & (left_h >= min_sum_hessian) & (right_h >= min_sum_hessian))
-        gains = _split_gains(left_g, left_h, right_g, right_h, l1, l2,
-                             max_delta_step, min_constraint, max_constraint,
-                             monotone[:, None])
-        return jnp.where(ok, gains, NEG_INF)
+    totals = jnp.stack([sum_grad, sum_hess, num_data])       # (3,)
+    # left sums per direction: p1 = prefix; m1 = total - suffix
+    left2 = jnp.stack([pre, totals[None, None, :] - suf])    # (2, F, B, 3)
+    right2 = totals[None, None, None, :] - left2
 
     # valid threshold ranges per feature (reference loop bounds):
     #   dir=+1: t in [0, nb-2]; NaN mode unchanged (NaN bin can sit alone
@@ -164,12 +152,21 @@ def per_feature_best(
     #           be empty at nb-2 since NaN is excluded there).
     base_valid = (tgrid < nbins - 1) & feature_mask[:, None] & (nbins > 1)
     zero_skip_t = is_zero & (tgrid == default_b)               # not a candidate
-    valid_p1 = base_valid & ~zero_skip_t
-    valid_m1 = base_valid & ~zero_skip_t & ~(is_nan & (tgrid >= nbins - 2))
+    valid2 = jnp.stack([base_valid & ~zero_skip_t,
+                        base_valid & ~zero_skip_t
+                        & ~(is_nan & (tgrid >= nbins - 2))])   # (2, F, B)
 
-    gains_p1 = eval_dir(gl1, hl1, cl1, valid_p1)
-    gains_m1 = eval_dir(sum_grad - gr_m1, sum_hess - hr_m1,
-                        num_data - cr_m1, valid_m1)
+    ok2 = (valid2
+           & (left2[..., 2] >= min_data_in_leaf)
+           & (right2[..., 2] >= min_data_in_leaf)
+           & (left2[..., 1] >= min_sum_hessian)
+           & (right2[..., 1] >= min_sum_hessian))
+    gains2 = _split_gains(left2[..., 0], left2[..., 1],
+                          right2[..., 0], right2[..., 1], l1, l2,
+                          max_delta_step, min_constraint, max_constraint,
+                          monotone[None, :, None])
+    gains2 = jnp.where(ok2, gains2, NEG_INF)
+    gains_p1, gains_m1 = gains2[0], gains2[1]
 
     gain_shift = leaf_split_gain(sum_grad, sum_hess, l1, l2, max_delta_step)
     min_gain_shift = gain_shift + min_gain_to_split
@@ -204,7 +201,7 @@ def per_feature_best(
         per_feature_rel = jnp.where(per_feature_rel > NEG_INF / 2,
                                     per_feature_rel - feature_cost,
                                     per_feature_rel)
-    prefix = (gl1, hl1, cl1, gr_m1, hr_m1, cr_m1)
+    prefix = (pre, suf)
     return per_feature_rel, per_feature_t, use_m1, prefix
 
 
@@ -213,13 +210,13 @@ def materialize_split(feat, per_feature_rel, per_feature_t, use_m1, prefix,
                       min_constraint, max_constraint,
                       *, l1, l2, max_delta_step) -> SplitResult:
     """Build the full SplitResult for one chosen feature."""
-    gl1, hl1, cl1, gr_m1, hr_m1, cr_m1 = prefix
+    pre, suf = prefix
     gain = per_feature_rel[feat]
     thr = per_feature_t[feat]
     dleft = use_m1[feat]
-    lg = jnp.where(dleft, sum_grad - gr_m1[feat, thr], gl1[feat, thr])
-    lh = jnp.where(dleft, sum_hess - hr_m1[feat, thr], hl1[feat, thr])
-    lc = jnp.where(dleft, num_data - cr_m1[feat, thr], cl1[feat, thr])
+    lg = jnp.where(dleft, sum_grad - suf[feat, thr, 0], pre[feat, thr, 0])
+    lh = jnp.where(dleft, sum_hess - suf[feat, thr, 1], pre[feat, thr, 1])
+    lc = jnp.where(dleft, num_data - suf[feat, thr, 2], pre[feat, thr, 2])
     rg = sum_grad - lg
     rh = sum_hess - lh
     rc = num_data - lc
@@ -339,54 +336,46 @@ def per_feature_best_categorical(
     oh_t = jnp.argmax(oh_gains, axis=1).astype(jnp.int32)
 
     # ---- sorted mode ----------------------------------------------------
+    # (g, h, c) ride one (F, B, 3) array through the sort-gather, the
+    # roll and the cumsum, and the two walk directions stack on a
+    # leading axis — one gather + one cumsum + one gain chain instead of
+    # 3/6/2 (bit-identical; this runs per split in the device loop)
     eff_l2 = l2 + cat_l2
     valid_sorted = bin_ok & (c >= cat_smooth)
     ctr = jnp.where(valid_sorted, g / (h + cat_smooth), jnp.inf)
     order = jnp.argsort(ctr, axis=1)                    # (F, B) bins by ctr
-    g_s = jnp.take_along_axis(g, order, axis=1)
-    h_s = jnp.take_along_axis(h, order, axis=1)
-    c_s = jnp.take_along_axis(c, order, axis=1)
+    hs = jnp.take_along_axis(hist, order[:, :, None], axis=1)
     v_s = jnp.take_along_axis(valid_sorted, order, axis=1)
     n_valid = jnp.sum(v_s.astype(jnp.int32), axis=1, keepdims=True)
-    g_s = jnp.where(v_s, g_s, 0.0)
-    h_s = jnp.where(v_s, h_s, 0.0)
-    c_s = jnp.where(v_s, c_s, 0.0)
+    hs = jnp.where(v_s[:, :, None], hs, 0.0)
     max_num_cat = jnp.minimum(max_cat_threshold, (n_valid + 1) // 2)
     pos = jnp.arange(b, dtype=jnp.int32)[None, :]
 
-    def sorted_dir(gd, hd, cd, vd):
-        gl = jnp.cumsum(gd, axis=1)
-        hl = jnp.cumsum(hd, axis=1)
-        cl = jnp.cumsum(cd, axis=1)
-        ok = (vd & (pos < max_num_cat)
-              & (cl >= min_data_in_leaf) & (hl >= min_sum_hessian)
-              & ((num_data - cl) >= jnp.maximum(min_data_in_leaf, min_data_per_group))
-              & ((sum_hess - hl) >= min_sum_hessian))
-        gains = gains_for(gl, hl, eff_l2, ok)
-        gains = jnp.where(gains > min_gain_shift, gains, NEG_INF)
-        best = jnp.max(gains, axis=1)
-        ti = jnp.argmax(gains, axis=1).astype(jnp.int32)
-        return best, ti
-
-    fwd_best, fwd_t = sorted_dir(g_s, h_s, c_s, v_s)
-    # dir=-1: walk from the high-ctr end; reverse only the valid prefix by
-    # flipping the whole sorted arrays (invalid entries are zero / masked)
-    g_r = g_s[:, ::-1]
-    h_r = h_s[:, ::-1]
-    c_r = c_s[:, ::-1]
-    v_r = v_s[:, ::-1]
-    # rotate so valid entries lead: valid entries sit at the tail after flip
+    # dir=-1 walks from the high-ctr end: flip, then rotate so valid
+    # entries lead (they sit at the tail after the flip)
     shift = b - n_valid[:, 0]
+    roll_idx = (pos + shift[:, None]) % b
 
     def roll_rows(x):
-        idx = (pos + shift[:, None]) % b
-        return jnp.take_along_axis(x, idx, axis=1)
+        return jnp.take_along_axis(x, roll_idx, axis=1)
 
-    g_r = roll_rows(g_r)
-    h_r = roll_rows(h_r)
-    c_r = roll_rows(c_r)
-    v_r = roll_rows(v_r)
-    bwd_best, bwd_t = sorted_dir(g_r, h_r, c_r, v_r)
+    hr = jnp.take_along_axis(hs[:, ::-1, :], roll_idx[:, :, None], axis=1)
+    v_r = roll_rows(v_s[:, ::-1])
+
+    hd2 = jnp.stack([hs, hr])                           # (2, F, B, 3)
+    vd2 = jnp.stack([v_s, v_r])
+    left2 = jnp.cumsum(hd2, axis=2)
+    gl2, hl2, cl2 = left2[..., 0], left2[..., 1], left2[..., 2]
+    ok2 = (vd2 & (pos < max_num_cat)
+           & (cl2 >= min_data_in_leaf) & (hl2 >= min_sum_hessian)
+           & ((num_data - cl2)
+              >= jnp.maximum(min_data_in_leaf, min_data_per_group))
+           & ((sum_hess - hl2) >= min_sum_hessian))
+    gains2 = gains_for(gl2, hl2, eff_l2, ok2)
+    gains2 = jnp.where(gains2 > min_gain_shift, gains2, NEG_INF)
+    best2 = jnp.max(gains2, axis=2)
+    ti2 = jnp.argmax(gains2, axis=2).astype(jnp.int32)
+    (fwd_best, bwd_best), (fwd_t, bwd_t) = best2, ti2
 
     use_fwd = fwd_best >= bwd_best
     sort_best = jnp.where(use_fwd, fwd_best, bwd_best)
