@@ -3,7 +3,7 @@
 #include <cstddef>
 typedef void* SEXP;
 extern "C" {
-SEXP R_NilValue;
+extern SEXP R_NilValue;
 typedef void (*R_CFinalizer_t)(SEXP);
 SEXP R_MakeExternalPtr(void*, SEXP, SEXP);
 void* R_ExternalPtrAddr(SEXP);
