@@ -1122,6 +1122,32 @@ def grow_tree_chunk(
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         partition: str = "sort", chunk_rows: int = 65536,
         cat_statics=None):
+    return grow_tree_chunk_core(
+        codes_pack, codes_row, grad, hess, w, base_mask,
+        f_numbins, f_missing, f_default, f_monotone, f_penalty,
+        f_categorical, f_col, f_base, f_elide, hist_idx, rng_key,
+        c_cols=c_cols, item_bits=item_bits, num_leaves=num_leaves,
+        num_bins=num_bins, col_bins=col_bins, max_depth=max_depth,
+        l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
+        use_pallas=use_pallas, partition=partition, chunk_rows=chunk_rows,
+        axis_name=None, cat_statics=cat_statics)
+
+
+def grow_tree_chunk_core(
+        codes_pack: jax.Array, codes_row: jax.Array,
+        grad: jax.Array, hess: jax.Array, w: jax.Array,
+        base_mask: jax.Array,
+        f_numbins, f_missing, f_default, f_monotone, f_penalty,
+        f_categorical, f_col, f_base, f_elide, hist_idx, rng_key,
+        *, c_cols: int, item_bits: int,
+        num_leaves: int, num_bins: int, col_bins: int, max_depth: int,
+        l1: float, l2: float, max_delta_step: float,
+        min_data_in_leaf: int, min_sum_hessian: float,
+        min_gain_to_split: float, bynode_k: int, use_pallas: bool,
+        partition: str = "sort", chunk_rows: int = 65536,
+        axis_name=None, cat_statics=None):
     """Switch-free whole-tree growth over fixed-size chunks.
 
     The compact strategy resolves dynamic leaf sizes with a lax.switch
@@ -1151,7 +1177,13 @@ def grow_tree_chunk(
         carry partition key 2 and are never written.
     The smaller child's histogram accumulates over its chunks after the
     move (sibling = parent - smaller, FeatureHistogram::Subtract).
-    Sharded modes and the LRU-capped pool stay on the compact strategy.
+
+    axis_name enables the data-parallel psum mode (rows sharded; the
+    root and smaller-child histograms psum-replicate and every shard
+    runs the identical scan — the compact core's non-sliced reduction,
+    reference data_parallel_tree_learner.cpp:149-164 in its replicated
+    rendering). The scatter/feature/voting reductions and the
+    LRU-capped pool stay on the compact strategy.
     """
     from ..ops.histogram import build_histogram
     n = grad.shape[0]
@@ -1182,6 +1214,8 @@ def grow_tree_chunk(
         [data0, jnp.zeros((CH, d_cols), jnp.uint32)], axis=0)
 
     hist0 = build_histogram(codes_row, gh, col_bins, use_pallas=use_pallas)
+    if axis_name is not None:
+        hist0 = jax.lax.psum(hist0, axis_name)
     totals = hist0[0].sum(axis=0)
     root_key, loop_key = jax.random.split(rng_key)
     root_res, root_cm = scan(hist0, totals[0], totals[1], totals[2],
@@ -1287,6 +1321,8 @@ def grow_tree_chunk(
         hist_small = jax.lax.fori_loop(
             0, -(-sc // CH), pass_h,
             jnp.zeros((c_cols, col_bins, 3), jnp.float32))
+        if axis_name is not None:
+            hist_small = jax.lax.psum(hist_small, axis_name)
 
         sibling = c.pool[l] - hist_small
         hist_l = jnp.where(left_small, hist_small, sibling)

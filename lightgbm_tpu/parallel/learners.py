@@ -485,17 +485,34 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     reduced histograms). No host round-trips inside a tree.
     """
 
+    # voting overrides to False: its 2-stage election lives in the
+    # compact core's reduction seams only
+    _chunk_capable = True
+
     def __init__(self, config: Config, dataset: Dataset,
                  mesh: Optional[Mesh] = None):
-        super().__init__(config, dataset, strategy="compact",
+        # LGBM_TPU_STRATEGY=chunk opts the sharded program into the
+        # switch-free chunk core (psum reduction only); anything else
+        # runs compact. resolve_strategy may fall chunk back to compact
+        # (LRU-capped pool), so read the resolved value afterwards.
+        import os
+        want = os.environ.get("LGBM_TPU_STRATEGY", "auto")
+        use_chunk = want == "chunk" and self._chunk_capable
+        if want == "chunk" and not self._chunk_capable:
+            log.warning("%s does not support the chunk strategy; "
+                        "using compact", type(self).__name__)
+        super().__init__(config, dataset,
+                         strategy="chunk" if use_chunk else "compact",
                          device_place=False)
         self.mesh = mesh or make_mesh(axis_name="data")
         self.shards = int(self.mesh.devices.size)
         # reduce-scatter mode needs the identity feature->column mapping
-        # and shard-independent feature masks (see grow_tree_compact_core)
+        # and shard-independent feature masks (see grow_tree_compact_core);
+        # the chunk core reduces by psum only
         mode = dp_reduce_mode_env()
         self.scatter_cols = (
             self.shards if (mode != "psum"
+                            and self.strategy != "chunk"
                             and dataset.bundle_arrays() is None
                             and not (0.0 < config.feature_fraction_bynode
                                      < 1.0)
@@ -523,6 +540,11 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
 
     # ------------------------------------------------------------------
     def _grow_statics(self):
+        if self.strategy == "chunk":
+            return dict(c_cols=self.c_cols, item_bits=self.item_bits,
+                        chunk_rows=self.chunk_rows,
+                        partition=self._partition_mode,
+                        **self._statics())
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
                     scatter_cols=self.scatter_cols,
@@ -542,7 +564,8 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         the reference's distributed behavior (BaggingHelper runs on each
         machine's local partition, goss.hpp:60-117 under num_machines>1),
         so no global top-k collective is needed."""
-        from ..models.device_learner import grow_tree_compact_core
+        from ..models.device_learner import (grow_tree_chunk_core, grow_tree_compact_core)
+        grow_core = (grow_tree_chunk_core if self.strategy == "chunk" else grow_tree_compact_core)
         statics = self._grow_statics()
         meta = self._meta
         cfg = self.config
@@ -608,7 +631,7 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                     w_l = alive.astype(jnp.float32)
             else:
                 w_l = w_or_key * alive.astype(jnp.float32)
-            rec, rec_cat, leaf_id, ks, tot = grow_tree_compact_core(
+            rec, rec_cat, leaf_id, ks, tot = grow_core(
                 cp_l, cr_l, g_l, h_l, w_l, base_mask, *meta, key,
                 axis_name="data", **statics)
             # rec_cat (the categorical winners' left-bin masks) is
@@ -720,6 +743,8 @@ class DeviceVotingParallelTreeLearner(DeviceDataParallelTreeLearner):
     reduction of ONLY the elected 2k features' histograms
     (voting_parallel_tree_learner.cpp:170-260). Communication per split
     is O(2k*B), constant in feature count."""
+
+    _chunk_capable = False
 
     def __init__(self, config: Config, dataset: Dataset,
                  mesh: Optional[Mesh] = None):

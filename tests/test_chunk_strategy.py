@@ -75,6 +75,36 @@ def test_chunk_matches_compact_with_missing(monkeypatch):
     assert a == b
 
 
+def test_chunk_data_parallel_matches_compact_psum(monkeypatch):
+    # the sharded chunk core (psum reduction) must grow the identical
+    # tree as the compact core's psum mode on the virtual 8-device mesh
+    from lightgbm_tpu.parallel.learners import DeviceDataParallelTreeLearner
+
+    r = np.random.RandomState(6)
+    n, f = 70000, 6
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] - 0.4 * x[:, 2] + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+
+    def grow(strategy):
+        monkeypatch.setenv("LGBM_TPU_DP_REDUCE", "psum")
+        monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+        if strategy == "chunk":
+            monkeypatch.setenv("LGBM_TPU_STRATEGY", "chunk")
+        else:
+            monkeypatch.delenv("LGBM_TPU_STRATEGY", raising=False)
+        cfg = Config({"objective": "binary", "num_leaves": 31,
+                      "max_bin": 63, "min_data_in_leaf": 20,
+                      "verbosity": -1})
+        ds = Dataset(x, config=cfg, label=y)
+        lrn = DeviceDataParallelTreeLearner(cfg, ds)
+        assert lrn.strategy == strategy
+        assert lrn.scatter_cols == 0
+        return lrn.train(g, h).to_string()
+
+    assert grow("chunk") == grow("compact")
+
+
 def test_chunk_fused_training_end_to_end(monkeypatch):
     # the production path: lgb.train -> make_fused_step with bagging;
     # sanity (learns + roundtrips), not bit-parity (sigmoid gradients
