@@ -1605,6 +1605,15 @@ class DeviceTreeLearner:
         if requested == "chunk" and self.strategy != "chunk":
             log.warning("chunk strategy needs the dense histogram pool; "
                         "using compact (LRU-capped) instead")
+        if (self.strategy == "masked" and dataset.num_data >= 262144
+                and int(config.num_leaves) >= 127):
+            # the masked program's compile blew past 19 minutes at
+            # 1M x 255 on the tunneled TPU (round-3 battery log); auto
+            # never picks it at this scale, so this is an explicit opt-in
+            log.warning(
+                "masked strategy at %d rows x %d leaves compiles very "
+                "slowly; compact or chunk is strongly recommended",
+                dataset.num_data, int(config.num_leaves))
         # default 2 measured fastest on-chip (754k vs 679k row-trees/s at
         # step 4, 1M x 255 leaves — docs/DESIGN.md 6a-r3): the tighter
         # ladder's lower window inflation beats its extra compile time
