@@ -64,31 +64,44 @@ EVAL_EVERY = int(os.environ.get("BENCH_EVAL_EVERY", 10))
 N_VALID = int(os.environ.get("BENCH_VALID_ROWS", 100_000))
 
 
-def make_higgs_like(n, f, seed=17, w=None):
+N_CAT = int(os.environ.get("BENCH_CAT_FEATURES", 0))
+CAT_CARD = int(os.environ.get("BENCH_CAT_CARD", 64))
+
+
+def make_higgs_like(n, f, seed=17, w=None, n_cat=0, card=64):
     """Synthetic stand-in with Higgs-like statistics: mixed informative /
     noise features, moderately separable classes. Pass `w` to draw a new
     sample from the SAME ground-truth function (e.g. a held-out valid set)
-    without perturbing the default stream, which is bit-identical to the
-    rounds 1-2 training sets."""
+    without perturbing the default stream, which (at n_cat=0) is
+    bit-identical to the rounds 1-2 training sets. n_cat > 0 converts the
+    LAST n_cat columns into categorical features (cardinality `card`)
+    with per-category target effects — the Expo/Allstate-style
+    categorical-heavy shape (reference docs/Experiments.rst datasets)."""
     r = np.random.RandomState(seed)
     x = r.randn(n, f).astype(np.float32)
     if w is None:
-        w = r.randn(f) * (r.rand(f) > 0.4)
-    logit = x @ w * 0.3 + 0.2 * x[:, 0] * x[:, 1] - 0.1 * x[:, 2] ** 2
+        w_num = r.randn(f) * (r.rand(f) > 0.4)
+        cat_tables = [r.randn(card) * 0.5 for _ in range(n_cat)]
+        w = (w_num, cat_tables)
+    w_num, cat_tables = w
+    logit = x @ w_num * 0.3 + 0.2 * x[:, 0] * x[:, 1] - 0.1 * x[:, 2] ** 2
+    for j in range(len(cat_tables)):
+        cats = r.randint(0, card, n)
+        x[:, f - len(cat_tables) + j] = cats
+        logit += cat_tables[j][cats]
     y = (logit + r.randn(n) * 1.5 > 0).astype(np.float64)
     return x, y, w
 
 
 def host_predict_raw(models, x):
-    """Vectorized numpy ensemble traversal (numerical splits, no NaN —
-    exactly this bench's data). Keeps ALL evaluation off the device: a
-    mid-training predict would otherwise compile a fresh ensemble
-    program per tree-count through the TPU tunnel, which round 3
-    observed blocking for >10 min and wedging the axon client."""
+    """Vectorized numpy ensemble traversal (numerical + categorical
+    bitset splits; no-NaN data — exactly this bench's generator). Keeps
+    ALL evaluation off the device: a mid-training predict would
+    otherwise compile a fresh ensemble program per tree-count through
+    the TPU tunnel, which round 3 observed blocking for >10 min and
+    wedging the axon client."""
     out = np.zeros(x.shape[0], dtype=np.float64)
     for t in models:
-        assert not t.cat_boundaries_inner[-1], \
-            "host_predict_raw handles numerical splits only"
         if t.num_leaves <= 1:
             out += float(t.leaf_value[0])
             continue
@@ -97,13 +110,31 @@ def host_predict_raw(models, x):
         lc = np.asarray(t.left_child, dtype=np.int32)
         rc = np.asarray(t.right_child, dtype=np.int32)
         lv = np.asarray(t.leaf_value, dtype=np.float64)
+        iscat = (np.asarray(t.decision_type, dtype=np.int32) & 1) != 0
+        cat_lo = np.asarray(t.cat_boundaries, dtype=np.int64)
+        cat_words = np.asarray(t.cat_threshold or [0], dtype=np.uint32)
         node = np.zeros(x.shape[0], dtype=np.int32)
         active = np.ones(x.shape[0], dtype=bool)
         while active.any():
             idx = np.nonzero(active)[0]
             nd = node[idx]
             v = x[idx, sf[nd]]
-            node[idx] = np.where(v <= thr[nd], lc[nd], rc[nd])
+            go_left = v <= thr[nd]
+            cn = iscat[nd]
+            if cn.any():
+                # categorical bitset routing (tree._cat_contains,
+                # vectorized): out-of-range or negative values go right
+                ci = thr[nd].astype(np.int64)
+                vi = np.where(cn & (v >= 0), v, 0).astype(np.int64)
+                word = vi // 32
+                nwords = cat_lo[np.clip(ci + 1, 0, len(cat_lo) - 1)] \
+                    - cat_lo[np.clip(ci, 0, len(cat_lo) - 1)]
+                inb = cn & (v >= 0) & (word < nwords)
+                wofs = np.clip(cat_lo[np.clip(ci, 0, len(cat_lo) - 1)]
+                               + word, 0, len(cat_words) - 1)
+                bit = (cat_words[wofs] >> (vi % 32).astype(np.uint32)) & 1
+                go_left = np.where(cn, inb & (bit == 1), go_left)
+            node[idx] = np.where(go_left, lc[nd], rc[nd])
             active[idx] = node[idx] >= 0
         out += lv[~node]
     return out
@@ -148,8 +179,10 @@ def main():
     # 22M row-trees/s TPU-vs-CPU baseline: flag it machine-readably
     degraded = backend in ("cpu", "cpu-fallback")
     n_valid = min(N_VALID, max(N_ROWS // 10, 1000))
-    x, y, w_true = make_higgs_like(N_ROWS, N_FEATURES)
-    xv, yv, _ = make_higgs_like(n_valid, N_FEATURES, seed=4242, w=w_true)
+    x, y, w_true = make_higgs_like(N_ROWS, N_FEATURES, n_cat=N_CAT,
+                                   card=CAT_CARD)
+    xv, yv, _ = make_higgs_like(n_valid, N_FEATURES, seed=4242, w=w_true,
+                                n_cat=N_CAT, card=CAT_CARD)
     params = {
         "objective": "binary",
         "num_leaves": num_leaves,
@@ -159,7 +192,8 @@ def main():
         "verbosity": -1,
         "min_data_in_leaf": 20,
     }
-    ds = lgb.Dataset(x, y)
+    cat_cols = list(range(N_FEATURES - N_CAT, N_FEATURES)) if N_CAT else []
+    ds = lgb.Dataset(x, y, categorical_feature=cat_cols or None)
     ds.construct()
     sys.stderr.write(f"setup {time.time()-t_setup:.1f}s\n")
 
@@ -241,6 +275,7 @@ def main():
         "rows": N_ROWS,
         "iters": N_ITERS,
         "num_leaves": num_leaves,
+        "cat_features": N_CAT,
         "valid_auc": round(valid_auc, 5),
         "auc_target": AUC_TARGET,
         "sec_to_auc": sec_to_auc,
