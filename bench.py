@@ -84,6 +84,12 @@ def make_higgs_like(n, f, seed=17, w=None, n_cat=0, card=64):
         cat_tables = [r.randn(card) * 0.5 for _ in range(n_cat)]
         w = (w_num, cat_tables)
     w_num, cat_tables = w
+    if cat_tables:
+        # categorical columns must not leak their pre-overwrite Gaussian
+        # draws into the label (unobservable noise would depress the
+        # categorical run's AUC)
+        w_num = w_num.copy()
+        w_num[f - len(cat_tables):] = 0.0
     logit = x @ w_num * 0.3 + 0.2 * x[:, 0] * x[:, 1] - 0.1 * x[:, 2] ** 2
     for j in range(len(cat_tables)):
         cats = r.randint(0, card, n)

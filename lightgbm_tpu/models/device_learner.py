@@ -54,7 +54,7 @@ from ..ops import split as split_ops
 from ..ops.partition import decide_left
 from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
 from ..utils import log
-from ..utils.envs import partition_mode_env, use_pallas_env
+from ..utils.envs import flag, partition_mode_env, use_pallas_env
 from .tree import Tree
 
 NEG_INF = split_ops.NEG_INF
@@ -1108,7 +1108,7 @@ class _CarryK(NamedTuple):
     static_argnames=("c_cols", "item_bits",
                      "num_leaves", "num_bins", "col_bins", "max_depth",
                      "bynode_k", "use_pallas", "partition",
-                     "chunk_rows", "cat_statics"))
+                     "chunk_rows", "fuse_hist", "cat_statics"))
 def grow_tree_chunk(
         codes_pack: jax.Array, codes_row: jax.Array,
         grad: jax.Array, hess: jax.Array, w: jax.Array,
@@ -1121,7 +1121,7 @@ def grow_tree_chunk(
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         partition: str = "sort", chunk_rows: int = 65536,
-        cat_statics=None):
+        fuse_hist: bool = True, cat_statics=None):
     return grow_tree_chunk_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -1132,7 +1132,7 @@ def grow_tree_chunk(
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
         use_pallas=use_pallas, partition=partition, chunk_rows=chunk_rows,
-        axis_name=None, cat_statics=cat_statics)
+        fuse_hist=fuse_hist, axis_name=None, cat_statics=cat_statics)
 
 
 def grow_tree_chunk_core(
@@ -1147,7 +1147,7 @@ def grow_tree_chunk_core(
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         partition: str = "sort", chunk_rows: int = 65536,
-        axis_name=None, cat_statics=None):
+        fuse_hist: bool = True, axis_name=None, cat_statics=None):
     """Switch-free whole-tree growth over fixed-size chunks.
 
     The compact strategy resolves dynamic leaf sizes with a lax.switch
@@ -1256,11 +1256,28 @@ def grow_tree_chunk_core(
         begin = c.leaf_begin[l]
         p = c.leaf_phys[l]
         nch = -(-p // CH)
+        # the GLOBALLY smaller child (replicated record counts) decides
+        # which side's rows accumulate the fused histogram
+        left_small = row[B_LCNT] <= row[B_RCNT]
+        hist_zero = jnp.zeros((c_cols, col_bins, 3), jnp.float32)
+
+        def chunk_hist(rows_win, count):
+            codes = _unpack_codes(rows_win[:, :cw], c_cols, item_bits)
+            v = (iota_ch < count).astype(jnp.float32)
+            ghw = jax.lax.bitcast_convert_type(
+                rows_win[:, cw:cw + 3], jnp.float32) * v[:, None]
+            return build_histogram(codes, ghw, col_bins,
+                                   use_pallas=use_pallas)
 
         # pass B: per chunk — read, decide, local 3-way stable partition,
-        # exact-write lefts forward into data, stage rights in scratch
+        # exact-write lefts forward into data, stage rights in scratch;
+        # when the LEFT child is the smaller one its histogram fuses in
+        # (the chunk's left segment sits at win_s[:lc]) so no later pass
+        # re-reads those rows
+        fuse = fuse_hist
+
         def pass_b(i, acc):
-            data, scratch, lrun, rcnt = acc
+            data, scratch, lrun, rcnt, hist = acc
             start = begin + i * CH
             win = jax.lax.dynamic_slice(data, (start, 0), (CH, d_cols))
             valid = iota_ch < (p - i * CH)
@@ -1284,43 +1301,51 @@ def grow_tree_chunk_core(
                 win_pad, (lc, 0), (CH, d_cols))
             scratch = jax.lax.dynamic_update_slice(
                 scratch, rights, (start, 0))
-            return data, scratch, lrun + lc, rcnt.at[i].set(vc - lc)
+            if fuse:
+                hist = hist + jax.lax.cond(
+                    left_small, lambda _: chunk_hist(win_s, lc),
+                    lambda _: hist_zero, operand=None)
+            return data, scratch, lrun + lc, rcnt.at[i].set(vc - lc), hist
 
-        data, scratch, lphys, rcnt = jax.lax.fori_loop(
+        data, scratch, lphys, rcnt, hist_small = jax.lax.fori_loop(
             0, nch, pass_b,
-            (c.data, c.scratch, jnp.int32(0), zi(maxch)))
+            (c.data, c.scratch, jnp.int32(0), zi(maxch), hist_zero))
         rphys = p - lphys
         roff = jnp.cumsum(rcnt) - rcnt
 
-        # pass C: place staged right segments after the left block
-        def pass_c(i, data):
+        # pass C: place staged right segments after the left block; when
+        # the RIGHT child is smaller its histogram fuses here (chunk i's
+        # rights sit at seg[:rcnt[i]])
+        def pass_c(i, acc):
+            data, hist = acc
             seg = jax.lax.dynamic_slice(
                 scratch, (begin + i * CH, 0), (CH, d_cols))
             dst = begin + lphys + roff[i]
             d_old = jax.lax.dynamic_slice(data, (dst, 0), (CH, d_cols))
             merged = jnp.where((iota_ch < rcnt[i])[:, None], seg, d_old)
-            return jax.lax.dynamic_update_slice(data, merged, (dst, 0))
+            data = jax.lax.dynamic_update_slice(data, merged, (dst, 0))
+            if fuse:
+                hist = hist + jax.lax.cond(
+                    left_small, lambda _: hist_zero,
+                    lambda _: chunk_hist(seg, rcnt[i]), operand=None)
+            return data, hist
 
-        data = jax.lax.fori_loop(0, nch, pass_c, data)
+        data, hist_small = jax.lax.fori_loop(
+            0, nch, pass_c, (data, hist_small))
 
-        # smaller-child histogram over its chunks (post-move layout)
-        left_small = row[B_LCNT] <= row[B_RCNT]
-        sb = begin + jnp.where(left_small, 0, lphys)
-        sc = jnp.where(left_small, lphys, rphys)
+        if not fuse:
+            # separate smaller-child histogram pass (post-move layout)
+            sb = begin + jnp.where(left_small, 0, lphys)
+            sc = jnp.where(left_small, lphys, rphys)
 
-        def pass_h(i, hist):
-            start = sb + i * CH
-            win = jax.lax.dynamic_slice(data, (start, 0), (CH, d_cols))
-            v = (iota_ch < (sc - i * CH)).astype(jnp.float32)
-            codes = _unpack_codes(win[:, :cw], c_cols, item_bits)
-            ghw = jax.lax.bitcast_convert_type(
-                win[:, cw:cw + 3], jnp.float32) * v[:, None]
-            return hist + build_histogram(codes, ghw, col_bins,
-                                          use_pallas=use_pallas)
+            def pass_h(i, hist):
+                start = sb + i * CH
+                win = jax.lax.dynamic_slice(data, (start, 0),
+                                            (CH, d_cols))
+                return hist + chunk_hist(win, sc - i * CH)
 
-        hist_small = jax.lax.fori_loop(
-            0, -(-sc // CH), pass_h,
-            jnp.zeros((c_cols, col_bins, 3), jnp.float32))
+            hist_small = jax.lax.fori_loop(0, -(-sc // CH), pass_h,
+                                           hist_zero)
         if axis_name is not None:
             hist_small = jax.lax.psum(hist_small, axis_name)
 
@@ -1811,6 +1836,7 @@ class DeviceTreeLearner:
             return grow_tree_chunk, dict(
                 c_cols=self.c_cols, item_bits=self.item_bits,
                 chunk_rows=self.chunk_rows,
+                fuse_hist=not flag("LGBM_TPU_CHUNK_NO_FUSE_HIST"),
                 partition=self._partition_mode)
         return grow_tree_compact, dict(
             c_cols=self.c_cols, item_bits=self.item_bits,

@@ -541,8 +541,10 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     # ------------------------------------------------------------------
     def _grow_statics(self):
         if self.strategy == "chunk":
+            from ..utils.envs import flag
             return dict(c_cols=self.c_cols, item_bits=self.item_bits,
                         chunk_rows=self.chunk_rows,
+                        fuse_hist=not flag("LGBM_TPU_CHUNK_NO_FUSE_HIST"),
                         partition=self._partition_mode,
                         **self._statics())
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,

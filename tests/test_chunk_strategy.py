@@ -75,6 +75,43 @@ def test_chunk_matches_compact_with_missing(monkeypatch):
     assert a == b
 
 
+def test_chunk_fuse_hist_escape_matches(monkeypatch):
+    # LGBM_TPU_CHUNK_NO_FUSE_HIST=1 runs the separate pass-H histogram;
+    # identical trees under exact arithmetic
+    r = np.random.RandomState(14)
+    n, f = 70000, 6
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+    fused = grow_tree_with(monkeypatch, "chunk", x, y, g, h)
+    monkeypatch.setenv("LGBM_TPU_CHUNK_NO_FUSE_HIST", "1")
+    unfused = grow_tree_with(monkeypatch, "chunk", x, y, g, h)
+    assert fused == unfused
+
+
+def test_chunk_goss_fused_training(monkeypatch):
+    # GOSS sampling + chunk growth through the fused production path
+    import lightgbm_tpu as lgb
+    monkeypatch.setenv("LGBM_TPU_STRATEGY", "chunk")
+    monkeypatch.setenv("LGBM_TPU_CHUNK", "16384")
+    r = np.random.RandomState(15)
+    n, f = 70000, 6
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] - 0.5 * x[:, 3] + 0.5 * r.randn(n)) > 0).astype(np.float64)
+    ds = lgb.Dataset(x, y)
+    bst = lgb.train({"objective": "binary", "boosting": "goss",
+                     "num_leaves": 31, "verbosity": -1,
+                     "top_rate": 0.2, "other_rate": 0.1},
+                    ds, num_boost_round=4)
+    p = bst.predict(x[:20000])
+    lbl = y[:20000]
+    ranks = np.argsort(np.argsort(p))
+    pos = lbl > 0
+    auc = (ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2) / max(
+        pos.sum() * (~pos).sum(), 1)
+    assert auc > 0.7
+
+
 def test_chunk_data_parallel_matches_compact_psum(monkeypatch):
     # the sharded chunk core (psum reduction) must grow the identical
     # tree as the compact core's psum mode on the virtual 8-device mesh
