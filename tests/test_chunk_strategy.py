@@ -89,6 +89,18 @@ def test_chunk_fuse_hist_escape_matches(monkeypatch):
     assert fused == unfused
 
 
+def test_chunk_larger_than_data(monkeypatch):
+    # CH > n degenerates to one chunk per split; still identical trees
+    r = np.random.RandomState(18)
+    n, f = 70000, 5
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] + 0.4 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+    a = grow_tree_with(monkeypatch, "compact", x, y, g, h, chunk=131072)
+    b = grow_tree_with(monkeypatch, "chunk", x, y, g, h, chunk=131072)
+    assert a == b
+
+
 def test_chunk_goss_fused_training(monkeypatch):
     # GOSS sampling + chunk growth through the fused production path
     import lightgbm_tpu as lgb
