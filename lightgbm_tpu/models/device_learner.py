@@ -54,7 +54,8 @@ from ..ops import split as split_ops
 from ..ops.partition import decide_left
 from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
 from ..utils import log
-from ..utils.envs import flag, partition_mode_env, use_pallas_env
+from ..utils.envs import (flag, partition_mode_env, strategy_env,
+                          use_pallas_env)
 from .tree import Tree
 
 NEG_INF = split_ops.NEG_INF
@@ -942,18 +943,21 @@ def grow_tree_compact_core(
                 return build_histogram(s_codes, s_gh, col_bins,
                                        use_pallas=use_pallas)
 
-            def hist_full(_):
+            def hist_range(range_begin, range_count):
+                # masked full-window pass over [range_begin,
+                # range_begin + range_count)
                 s_codes = decode_for_hist(win_sorted[:, :cw])
                 j = jnp.arange(wsz, dtype=jnp.int32)
-                sv = ((j >= s_begin)
-                      & (j < s_begin + s_count)).astype(jnp.float32)
+                sv = ((j >= range_begin)
+                      & (j < range_begin + range_count)).astype(jnp.float32)
                 s_gh = jax.lax.bitcast_convert_type(
                     win_sorted[:, cw:cw + 3], jnp.float32) * sv[:, None]
                 return build_histogram(s_codes, s_gh, col_bins,
                                        use_pallas=use_pallas)
 
-            hist_small = jax.lax.cond(s_count <= half, hist_half, hist_full,
-                                      operand=None)
+            hist_small = jax.lax.cond(
+                s_count <= half, hist_half,
+                lambda _: hist_range(s_begin, s_count), operand=None)
 
             # pooled mode, parent-histogram miss: the sibling cannot come
             # from subtraction, so build the LARGER child's histogram
@@ -962,19 +966,8 @@ def grow_tree_compact_core(
             if pooled:
                 o_begin = jnp.where(left_small, lphys, 0)
                 o_count = pcount - s_count
-
-                def hist_other_fn(_):
-                    s_codes = decode_for_hist(win_sorted[:, :cw])
-                    j = jnp.arange(wsz, dtype=jnp.int32)
-                    sv = ((j >= o_begin)
-                          & (j < o_begin + o_count)).astype(jnp.float32)
-                    s_gh = jax.lax.bitcast_convert_type(
-                        win_sorted[:, cw:cw + 3], jnp.float32) * sv[:, None]
-                    return build_histogram(s_codes, s_gh, col_bins,
-                                           use_pallas=use_pallas)
-
                 hist_other = jax.lax.cond(
-                    need_other, hist_other_fn,
+                    need_other, lambda _: hist_range(o_begin, o_count),
                     lambda _: jnp.zeros((hist_cols, col_bins, 3),
                                         jnp.float32),
                     operand=None)
@@ -1515,7 +1508,7 @@ def resolve_strategy(config: Config, dataset: Dataset,
     switch-free fixed-chunk formulation (opt-in pending on-chip A/B);
     it requires the dense histogram pool, so LRU-capped configs fall
     back to compact."""
-    strat = forced or _env("LGBM_TPU_STRATEGY", "auto")
+    strat = forced or strategy_env()
     if strat == "auto":
         strat = "compact" if dataset.num_data >= 65536 else "masked"
     if strat == "chunk":
@@ -1625,7 +1618,7 @@ class DeviceTreeLearner:
         # backend; pallas runs interpret mode off-TPU so CI covers the
         # integrated path)
         self._partition_mode = partition_mode_env()
-        requested = strategy or _env("LGBM_TPU_STRATEGY", "auto")
+        requested = strategy or strategy_env()
         self.strategy = resolve_strategy(config, dataset, strategy)
         if requested == "chunk" and self.strategy != "chunk":
             log.warning("chunk strategy needs the dense histogram pool; "
@@ -1951,7 +1944,6 @@ class DeviceTreeLearner:
         # physically gather the bag once per iteration so every per-split
         # window scales with the bag, not N; out-of-bag rows get their
         # leaf from a rec-replay routing pass
-        from ..utils.envs import flag
         bag_compact = (use_compact and bag_on and bag_k < n
                        and not flag("LGBM_TPU_NO_BAG_COMPACT"))
 

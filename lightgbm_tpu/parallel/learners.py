@@ -495,8 +495,8 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         # switch-free chunk core (psum reduction only); anything else
         # runs compact. resolve_strategy may fall chunk back to compact
         # (LRU-capped pool), so read the resolved value afterwards.
-        import os
-        want = os.environ.get("LGBM_TPU_STRATEGY", "auto")
+        from ..utils.envs import strategy_env
+        want = strategy_env()
         use_chunk = want == "chunk" and self._chunk_capable
         if want == "chunk" and not self._chunk_capable:
             log.warning("%s does not support the chunk strategy; "

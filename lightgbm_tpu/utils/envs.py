@@ -33,6 +33,13 @@ def partition_mode_env() -> str:
     return "pallas" if flag("LGBM_TPU_PALLAS_PART") else "sort"
 
 
+def strategy_env(default: str = "auto") -> str:
+    """LGBM_TPU_STRATEGY: auto | masked | compact | chunk — the ONE
+    read shared by the device learner's resolve_strategy and the
+    sharded learners' chunk opt-in."""
+    return os.environ.get("LGBM_TPU_STRATEGY", default).strip().lower()
+
+
 def dp_reduce_mode_env() -> str:
     """LGBM_TPU_DP_REDUCE: 'scatter' (reference comm pattern, default) or
     'psum' (replicated histograms) for the data-parallel device learner."""

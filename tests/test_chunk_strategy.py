@@ -22,6 +22,13 @@ def exact_grads(r, n):
     return g, h
 
 
+def rank_auc(scores, labels):
+    ranks = np.argsort(np.argsort(scores))
+    pos = labels > 0
+    return (ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2) / max(
+        pos.sum() * (~pos).sum(), 1)
+
+
 def grow_tree_with(monkeypatch, strategy, x, y, g, h, params=None,
                    chunk=8192):
     monkeypatch.setenv("LGBM_TPU_CHUNK", str(chunk))
@@ -115,13 +122,7 @@ def test_chunk_goss_fused_training(monkeypatch):
                      "num_leaves": 31, "verbosity": -1,
                      "top_rate": 0.2, "other_rate": 0.1},
                     ds, num_boost_round=4)
-    p = bst.predict(x[:20000])
-    lbl = y[:20000]
-    ranks = np.argsort(np.argsort(p))
-    pos = lbl > 0
-    auc = (ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2) / max(
-        pos.sum() * (~pos).sum(), 1)
-    assert auc > 0.7
+    assert rank_auc(bst.predict(x[:20000]), y[:20000]) > 0.7
 
 
 def test_chunk_data_parallel_matches_compact_psum(monkeypatch):
@@ -170,11 +171,6 @@ def test_chunk_fused_training_end_to_end(monkeypatch):
                      "verbosity": -1, "bagging_fraction": 0.7,
                      "bagging_freq": 1}, ds, num_boost_round=4)
     p = bst.predict(x[:20000])
-    lbl = y[:20000]
-    auc_ranks = np.argsort(np.argsort(p))
-    pos = lbl > 0
-    auc = (auc_ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2) / max(
-        pos.sum() * (~pos).sum(), 1)
-    assert auc > 0.75
+    assert rank_auc(p, y[:20000]) > 0.75
     b2 = lgb.Booster(model_str=bst.model_to_string())
     assert np.allclose(p, b2.predict(x[:20000]))
