@@ -47,6 +47,9 @@ bench "bench 1M chunk+scan" 900 LGBM_TPU_STRATEGY=chunk \
 bench "bench 1M chunk+pallas-part" 900 LGBM_TPU_STRATEGY=chunk \
   LGBM_TPU_PARTITION=pallas \
   BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+bench "bench 1M chunk CH=16384" 900 LGBM_TPU_STRATEGY=chunk \
+  LGBM_TPU_CHUNK=16384 \
+  BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 bench "bench 1M categorical (8 cats)" 1200 BENCH_CAT_FEATURES=8 \
   BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 echo "=== battery3 done $(date +%H:%M:%S) ===" >> $RES
