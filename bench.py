@@ -178,7 +178,9 @@ def main():
     knobs = {k: os.environ[k] for k in
              ("LGBM_TPU_STRATEGY", "LGBM_TPU_WINDOW_STEP",
               "LGBM_TPU_PACK_WORDS", "LGBM_TPU_PALLAS",
-              "LGBM_TPU_DP_REDUCE") if k in os.environ}
+              "LGBM_TPU_DP_REDUCE", "LGBM_TPU_PARTITION",
+              "LGBM_TPU_CHUNK", "LGBM_TPU_CHUNK_NO_FUSE_HIST",
+              "BENCH_CAT_FEATURES") if k in os.environ}
     sys.stderr.write(f"rows={N_ROWS} iters={N_ITERS} knobs={knobs}\n")
 
     # any capped run (explicit CPU or fallback) is not comparable to the
