@@ -431,7 +431,8 @@ def _unpack_codes(words: jax.Array, c_cols: int, item_bits: int) -> jax.Array:
     static_argnames=("c_cols", "item_bits",
                      "num_leaves", "num_bins", "col_bins", "max_depth",
                      "bynode_k", "use_pallas", "partition",
-                     "pool_slots", "window_step", "cat_statics"))
+                     "pool_slots", "window_step", "trivial_weights",
+                     "cat_statics"))
 def grow_tree_compact(
         codes_pack: jax.Array,       # (N, CW) u32: packed column codes
         codes_row: jax.Array,        # (N, C) u8/u16 for the root pass
@@ -445,7 +446,8 @@ def grow_tree_compact(
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         partition: str = "sort",
-        pool_slots: int = 0, window_step: int = 4, cat_statics=None):
+        pool_slots: int = 0, window_step: int = 4,
+        trivial_weights: bool = False, cat_statics=None):
     return grow_tree_compact_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -457,7 +459,8 @@ def grow_tree_compact(
         min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
         use_pallas=use_pallas, partition=partition,
         axis_name=None, pool_slots=pool_slots,
-        window_step=window_step, cat_statics=cat_statics)
+        window_step=window_step, trivial_weights=trivial_weights,
+        cat_statics=cat_statics)
 
 
 def grow_tree_compact_core(
@@ -474,7 +477,7 @@ def grow_tree_compact_core(
         partition: str = "sort",
         axis_name=None, pool_slots: int = 0, scatter_cols: int = 0,
         feature_shards: int = 0, voting_k: int = 0, window_step: int = 4,
-        cat_statics=None):
+        trivial_weights: bool = False, cat_statics=None):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
@@ -955,9 +958,17 @@ def grow_tree_compact_core(
                 return build_histogram(s_codes, s_gh, col_bins,
                                        use_pallas=use_pallas)
 
-            hist_small = jax.lax.cond(
-                s_count <= half, hist_half,
-                lambda _: hist_range(s_begin, s_count), operand=None)
+            if trivial_weights and axis_name is None:
+                # all-ones weights single-chip: record counts equal
+                # physical counts, so the smaller side always fits the
+                # contiguous half window — the masked full-window
+                # fallback (and its extra compiled histogram program
+                # per window class) is statically dead
+                hist_small = hist_half(None)
+            else:
+                hist_small = jax.lax.cond(
+                    s_count <= half, hist_half,
+                    lambda _: hist_range(s_begin, s_count), operand=None)
 
             # pooled mode, parent-histogram miss: the sibling cannot come
             # from subtraction, so build the LARGER child's histogram
@@ -1823,8 +1834,13 @@ class DeviceTreeLearner:
             log.warning("No further splits with positive gain")
         return self.replay_tree(rec_h, k, rec_cat_h)
 
-    def _grow_fn_kwargs(self):
-        """(grow fn, strategy-specific kwargs) for the packed strategies."""
+    def _grow_fn_kwargs(self, trivial_weights: bool = False):
+        """(grow fn, strategy-specific kwargs) for the packed strategies.
+        trivial_weights asserts the weight vector reaching the grower is
+        all-ones; only the compact strategy consumes it (it drops the
+        masked full-window histogram fallback), and only below 2**24
+        rows where the float32 record counts that pick the smaller side
+        are exact integers."""
         if self.strategy == "chunk":
             return grow_tree_chunk, dict(
                 c_cols=self.c_cols, item_bits=self.item_bits,
@@ -1834,13 +1850,16 @@ class DeviceTreeLearner:
         return grow_tree_compact, dict(
             c_cols=self.c_cols, item_bits=self.item_bits,
             pool_slots=self.pool_slots, window_step=self.window_step,
+            trivial_weights=(trivial_weights
+                             and self.dataset.num_data < (1 << 24)),
             partition=self._partition_mode)
 
     def _run_grow(self, grad, hess, w, base_mask, key):
         """The grow-program invocation; sharded subclasses override this
         single hook and inherit the rest of train()."""
         if self.strategy in ("compact", "chunk"):
-            grow, kw = self._grow_fn_kwargs()
+            grow, kw = self._grow_fn_kwargs(
+                trivial_weights=w is self._ones_w)
             return grow(
                 self.codes_pack, self.codes_row, grad, hess, w, base_mask,
                 self.f_numbins, self.f_missing, self.f_default,
@@ -1919,10 +1938,6 @@ class DeviceTreeLearner:
         n = self.dataset.num_data
         cfg = self.config
         use_compact = self.strategy in ("compact", "chunk")
-        if use_compact:
-            grow, grow_kw = self._grow_fn_kwargs()
-        else:
-            grow, grow_kw = grow_tree, {}
         meta = (self.f_numbins, self.f_missing, self.f_default,
                 self.f_monotone, self.f_penalty, self.f_categorical,
                 self.f_col, self.f_base,
@@ -1946,6 +1961,15 @@ class DeviceTreeLearner:
         # leaf from a rec-replay routing pass
         bag_compact = (use_compact and bag_on and bag_k < n
                        and not flag("LGBM_TPU_NO_BAG_COMPACT"))
+        if use_compact:
+            # bag-compacted and full-data fused paths hand the grower an
+            # all-ones weight vector; GOSS/bagging without compaction
+            # carries 0/1 weights and keeps the masked fallback
+            grow, grow_kw = self._grow_fn_kwargs(
+                trivial_weights=bag_compact
+                or (goss is None and not bag_on))
+        else:
+            grow, grow_kw = grow_tree, {}
 
         @jax.jit
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
