@@ -1,0 +1,74 @@
+"""Parse A/B battery logs into a comparison table + playbook suggestions.
+
+Usage: python tools/analyze_battery.py [log ...]
+Defaults to /tmp/tpu_bench_results.log, *2.log, *3.log (whichever exist).
+Pure text processing — safe to run any time (no jax import).
+"""
+import json
+import os
+import re
+import sys
+
+paths = sys.argv[1:] or [p for p in (
+    "/tmp/tpu_bench_results.log", "/tmp/tpu_bench_results2.log",
+    "/tmp/tpu_bench_results3.log",
+    "docs/bench_logs/r3_tpu_chain.log") if os.path.exists(p)]
+
+runs = []
+for path in paths:
+    name = None
+    for line in open(path, errors="replace"):
+        m = re.match(r"^--- (.+?) ---", line)
+        if m and not m.group(1).startswith("end"):
+            name = m.group(1)
+        if line.startswith('{"metric"'):
+            try:
+                j = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            j["_step"] = name or "?"
+            j["_log"] = os.path.basename(path)
+            runs.append(j)
+
+if not runs:
+    print("no bench JSON lines found in:", paths)
+    sys.exit(0)
+
+print(f"{'step':44s} {'backend':12s} {'rows':>9s} {'row-trees/s':>12s} "
+      f"{'vs_base':>8s} {'sec_to_auc':>10s} {'deg':>4s}")
+for j in runs:
+    print(f"{j['_step'][:44]:44s} {j.get('backend', '?'):12s} "
+          f"{j.get('rows', 0):9d} {j.get('value', 0):12,.0f} "
+          f"{j.get('vs_baseline', 0):8.4f} "
+          f"{str(j.get('sec_to_auc')):>10s} "
+          f"{'Y' if j.get('degraded') else '':>4s}")
+
+ok = [j for j in runs if not j.get("degraded") and j.get("value")]
+if not ok:
+    print("\nno non-degraded runs — no default decisions possible")
+    sys.exit(0)
+
+
+def best(pred):
+    c = [j for j in ok if pred(j)]
+    return max(c, key=lambda j: j["value"]) if c else None
+
+
+base = best(lambda j: "default" in j["_step"] or j["_step"].endswith(
+    "bench 1M"))
+print("\n--- playbook suggestions (docs/bench_logs/PLAYBOOK.md) ---")
+if base:
+    print(f"baseline: {base['_step']} = {base['value']:,.0f}")
+for label, pat in (("partition=scan", r"partition=scan"),
+                   ("partition=pallas", r"partition=pallas|pallas-part"),
+                   ("chunk", r"chunk(?!\+)"),
+                   ("chunk+scan", r"chunk\+scan"),
+                   ("chunk+pallas", r"chunk\+pallas"),
+                   ("pallas hist", r"pallas hist"),
+                   ("10.5M scale", r"10\.5M")):
+    b = best(lambda j, pat=pat: re.search(pat, j["_step"]))
+    if b:
+        rel = b["value"] / base["value"] if base else float("nan")
+        verdict = "FLIP DEFAULT" if base and rel > 1.05 else \
+            ("close — keep measuring" if base and rel > 0.95 else "keep")
+        print(f"{label:18s} {b['value']:12,.0f}  x{rel:5.2f}  -> {verdict}")
