@@ -155,6 +155,42 @@ def test_chunk_data_parallel_matches_compact_psum(monkeypatch):
     assert grow("chunk") == grow("compact")
 
 
+def test_chunk_data_parallel_categorical(monkeypatch):
+    # categorical winners' left-bin masks replicate through the chunk
+    # core's psum mode exactly as through compact's
+    from lightgbm_tpu.parallel.learners import DeviceDataParallelTreeLearner
+
+    r = np.random.RandomState(26)
+    n = 70000
+    x = np.stack([
+        r.randn(n).astype(np.float32),
+        r.randint(0, 9, n).astype(np.float32),
+        r.randn(n).astype(np.float32),
+    ], axis=1)
+    y = ((x[:, 0] + (x[:, 1] % 2 == 0) + 0.4 * r.randn(n)) > 0.8) \
+        .astype(np.float64)
+    g, h = exact_grads(r, n)
+
+    def grow(strategy):
+        monkeypatch.setenv("LGBM_TPU_DP_REDUCE", "psum")
+        monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+        if strategy == "chunk":
+            monkeypatch.setenv("LGBM_TPU_STRATEGY", "chunk")
+        else:
+            monkeypatch.delenv("LGBM_TPU_STRATEGY", raising=False)
+        cfg = Config({"objective": "binary", "num_leaves": 31,
+                      "max_bin": 63, "min_data_in_leaf": 20,
+                      "categorical_feature": "1", "verbosity": -1})
+        ds = Dataset(x, config=cfg, label=y)
+        lrn = DeviceDataParallelTreeLearner(cfg, ds)
+        assert lrn.strategy == strategy
+        return lrn.train(g, h).to_string()
+
+    chunk_tree = grow("chunk")
+    assert "cat_threshold" in chunk_tree   # a categorical split happened
+    assert chunk_tree == grow("compact")
+
+
 def test_chunk_fused_training_end_to_end(monkeypatch):
     # the production path: lgb.train -> make_fused_step with bagging;
     # sanity (learns + roundtrips), not bit-parity (sigmoid gradients
