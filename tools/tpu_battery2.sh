@@ -20,6 +20,14 @@
 cd /root/repo
 RES=/tmp/tpu_bench_results2.log
 probe() {
+  # /tmp/battery_cutoff (epoch secs) guards the round boundary: a step
+  # that would still be mid-TPU-op when the driver takes over risks a
+  # SIGTERM-induced tunnel wedge for the driver's own bench
+  if [ -f /tmp/battery_cutoff ] \
+      && [ "$(date +%s)" -gt "$(cat /tmp/battery_cutoff)" ]; then
+    echo "!! battery cutoff reached — stopping cleanly" >> $RES
+    return 1
+  fi
   timeout 150 python -c "import jax; assert jax.default_backend()=='tpu'" \
     2>/dev/null
 }

@@ -6,6 +6,12 @@
 cd /root/repo
 RES=/tmp/tpu_bench_results3.log
 probe() {
+  # round-boundary guard: see tpu_battery2.sh
+  if [ -f /tmp/battery_cutoff ] \
+      && [ "$(date +%s)" -gt "$(cat /tmp/battery_cutoff)" ]; then
+    echo "!! battery cutoff reached — stopping cleanly" >> $RES
+    return 1
+  fi
   timeout 150 python -c "import jax; assert jax.default_backend()=='tpu'" \
     2>/dev/null
 }
