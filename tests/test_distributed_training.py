@@ -36,6 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.dataset import Dataset
 from lightgbm_tpu.models.device_learner import (DeviceTreeLearner,
+                                                grow_tree_chunk,
+                                                grow_tree_chunk_core,
                                                 grow_tree_compact,
                                                 grow_tree_compact_core)
 
@@ -114,6 +116,33 @@ if rank == 0:
     np.testing.assert_allclose(np.asarray(tot_1), np.asarray(tot),
                                rtol=1e-5)
 
+# ---- chunk core (psum mode) across REAL process boundaries ----
+statics_k = dict(c_cols=lrn.c_cols, item_bits=lrn.item_bits,
+                 chunk_rows=1024, **lrn._statics())
+
+def local_k(cp_l, cr_l, g_l, h_l, w_l, mask, key):
+    rec, _rec_cat, _leaf, k, tot = grow_tree_chunk_core(
+        cp_l, cr_l, g_l, h_l, w_l, mask, *meta, key,
+        axis_name="data", **statics_k)
+    return rec, k
+
+fnk = jax.jit(shard_map(
+    local_k, mesh=mesh,
+    in_specs=(P("data", None), P("data", None), P("data"), P("data"),
+              P("data"), P(), P()),
+    out_specs=(P(), P()), check_vma=False))
+reck, kk = jax.device_get(fnk(cp, cr, gg, hh, ww, mask_g, key_g))
+
+reck_s = kk_s = None
+if rank == 0:
+    rk_1, _rc, _leaf, kk_1, _t = grow_tree_chunk(
+        jnp.asarray(lrn.codes_pack), jnp.asarray(lrn.codes_row),
+        jnp.asarray(g), jnp.asarray(h), jnp.asarray(w),
+        jnp.asarray(mask_np), *meta, jnp.asarray(key_np),
+        c_cols=lrn.c_cols, item_bits=lrn.item_bits, chunk_rows=1024,
+        **lrn._statics())
+    reck_s, kk_s = jax.device_get((rk_1, kk_1))
+
 # ---- categorical step: the winner's (B,) left-bin mask rides the ----
 # ---- candidate election across REAL process boundaries           ----
 r2 = np.random.RandomState(23)
@@ -167,6 +196,9 @@ with open(out, "wb") as fh:
     pickle.dump({"rec": np.asarray(rec), "k": int(k),
                  "rec_s": None if rec_s is None else np.asarray(rec_s),
                  "k_s": None if k_s is None else int(k_s),
+                 "reck": np.asarray(reck), "kk": int(kk),
+                 "reck_s": None if reck_s is None else np.asarray(reck_s),
+                 "kk_s": None if kk_s is None else int(kk_s),
                  "recc": np.asarray(recc),
                  "recc_cat": np.asarray(recc_cat), "kc": int(kc),
                  "recc_s": None if recc_s is None else np.asarray(recc_s),
@@ -225,6 +257,15 @@ def test_two_process_data_parallel_training_step(tmp_path):
                 or rec[i, R_THR] != rec_s[i, R_THR]):
             assert abs(gd - gs) <= 2e-5 * max(1.0, abs(gs)), \
                 (i, "split differs beyond a tie plateau")
+
+    # chunk core (psum): replicated records across processes and
+    # agreement with the single-device chunk run (tolerance as above)
+    assert r0["kk"] == r1["kk"] > 0
+    np.testing.assert_array_equal(r0["reck"], r1["reck"])
+    assert r0["kk"] == r0["kk_s"]
+    for i in range(r0["kk"]):
+        gd, gs = r0["reck"][i, R_GAIN], r0["reck_s"][i, R_GAIN]
+        assert abs(gd - gs) <= 1e-4 * max(1.0, abs(gs)), (i, gd, gs)
 
     # categorical step: replicated records + masks across processes,
     # at least one elected categorical winner, single-device agreement
