@@ -724,88 +724,16 @@ def grow_tree_compact_core(
 
         search2_rows = search2_simple(scan2, best_row)
     else:
-        # feature-sliced scan: every shard searches only the columns it
-        # owns (after the reduce-scatter in scatter mode; built directly
-        # in feature-parallel mode), then candidates are elected
         D = scatter_cols if scatter else feature_shards
-        f_all = int(f_numbins.shape[0])
-        assert f_all == c_cols, \
-            "sliced modes require identity feature->column mapping"
-        if fp:
-            # slice boundaries fall on packed-word boundaries so the
-            # window decode can slice words directly
-            cs = padded_shard_cols(c_cols, D, item_bits)
-        else:
-            cs = -(-c_cols // D)            # columns per shard (padded)
-        c_pad = cs * D
-        shard = jax.lax.axis_index(axis_name)
-        start = (shard * cs).astype(jnp.int32)
-
-        def pad1(a, fill):
-            return jnp.pad(a, (0, c_pad - f_all), constant_values=fill)
-
-        def sl(a):
-            return jax.lax.dynamic_slice_in_dim(a, start, cs)
-
-        mask_sl = sl(pad1(base_mask, False))
-        nb_sl = sl(pad1(f_numbins, 1))
-        miss_sl = sl(pad1(f_missing, 0))
-        def_sl = sl(pad1(f_default, 0))
-        mono_sl = sl(pad1(f_monotone, 0))
-        pen_sl = sl(pad1(f_penalty, 1.0))
-        elide_sl = sl(pad1(f_elide, 0))
-        cat_sl = sl(pad1(f_categorical, 0)) if has_cat else None
-        # local expansion gather for the slice's flattened (cs*B + 1)
-        # column histogram (identity mapping: feature j bin b -> j*B + b)
-        hi_local = (jnp.arange(cs, dtype=jnp.int32)[:, None] * col_bins
-                    + jnp.arange(col_bins, dtype=jnp.int32)[None, :])
-        hi_local = jnp.where(
-            jnp.arange(col_bins, dtype=jnp.int32)[None, :] < nb_sl[:, None],
-            hi_local, cs * col_bins)
-        (_, scan_sl, _, _, best_row) = _tree_helpers(
-            mask_sl, nb_sl, miss_sl, def_sl, mono_sl, pen_sl, elide_sl,
-            hi_local, f_categorical=cat_sl, cat_statics=cat_statics,
-            **helper_kwargs)
-
-        if scatter:
-            def reduce_hist(h):
-                h = jnp.pad(h, ((0, c_pad - c_cols), (0, 0), (0, 0)))
-                return jax.lax.psum_scatter(
-                    h, axis_name, scatter_dimension=0, tiled=True)
-        else:
-            def reduce_hist(h):
-                return h     # already the local slice over ALL rows
-
-        def _elect(row, cm):
-            # the candidate row carries its (B,) categorical left-bin
-            # mask through the election so every shard can route the
-            # partition on a categorical winner it does not own
-            # (SyncUpGlobalBestSplit's serialized cat_threshold role,
-            # split_info.hpp:22-193)
-            payload = jnp.concatenate([row, cm])     # (12 + cat_b,)
-            rows = jax.lax.all_gather(payload, axis_name)
-            win = rows[jnp.argmax(rows[:, B_GAIN])]
-            return win[:12], win[12:]
-
-        def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
-            res, cm = scan_sl(col_hist, sg, sh, cnt, mn, mx, mask_sl)
-            row = best_row(res, child_depth)
-            row = row.at[B_FEAT].add(start.astype(jnp.float32))
-            return _elect(row, cm)
-
-        def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
-                         child_depth):
-            res2, cm2 = jax.vmap(scan_sl)(
-                col_hist2, sg2, sh2, cnt2, mn2, mx2,
-                jnp.broadcast_to(mask_sl, (2,) + mask_sl.shape))
-            rows = jax.vmap(
-                functools.partial(best_row, child_depth=child_depth))(res2)
-            rows = rows.at[:, B_FEAT].add(start.astype(jnp.float32))
-            payload = jnp.concatenate([rows, cm2], axis=1)   # (2, 12+cat_b)
-            g = jax.lax.all_gather(payload, axis_name)       # (D, 2, .)
-            win = jnp.argmax(g[:, :, B_GAIN], axis=0)        # (2,)
-            sel = g[win, jnp.arange(2)]
-            return sel[:, :12], sel[:, 12:]
+        (reduce_hist, search_row, search2_rows, cs, shard,
+         start) = make_sliced_search(
+            axis_name=axis_name, fp=fp, D=D,
+            c_cols=c_cols, col_bins=col_bins, item_bits=item_bits,
+            base_mask=base_mask, f_numbins=f_numbins, f_missing=f_missing,
+            f_default=f_default, f_monotone=f_monotone,
+            f_penalty=f_penalty, f_elide=f_elide,
+            f_categorical=f_categorical, has_cat=has_cat,
+            cat_statics=cat_statics, helper_kwargs=helper_kwargs)
 
     hist_cols = cs if fp else c_cols   # width of branch-built histograms
     if fp:
@@ -841,8 +769,8 @@ def grow_tree_compact_core(
         # root histogram is built from this shard's column slice only
         totals = gh.sum(axis=0)
         cr = codes_row
-        if cr.shape[1] < c_pad:
-            cr = jnp.pad(cr, ((0, 0), (0, c_pad - cr.shape[1])))
+        if cr.shape[1] < cs * D:
+            cr = jnp.pad(cr, ((0, 0), (0, cs * D - cr.shape[1])))
         cr_sl = jax.lax.dynamic_slice(
             cr, (jnp.int32(0), (shard * cs).astype(jnp.int32)), (n, cs))
         hist0 = build_histogram(cr_sl, gh, col_bins, use_pallas=use_pallas)
@@ -1112,7 +1040,8 @@ class _CarryK(NamedTuple):
     static_argnames=("c_cols", "item_bits",
                      "num_leaves", "num_bins", "col_bins", "max_depth",
                      "bynode_k", "use_pallas", "partition",
-                     "chunk_rows", "fuse_hist", "cat_statics"))
+                     "chunk_rows", "fuse_hist", "feature_shards",
+                     "cat_statics"))
 def grow_tree_chunk(
         codes_pack: jax.Array, codes_row: jax.Array,
         grad: jax.Array, hess: jax.Array, w: jax.Array,
@@ -1125,7 +1054,8 @@ def grow_tree_chunk(
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         partition: str = "sort", chunk_rows: int = 65536,
-        fuse_hist: bool = True, cat_statics=None):
+        fuse_hist: bool = True, feature_shards: int = 0,
+        cat_statics=None):
     return grow_tree_chunk_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -1136,7 +1066,8 @@ def grow_tree_chunk(
         min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
         min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
         use_pallas=use_pallas, partition=partition, chunk_rows=chunk_rows,
-        fuse_hist=fuse_hist, axis_name=None, cat_statics=cat_statics)
+        fuse_hist=fuse_hist, feature_shards=feature_shards,
+        axis_name=None, cat_statics=cat_statics)
 
 
 def grow_tree_chunk_core(
@@ -1151,7 +1082,8 @@ def grow_tree_chunk_core(
         min_data_in_leaf: int, min_sum_hessian: float,
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         partition: str = "sort", chunk_rows: int = 65536,
-        fuse_hist: bool = True, axis_name=None, cat_statics=None):
+        fuse_hist: bool = True, feature_shards: int = 0,
+        axis_name=None, cat_statics=None):
     """Switch-free whole-tree growth over fixed-size chunks.
 
     The compact strategy resolves dynamic leaf sizes with a lax.switch
@@ -1182,12 +1114,15 @@ def grow_tree_chunk_core(
     The smaller child's histogram accumulates over its chunks after the
     move (sibling = parent - smaller, FeatureHistogram::Subtract).
 
-    axis_name enables the data-parallel psum mode (rows sharded; the
-    root and smaller-child histograms psum-replicate and every shard
-    runs the identical scan — the compact core's non-sliced reduction,
-    reference data_parallel_tree_learner.cpp:149-164 in its replicated
-    rendering). The scatter/feature/voting reductions and the
-    LRU-capped pool stay on the compact strategy.
+    axis_name enables the sharded modes: data-parallel psum (rows
+    sharded; root and smaller-child histograms psum-replicate and every
+    shard runs the identical scan — the compact core's non-sliced
+    reduction, reference data_parallel_tree_learner.cpp:149-164 in its
+    replicated rendering), and with feature_shards > 1 the
+    feature-parallel mode (rows replicated, histograms built and
+    scanned per column slice, winners elected via make_sliced_search —
+    feature_parallel_tree_learner.cpp:33-76). The scatter and voting
+    reductions and the LRU-capped pool stay on the compact strategy.
     """
     from ..ops.histogram import build_histogram
     n = grad.shape[0]
@@ -1204,12 +1139,47 @@ def grow_tree_chunk_core(
         max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
         bynode_k=bynode_k)
-    (node_mask, scan, store_best, scan2,
-     best_row) = _tree_helpers(
-        base_mask, f_numbins, f_missing, f_default, f_monotone,
-        f_penalty, f_elide, hist_idx,
-        f_categorical=f_categorical, cat_statics=cat_statics,
-        **helper_kwargs)
+    fp = feature_shards > 1 and axis_name is not None
+    per_w = 32 // item_bits
+    if fp:
+        # feature-parallel: rows replicated, each shard builds and scans
+        # only its word-aligned column slice; the winner is elected from
+        # the all_gather of candidate rows (make_sliced_search)
+        (_, search_row, search2, cs, shard, _start) = make_sliced_search(
+            axis_name=axis_name, fp=True, D=feature_shards,
+            c_cols=c_cols, col_bins=col_bins, item_bits=item_bits,
+            base_mask=base_mask, f_numbins=f_numbins, f_missing=f_missing,
+            f_default=f_default, f_monotone=f_monotone,
+            f_penalty=f_penalty, f_elide=f_elide,
+            f_categorical=f_categorical, has_cat=has_cat,
+            cat_statics=cat_statics, helper_kwargs=helper_kwargs)
+        cs_words = cs // per_w
+        assert cw >= cs_words * feature_shards, \
+            "feature-parallel needs codes packed to the padded column count"
+        w0 = (shard * cs_words).astype(jnp.int32)
+        hist_w = cs
+
+        def decode_hist_cols(words2d):
+            wsl = jax.lax.dynamic_slice(
+                words2d, (jnp.int32(0), w0), (words2d.shape[0], cs_words))
+            return _unpack_codes(wsl, cs, item_bits)
+    else:
+        (node_mask, scan, store_best, scan2,
+         best_row) = _tree_helpers(
+            base_mask, f_numbins, f_missing, f_default, f_monotone,
+            f_penalty, f_elide, hist_idx,
+            f_categorical=f_categorical, cat_statics=cat_statics,
+            **helper_kwargs)
+        hist_w = c_cols
+
+        def decode_hist_cols(words2d):
+            return _unpack_codes(words2d[:, :cw], c_cols, item_bits)
+
+        def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
+            res, cm = scan(col_hist, sg, sh, cnt, mn, mx, node_mask(key))
+            return best_row(res, child_depth), cm
+
+        search2 = search2_simple(scan2, best_row)
 
     gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)
     ids = jnp.arange(n, dtype=jnp.uint32)[:, None]
@@ -1217,24 +1187,36 @@ def grow_tree_chunk_core(
     data0 = jnp.concatenate(
         [data0, jnp.zeros((CH, d_cols), jnp.uint32)], axis=0)
 
-    hist0 = build_histogram(codes_row, gh, col_bins, use_pallas=use_pallas)
-    if axis_name is not None:
-        hist0 = jax.lax.psum(hist0, axis_name)
-    totals = hist0[0].sum(axis=0)
+    if fp:
+        # rows replicated: totals come straight from gh; root histogram
+        # from this shard's column slice only
+        totals = gh.sum(axis=0)
+        cr = codes_row
+        if cr.shape[1] < cs * feature_shards:
+            cr = jnp.pad(
+                cr, ((0, 0), (0, cs * feature_shards - cr.shape[1])))
+        cr_sl = jax.lax.dynamic_slice(
+            cr, (jnp.int32(0), (shard * cs).astype(jnp.int32)), (n, cs))
+        hist0 = build_histogram(cr_sl, gh, col_bins, use_pallas=use_pallas)
+    else:
+        hist0 = build_histogram(codes_row, gh, col_bins,
+                                use_pallas=use_pallas)
+        if axis_name is not None:
+            hist0 = jax.lax.psum(hist0, axis_name)
+        totals = hist0[0].sum(axis=0)
     root_key, loop_key = jax.random.split(rng_key)
-    root_res, root_cm = scan(hist0, totals[0], totals[1], totals[2],
-                             jnp.float32(-np.inf), jnp.float32(np.inf),
-                             node_mask(root_key))
+    row0, cm0 = search_row(hist0, totals[0], totals[1], totals[2],
+                           jnp.float32(-np.inf), jnp.float32(np.inf),
+                           root_key, jnp.int32(0))
     best = jnp.full((L, 12), NEG_INF, jnp.float32).at[:, B_FEAT:].set(0.0)
-    best_cat = jnp.zeros((L, cat_b), jnp.float32)
-    best, best_cat = store_best(best, best_cat, 0, root_res, root_cm,
-                                jnp.int32(0))
+    best = best.at[0].set(row0)
+    best_cat = jnp.zeros((L, cat_b), jnp.float32).at[0].set(cm0)
     zi = functools.partial(jnp.zeros, dtype=jnp.int32)
     carry = _CarryK(
         k=jnp.int32(0), data=data0, scratch=jnp.zeros_like(data0),
         pos_leaf=jnp.zeros(n + CH, jnp.int32),
         leaf_begin=zi(L), leaf_phys=zi(L).at[0].set(n),
-        pool=jnp.zeros((L, c_cols, col_bins, 3), jnp.float32).at[0]
+        pool=jnp.zeros((L, hist_w, col_bins, 3), jnp.float32).at[0]
             .set(hist0),
         depth=zi(L),
         leaf_min=jnp.full((L,), -np.inf, jnp.float32),
@@ -1263,10 +1245,10 @@ def grow_tree_chunk_core(
         # the GLOBALLY smaller child (replicated record counts) decides
         # which side's rows accumulate the fused histogram
         left_small = row[B_LCNT] <= row[B_RCNT]
-        hist_zero = jnp.zeros((c_cols, col_bins, 3), jnp.float32)
+        hist_zero = jnp.zeros((hist_w, col_bins, 3), jnp.float32)
 
         def chunk_hist(rows_win, count):
-            codes = _unpack_codes(rows_win[:, :cw], c_cols, item_bits)
+            codes = decode_hist_cols(rows_win[:, :cw])
             v = (iota_ch < count).astype(jnp.float32)
             ghw = jax.lax.bitcast_convert_type(
                 rows_win[:, cw:cw + 3], jnp.float32) * v[:, None]
@@ -1350,7 +1332,7 @@ def grow_tree_chunk_core(
 
             hist_small = jax.lax.fori_loop(0, -(-sc // CH), pass_h,
                                            hist_zero)
-        if axis_name is not None:
+        if axis_name is not None and not fp:
             hist_small = jax.lax.psum(hist_small, axis_name)
 
         sibling = c.pool[l] - hist_small
@@ -1372,8 +1354,7 @@ def grow_tree_chunk_core(
             mono_f=f_monotone[feat], best_cat_l=c.best_cat[l],
             leaf_min=c.leaf_min, leaf_max=c.leaf_max, depth=c.depth,
             rec=c.rec, rec_cat=c.rec_cat, best=b, best_cat=c.best_cat,
-            hist_l=hist_l, hist_r=hist_r,
-            search2=search2_simple(scan2, best_row))
+            hist_l=hist_l, hist_r=hist_r, search2=search2)
         return _CarryK(new_id, data, scratch, pos_leaf, leaf_begin,
                        leaf_phys, pool, depth, leaf_min, leaf_max,
                        best2, best_cat2, rec2, rec_cat2, key)
@@ -1384,6 +1365,100 @@ def grow_tree_chunk_core(
         out.pos_leaf[:n], unique_indices=True)
     return (out.rec, out.rec_cat if has_cat else None,
             leaf_id, out.k, totals)
+
+
+def make_sliced_search(*, axis_name, fp, D, c_cols, col_bins, item_bits,
+                       base_mask, f_numbins, f_missing, f_default,
+                       f_monotone, f_penalty, f_elide, f_categorical,
+                       has_cat, cat_statics, helper_kwargs):
+    """Feature-sliced scan + candidate election, shared by the compact
+    core's scatter/feature-parallel modes and the chunk core's
+    feature-parallel mode: every shard searches only the columns it owns
+    (after the reduce-scatter in scatter mode — fp=False — or built
+    directly over its slice in feature-parallel mode — fp=True), then
+    the winner is elected from an all_gather of per-shard candidate rows
+    (SyncUpGlobalBestSplit role). Returns (reduce_hist, search_row,
+    search2_rows, cs, shard, start)."""
+    f_all = int(f_numbins.shape[0])
+    assert f_all == c_cols, \
+        "sliced modes require identity feature->column mapping"
+    if fp:
+        # slice boundaries fall on packed-word boundaries so the
+        # window decode can slice words directly
+        cs = padded_shard_cols(c_cols, D, item_bits)
+    else:
+        cs = -(-c_cols // D)            # columns per shard (padded)
+    c_pad = cs * D
+    shard = jax.lax.axis_index(axis_name)
+    start = (shard * cs).astype(jnp.int32)
+
+    def pad1(a, fill):
+        return jnp.pad(a, (0, c_pad - f_all), constant_values=fill)
+
+    def sl(a):
+        return jax.lax.dynamic_slice_in_dim(a, start, cs)
+
+    mask_sl = sl(pad1(base_mask, False))
+    nb_sl = sl(pad1(f_numbins, 1))
+    miss_sl = sl(pad1(f_missing, 0))
+    def_sl = sl(pad1(f_default, 0))
+    mono_sl = sl(pad1(f_monotone, 0))
+    pen_sl = sl(pad1(f_penalty, 1.0))
+    elide_sl = sl(pad1(f_elide, 0))
+    cat_sl = sl(pad1(f_categorical, 0)) if has_cat else None
+    # local expansion gather for the slice's flattened (cs*B + 1)
+    # column histogram (identity mapping: feature j bin b -> j*B + b)
+    hi_local = (jnp.arange(cs, dtype=jnp.int32)[:, None] * col_bins
+                + jnp.arange(col_bins, dtype=jnp.int32)[None, :])
+    hi_local = jnp.where(
+        jnp.arange(col_bins, dtype=jnp.int32)[None, :] < nb_sl[:, None],
+        hi_local, cs * col_bins)
+    (_, scan_sl, _, _, best_row) = _tree_helpers(
+        mask_sl, nb_sl, miss_sl, def_sl, mono_sl, pen_sl, elide_sl,
+        hi_local, f_categorical=cat_sl, cat_statics=cat_statics,
+        **helper_kwargs)
+
+    if fp:
+        def reduce_hist(h):
+            return h     # already the local slice over ALL rows
+    else:
+        def reduce_hist(h):
+            h = jnp.pad(h, ((0, c_pad - c_cols), (0, 0), (0, 0)))
+            return jax.lax.psum_scatter(
+                h, axis_name, scatter_dimension=0, tiled=True)
+
+    def _elect(row, cm):
+        # the candidate row carries its (B,) categorical left-bin
+        # mask through the election so every shard can route the
+        # partition on a categorical winner it does not own
+        # (SyncUpGlobalBestSplit's serialized cat_threshold role,
+        # split_info.hpp:22-193)
+        payload = jnp.concatenate([row, cm])     # (12 + cat_b,)
+        rows = jax.lax.all_gather(payload, axis_name)
+        win = rows[jnp.argmax(rows[:, B_GAIN])]
+        return win[:12], win[12:]
+
+    def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
+        res, cm = scan_sl(col_hist, sg, sh, cnt, mn, mx, mask_sl)
+        row = best_row(res, child_depth)
+        row = row.at[B_FEAT].add(start.astype(jnp.float32))
+        return _elect(row, cm)
+
+    def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
+                     child_depth):
+        res2, cm2 = jax.vmap(scan_sl)(
+            col_hist2, sg2, sh2, cnt2, mn2, mx2,
+            jnp.broadcast_to(mask_sl, (2,) + mask_sl.shape))
+        rows = jax.vmap(
+            functools.partial(best_row, child_depth=child_depth))(res2)
+        rows = rows.at[:, B_FEAT].add(start.astype(jnp.float32))
+        payload = jnp.concatenate([rows, cm2], axis=1)   # (2, 12+cat_b)
+        g = jax.lax.all_gather(payload, axis_name)       # (D, 2, .)
+        win = jnp.argmax(g[:, :, B_GAIN], axis=0)        # (2,)
+        sel = g[win, jnp.arange(2)]
+        return sel[:, :12], sel[:, 12:]
+
+    return reduce_hist, search_row, search2_rows, cs, shard, start
 
 
 def partition_window(win: jax.Array, key3: jax.Array,

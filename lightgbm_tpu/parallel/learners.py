@@ -88,6 +88,20 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         return mask
 
 
+def _sharded_chunk_opt_in(learner) -> str:
+    """The ONE copy of the sharded learners' chunk opt-in: honor
+    LGBM_TPU_STRATEGY=chunk when the learner class supports the chunk
+    core (DP psum / FP sliced; voting's 2-stage election lives in the
+    compact core's reduction seams only), warn when it cannot."""
+    from ..utils.envs import strategy_env
+    want = strategy_env()
+    capable = getattr(learner, "_chunk_capable", True)
+    if want == "chunk" and not capable:
+        log.warning("%s does not support the chunk strategy; "
+                    "using compact", type(learner).__name__)
+    return "chunk" if (want == "chunk" and capable) else "compact"
+
+
 def _dp_pspec(mesh):
     return NamedSharding(mesh, P("data"))
 
@@ -492,17 +506,10 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     def __init__(self, config: Config, dataset: Dataset,
                  mesh: Optional[Mesh] = None):
         # LGBM_TPU_STRATEGY=chunk opts the sharded program into the
-        # switch-free chunk core (psum reduction only); anything else
-        # runs compact. resolve_strategy may fall chunk back to compact
-        # (LRU-capped pool), so read the resolved value afterwards.
-        from ..utils.envs import strategy_env
-        want = strategy_env()
-        use_chunk = want == "chunk" and self._chunk_capable
-        if want == "chunk" and not self._chunk_capable:
-            log.warning("%s does not support the chunk strategy; "
-                        "using compact", type(self).__name__)
+        # switch-free chunk core; resolve_strategy may fall chunk back
+        # to compact (LRU-capped pool), so read self.strategy afterwards
         super().__init__(config, dataset,
-                         strategy="chunk" if use_chunk else "compact",
+                         strategy=_sharded_chunk_opt_in(self),
                          device_place=False)
         self.mesh = mesh or make_mesh(axis_name="data")
         self.shards = int(self.mesh.devices.size)
@@ -775,7 +782,8 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
 
     def __init__(self, config: Config, dataset: Dataset,
                  mesh: Optional[Mesh] = None):
-        super().__init__(config, dataset, strategy="compact",
+        super().__init__(config, dataset,
+                         strategy=_sharded_chunk_opt_in(self),
                          device_place=False)
         self.mesh = mesh or make_mesh(axis_name="feature")
         self.shards = int(self.mesh.devices.size)
@@ -796,6 +804,14 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         self._tree_fn = None
 
     def _grow_statics(self):
+        if self.strategy == "chunk":
+            from ..utils.envs import flag
+            return dict(c_cols=self.c_cols, item_bits=self.item_bits,
+                        chunk_rows=self.chunk_rows,
+                        fuse_hist=not flag("LGBM_TPU_CHUNK_NO_FUSE_HIST"),
+                        feature_shards=self.shards,
+                        partition=self._partition_mode,
+                        **self._statics())
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
                     feature_shards=self.shards,
@@ -804,12 +820,15 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                     **self._statics())
 
     def _sharded_tree_fn(self):
-        from ..models.device_learner import grow_tree_compact_core
+        from ..models.device_learner import (grow_tree_chunk_core,
+                                             grow_tree_compact_core)
+        grow_core = (grow_tree_chunk_core if self.strategy == "chunk"
+                     else grow_tree_compact_core)
         statics = self._grow_statics()
         meta = self._meta
 
         def local(cp, cr, g, h, w, base_mask, key):
-            rec, rec_cat, leaf_id, ks, tot = grow_tree_compact_core(
+            rec, rec_cat, leaf_id, ks, tot = grow_core(
                 cp, cr, g, h, w, base_mask, *meta, key,
                 axis_name="feature", **statics)
             # replicated: the elected candidate row carries the winning

@@ -191,6 +191,35 @@ def test_chunk_data_parallel_categorical(monkeypatch):
     assert chunk_tree == grow("compact")
 
 
+def test_chunk_feature_parallel_matches_compact(monkeypatch):
+    # the chunk core's feature-parallel mode (sliced hists + election)
+    # must grow the identical tree as the compact FP learner
+    from lightgbm_tpu.parallel.learners import (
+        DeviceFeatureParallelTreeLearner)
+
+    r = np.random.RandomState(31)
+    n, f = 70000, 6
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+
+    def grow(strategy):
+        monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+        if strategy == "chunk":
+            monkeypatch.setenv("LGBM_TPU_STRATEGY", "chunk")
+        else:
+            monkeypatch.delenv("LGBM_TPU_STRATEGY", raising=False)
+        cfg = Config({"objective": "binary", "num_leaves": 31,
+                      "max_bin": 63, "min_data_in_leaf": 20,
+                      "verbosity": -1})
+        ds = Dataset(x, config=cfg, label=y)
+        lrn = DeviceFeatureParallelTreeLearner(cfg, ds)
+        assert lrn.strategy == strategy
+        return lrn.train(g, h).to_string()
+
+    assert grow("chunk") == grow("compact")
+
+
 def test_chunk_fused_training_end_to_end(monkeypatch):
     # the production path: lgb.train -> make_fused_step with bagging;
     # sanity (learns + roundtrips), not bit-parity (sigmoid gradients
