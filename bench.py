@@ -76,7 +76,11 @@ def make_higgs_like(n, f, seed=17, w=None, n_cat=0, card=64):
     bit-identical to the rounds 1-2 training sets. n_cat > 0 converts the
     LAST n_cat columns into categorical features (cardinality `card`)
     with per-category target effects — the Expo/Allstate-style
-    categorical-heavy shape (reference docs/Experiments.rst datasets)."""
+    categorical-heavy shape (reference docs/Experiments.rst datasets).
+
+    `w` is a `(w_num, cat_tables)` tuple (since round 3; previously a
+    bare ndarray) — callers replaying a returned `w_true` must unpack
+    it, even at n_cat=0 where `cat_tables` is just `[]`."""
     r = np.random.RandomState(seed)
     x = r.randn(n, f).astype(np.float32)
     if w is None:

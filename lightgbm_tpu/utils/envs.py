@@ -27,10 +27,11 @@ def partition_mode_env() -> str:
     mode = os.environ.get("LGBM_TPU_PARTITION", "").strip().lower()
     if mode in ("sort", "scan", "pallas"):
         return mode
+    resolved = "pallas" if flag("LGBM_TPU_PALLAS_PART") else "sort"
     if mode:
         from . import log
-        log.warning("Unknown LGBM_TPU_PARTITION=%r; using default", mode)
-    return "pallas" if flag("LGBM_TPU_PALLAS_PART") else "sort"
+        log.warning("Unknown LGBM_TPU_PARTITION=%r; using %s", mode, resolved)
+    return resolved
 
 
 def strategy_env(default: str = "auto") -> str:

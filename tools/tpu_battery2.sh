@@ -22,18 +22,22 @@ RES=/tmp/tpu_bench_results2.log
 probe() {
   # /tmp/battery_cutoff (epoch secs) guards the round boundary: a step
   # that would still be mid-TPU-op when the driver takes over risks a
-  # SIGTERM-induced tunnel wedge for the driver's own bench
+  # SIGTERM-induced tunnel wedge for the driver's own bench.
+  # rc=2 distinguishes a clean cutoff stop from a tunnel outage.
   if [ -f /tmp/battery_cutoff ] \
       && [ "$(date +%s)" -gt "$(cat /tmp/battery_cutoff)" ]; then
-    echo "!! battery cutoff reached — stopping cleanly" >> $RES
-    return 1
+    return 2
   fi
   timeout 150 python -c "import jax; assert jax.default_backend()=='tpu'" \
-    2>/dev/null
+    2>/dev/null || return 1
 }
 step() {  # step <name> <internal_deadline_s> <env...>
   local name="$1" dl="$2"; shift 2
-  if ! probe; then
+  probe; local prc=$?
+  if [ $prc -eq 2 ]; then
+    echo "!! battery cutoff reached before step '$name' — stopping cleanly" >> $RES
+    exit 0
+  elif [ $prc -ne 0 ]; then
     echo "!! tunnel down before step '$name' — battery stops" >> $RES
     exit 1
   fi

@@ -6,31 +6,34 @@
 cd /root/repo
 RES=/tmp/tpu_bench_results3.log
 probe() {
-  # round-boundary guard: see tpu_battery2.sh
+  # round-boundary guard: see tpu_battery2.sh. rc=2 = cutoff, rc=1 = down.
   if [ -f /tmp/battery_cutoff ] \
       && [ "$(date +%s)" -gt "$(cat /tmp/battery_cutoff)" ]; then
-    echo "!! battery cutoff reached — stopping cleanly" >> $RES
-    return 1
+    return 2
   fi
   timeout 150 python -c "import jax; assert jax.default_backend()=='tpu'" \
-    2>/dev/null
+    2>/dev/null || return 1
+}
+guard() {  # guard <name>: exit cleanly on cutoff, rc=1 on tunnel outage
+  probe; local prc=$?
+  if [ $prc -eq 2 ]; then
+    echo "!! battery cutoff reached before '$1' — stopping cleanly" >> $RES
+    exit 0
+  elif [ $prc -ne 0 ]; then
+    echo "!! tunnel down before '$1' — battery stops" >> $RES
+    exit 1
+  fi
 }
 run() {  # run <name> <outer_timeout_s> <cmd...>
   local name="$1" to="$2"; shift 2
-  if ! probe; then
-    echo "!! tunnel down before '$name' — battery stops" >> $RES
-    exit 1
-  fi
+  guard "$name"
   echo "--- $name ---" >> $RES
   timeout -s INT -k 120 "$to" "$@" >> $RES 2>&1
   echo "--- end rc=$? $(date +%H:%M:%S) ---" >> $RES
 }
 bench() {  # bench <name> <internal_deadline_s> <env...>
   local name="$1" dl="$2"; shift 2
-  if ! probe; then
-    echo "!! tunnel down before bench '$name' — battery stops" >> $RES
-    exit 1
-  fi
+  guard "bench $name"
   echo "--- $name ---" >> $RES
   env "$@" BENCH_DEADLINE=$dl timeout -s INT -k 120 $((dl + 300)) \
     python bench.py >> $RES 2>&1

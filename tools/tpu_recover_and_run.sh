@@ -20,8 +20,14 @@ done
 echo "--- overhead probe $(date +%H:%M:%S) ---" >> $RES
 timeout -s INT -k 120 1200 python tools/tpu_overhead_probe.py >> $RES 2>&1
 echo "--- end overhead probe rc=$? ---" >> $RES
+cutoff_hit() {
+  [ -f /tmp/battery_cutoff ] \
+    && [ "$(date +%s)" -gt "$(cat /tmp/battery_cutoff)" ]
+}
 bash tools/tpu_battery3.sh || { echo "battery3 aborted (tunnel down)" >> $RES; exit 1; }
+cutoff_hit && { echo "cutoff reached after battery3; stopping" >> $RES; exit 0; }
 bash tools/tpu_battery2.sh || { echo "battery aborted (tunnel down); skipping profile" >> $RES; exit 1; }
+cutoff_hit && { echo "cutoff reached after battery2; skipping profile" >> $RES; exit 0; }
 echo "--- profile_iter 1M $(date +%H:%M:%S) ---" >> $RES
 timeout -s INT -k 120 1200 python tools/profile_iter.py 1000000 5 >> $RES 2>&1
 echo "--- end profile_iter rc=$? ---" >> $RES
