@@ -463,6 +463,192 @@ def grow_tree_compact(
         cat_statics=cat_statics)
 
 
+def make_voting_search(*, axis_name, voting_k, c_cols, col_bins,
+                       base_mask, f_numbins, f_missing, f_default,
+                       f_monotone, f_penalty, f_elide, hist_idx,
+                       f_categorical, has_cat, cat_statics,
+                       helper_kwargs):
+    """PV-Tree 2-stage voting reduction + search, shared by the
+    compact and chunk growth cores (the voting seam of
+    voting_parallel_tree_learner.cpp:170-260): per split, every
+    shard scans its LOCAL histograms with 1/D-scaled data gates,
+    votes for its top-k features, the vote psum elects 2k global
+    candidates, and ONLY the elected features' histograms are
+    reduced — O(2k*B) communication per split instead of O(F*B).
+    Deterministic and replicated on every shard, so no best-split
+    broadcast is needed. Returns (reduce_hist, search_row,
+    search2_rows); reduce_hist is the identity (histograms stay
+    local until election)."""
+    num_bins = helper_kwargs["num_bins"]
+    l1 = helper_kwargs["l1"]
+    l2 = helper_kwargs["l2"]
+    max_delta_step = helper_kwargs["max_delta_step"]
+    min_data_in_leaf = helper_kwargs["min_data_in_leaf"]
+    min_sum_hessian = helper_kwargs["min_sum_hessian"]
+    min_gain_to_split = helper_kwargs["min_gain_to_split"]
+    cat_b = num_bins if has_cat else 1
+    f_all = int(f_numbins.shape[0])
+    assert f_all == c_cols, \
+        "voting mode requires identity feature->column mapping"
+    n_elect = min(2 * voting_k, f_all)
+    # the reference scales the local gates by machine count
+    # (voting_parallel_tree_learner.cpp:57-59)
+    d_v = jax.lax.psum(1, axis_name)
+    (node_mask, _, _, _, best_row) = _tree_helpers(
+        base_mask, f_numbins, f_missing, f_default, f_monotone,
+        f_penalty, f_elide, hist_idx, **helper_kwargs)
+    scan_kwargs_local = dict(
+        num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
+        # integer division for the count gate, exactly the
+        # reference's local_config (voting_parallel:58-59)
+        min_data_in_leaf=jnp.asarray(min_data_in_leaf,
+                                     jnp.int32) // d_v,
+        min_sum_hessian=min_sum_hessian / d_v,
+        min_gain_to_split=min_gain_to_split)
+    scan_kwargs_global = dict(
+        num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf,
+        min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split)
+    if has_cat:
+        # categorical candidates ride the same vote/elect/reduce
+        # pipeline: local rel gains merge the categorical search
+        # (scaled gates, like the numerical local config) and the
+        # elected global scan re-runs both searches on the psum'd
+        # histograms. Every shard computes the identical elected
+        # scan, so the winning left-bin mask is replicated — no
+        # mask transport is needed in voting mode.
+        is_cat_v = f_categorical != 0
+        cat_l2_v, cat_smooth_v, max_cat_threshold_v, \
+            max_cat_to_onehot_v, min_data_per_group_v = cat_statics
+        cat_extra = dict(
+            cat_l2=cat_l2_v, cat_smooth=cat_smooth_v,
+            max_cat_threshold=max_cat_threshold_v,
+            max_cat_to_onehot=max_cat_to_onehot_v,
+            min_data_per_group=min_data_per_group_v)
+        cat_kwargs_local = dict(scan_kwargs_local, **cat_extra)
+        cat_kwargs_global = dict(scan_kwargs_global, **cat_extra)
+
+    def _local_rel(col_hist_l, fmask):
+        """Per-feature local best gains from the shard's histograms."""
+        lt = col_hist_l[0].sum(axis=0)        # local (sg, sh, cnt)
+        hist = bundle_ops.expand_column_hist(
+            col_hist_l, lt, hist_idx, f_elide, f_default)
+        rel, _, _, _ = split_ops.per_feature_best(
+            hist, lt[0], lt[1], lt[2], f_numbins, f_missing, f_default,
+            fmask & ~is_cat_v if has_cat else fmask, f_monotone,
+            jnp.float32(-np.inf),
+            jnp.float32(np.inf), f_penalty, None, **scan_kwargs_local)
+        if has_cat:
+            crel, _ = split_ops.per_feature_best_categorical(
+                hist, lt[0], lt[1], lt[2], f_numbins, f_missing,
+                fmask & is_cat_v, jnp.float32(-np.inf),
+                jnp.float32(np.inf), f_penalty, **cat_kwargs_local)
+            rel = jnp.maximum(rel, crel)
+        return rel                            # (F,)
+
+    def _vote(rel):
+        """Exactly-k vote mask from local rel gains (lax.top_k ties
+        break by index, same as the host learner — a >=kth threshold
+        would let gain ties cast extra votes)."""
+        _, top_idx = jax.lax.top_k(rel, min(voting_k, f_all))
+        return jnp.zeros(f_all, jnp.float32).at[top_idx].add(
+            jnp.where(rel[top_idx] > NEG_INF / 2, 1.0, 0.0))
+
+    def _elected_scan(col_hist_l, elect, sg, sh, cnt, mn, mx, fmask,
+                      child_depth):
+        """Reduce elected features' histograms and find the winner."""
+        hist_e = jax.lax.psum(jnp.take(col_hist_l, elect, axis=0),
+                              axis_name)      # (2k, B, 3) global
+        nb_e = jnp.take(f_numbins, elect)
+        hi_e = (jnp.arange(n_elect, dtype=jnp.int32)[:, None] * col_bins
+                + jnp.arange(col_bins, dtype=jnp.int32)[None, :])
+        hi_e = jnp.where(
+            jnp.arange(col_bins, dtype=jnp.int32)[None, :]
+            < nb_e[:, None], hi_e, n_elect * col_bins)
+        hist_f = bundle_ops.expand_column_hist(
+            hist_e, jnp.stack([sg, sh, cnt]), hi_e,
+            jnp.take(f_elide, elect), jnp.take(f_default, elect))
+        fmask_e = jnp.take(fmask, elect)
+        if has_cat:
+            is_cat_e = jnp.take(is_cat_v, elect)
+        rel, t, use_m1, prefix = split_ops.per_feature_best(
+            hist_f, sg, sh, cnt, nb_e, jnp.take(f_missing, elect),
+            jnp.take(f_default, elect),
+            fmask_e & ~is_cat_e if has_cat else fmask_e,
+            jnp.take(f_monotone, elect), mn, mx,
+            jnp.take(f_penalty, elect), None, **scan_kwargs_global)
+        fe = jnp.argmax(rel).astype(jnp.int32)
+        res = split_ops.materialize_split(
+            fe, rel, t, use_m1, prefix, sg, sh, cnt, mn, mx,
+            l1=l1, l2=l2, max_delta_step=max_delta_step)
+        if has_cat:
+            crel, caux = split_ops.per_feature_best_categorical(
+                hist_f, sg, sh, cnt, nb_e, jnp.take(f_missing, elect),
+                fmask_e & is_cat_e, mn, mx,
+                jnp.take(f_penalty, elect), **cat_kwargs_global)
+            cfe = jnp.argmax(crel).astype(jnp.int32)
+            cres = split_ops.materialize_cat_split(
+                cfe, crel, caux, hist_f, sg, sh, cnt, mn, mx,
+                l1=l1, l2=l2, cat_l2=cat_l2_v,
+                max_delta_step=max_delta_step)
+            res, cm = _merge_num_cat(res, cres)
+        else:
+            cm = jnp.zeros((cat_b,), jnp.float32)
+        row = best_row(res, child_depth)
+        # map the elected-subset index back to the real feature id
+        sub_f = res.feature.astype(jnp.int32)
+        return row.at[B_FEAT].set(
+            jnp.take(elect, sub_f).astype(jnp.float32)), cm
+
+    def reduce_hist(h):
+        return h                               # stays local
+
+    def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
+        fmask = node_mask(key)
+        rel = _local_rel(col_hist, fmask)
+        votes = jax.lax.psum(_vote(rel), axis_name)
+        elect = jnp.argsort(
+            -votes, stable=True)[:n_elect].astype(jnp.int32)
+        return _elected_scan(col_hist, elect, sg, sh, cnt, mn, mx,
+                             fmask, child_depth)
+
+    # batched 2-child elected reduction: ONE (2, 2k, B, 3) psum per
+    # split instead of two sequential ones — half the collective
+    # latency on real ICI. XLA:CPU's collective rendezvous fatally
+    # aborts on the batched form under the virtual mesh (hard 40s
+    # timeout, observed round 2), so the lever defaults to
+    # backend-keyed auto. LGBM_TPU_VOTING_BATCHED=0/1 overrides.
+    vb_env = _env("LGBM_TPU_VOTING_BATCHED", "auto")
+    voting_batched = (jax.default_backend() == "tpu"
+                      if vb_env == "auto" else vb_env == "1")
+
+    def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
+                     child_depth):
+        fmask2 = jax.vmap(node_mask)(keys2)
+        rel2 = jax.vmap(_local_rel)(col_hist2, fmask2)
+        votes2 = jax.lax.psum(jax.vmap(_vote)(rel2), axis_name)
+        elect2 = jnp.argsort(
+            -votes2, axis=1,
+            stable=True)[:, :n_elect].astype(jnp.int32)
+        if voting_batched:
+            rows2, cm2 = jax.vmap(
+                _elected_scan,
+                in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None))(
+                col_hist2, elect2, sg2, sh2, cnt2, mn2, mx2, fmask2,
+                child_depth)
+        else:
+            pairs = [
+                _elected_scan(col_hist2[i], elect2[i], sg2[i], sh2[i],
+                              cnt2[i], mn2[i], mx2[i], fmask2[i],
+                              child_depth)
+                for i in range(2)]
+            rows2 = jnp.stack([p[0] for p in pairs])
+            cm2 = jnp.stack([p[1] for p in pairs])
+        return rows2, cm2
+    return reduce_hist, search_row, search2_rows
+
+
 def grow_tree_compact_core(
         codes_pack: jax.Array, codes_row: jax.Array,
         grad: jax.Array, hess: jax.Array, w: jax.Array,
@@ -541,172 +727,14 @@ def grow_tree_compact_core(
     per_w = 32 // item_bits
 
     if voting:
-        # PV-Tree 2-stage voting (voting_parallel_tree_learner.cpp:170-
-        # 260): per split, every shard scans its LOCAL histograms with
-        # 1/D-scaled data gates, votes for its top-k features, the vote
-        # psum elects 2k global candidates, and ONLY the elected
-        # features' histograms are reduced — O(2k*B) communication per
-        # split instead of O(F*B). Deterministic and replicated on every
-        # shard, so no best-split broadcast is needed.
-        f_all = int(f_numbins.shape[0])
-        assert f_all == c_cols, \
-            "voting mode requires identity feature->column mapping"
-        n_elect = min(2 * voting_k, f_all)
-        # the reference scales the local gates by machine count
-        # (voting_parallel_tree_learner.cpp:57-59)
-        d_v = jax.lax.psum(1, axis_name)
-        (node_mask, _, _, _, best_row) = _tree_helpers(
-            base_mask, f_numbins, f_missing, f_default, f_monotone,
-            f_penalty, f_elide, hist_idx, **helper_kwargs)
-        scan_kwargs_local = dict(
-            num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
-            # integer division for the count gate, exactly the
-            # reference's local_config (voting_parallel:58-59)
-            min_data_in_leaf=jnp.asarray(min_data_in_leaf,
-                                         jnp.int32) // d_v,
-            min_sum_hessian=min_sum_hessian / d_v,
-            min_gain_to_split=min_gain_to_split)
-        scan_kwargs_global = dict(
-            num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
-            min_data_in_leaf=min_data_in_leaf,
-            min_sum_hessian=min_sum_hessian,
-            min_gain_to_split=min_gain_to_split)
-        if has_cat:
-            # categorical candidates ride the same vote/elect/reduce
-            # pipeline: local rel gains merge the categorical search
-            # (scaled gates, like the numerical local config) and the
-            # elected global scan re-runs both searches on the psum'd
-            # histograms. Every shard computes the identical elected
-            # scan, so the winning left-bin mask is replicated — no
-            # mask transport is needed in voting mode.
-            is_cat_v = f_categorical != 0
-            cat_l2_v, cat_smooth_v, max_cat_threshold_v, \
-                max_cat_to_onehot_v, min_data_per_group_v = cat_statics
-            cat_extra = dict(
-                cat_l2=cat_l2_v, cat_smooth=cat_smooth_v,
-                max_cat_threshold=max_cat_threshold_v,
-                max_cat_to_onehot=max_cat_to_onehot_v,
-                min_data_per_group=min_data_per_group_v)
-            cat_kwargs_local = dict(scan_kwargs_local, **cat_extra)
-            cat_kwargs_global = dict(scan_kwargs_global, **cat_extra)
-
-        def _local_rel(col_hist_l, fmask):
-            """Per-feature local best gains from the shard's histograms."""
-            lt = col_hist_l[0].sum(axis=0)        # local (sg, sh, cnt)
-            hist = bundle_ops.expand_column_hist(
-                col_hist_l, lt, hist_idx, f_elide, f_default)
-            rel, _, _, _ = split_ops.per_feature_best(
-                hist, lt[0], lt[1], lt[2], f_numbins, f_missing, f_default,
-                fmask & ~is_cat_v if has_cat else fmask, f_monotone,
-                jnp.float32(-np.inf),
-                jnp.float32(np.inf), f_penalty, None, **scan_kwargs_local)
-            if has_cat:
-                crel, _ = split_ops.per_feature_best_categorical(
-                    hist, lt[0], lt[1], lt[2], f_numbins, f_missing,
-                    fmask & is_cat_v, jnp.float32(-np.inf),
-                    jnp.float32(np.inf), f_penalty, **cat_kwargs_local)
-                rel = jnp.maximum(rel, crel)
-            return rel                            # (F,)
-
-        def _vote(rel):
-            """Exactly-k vote mask from local rel gains (lax.top_k ties
-            break by index, same as the host learner — a >=kth threshold
-            would let gain ties cast extra votes)."""
-            _, top_idx = jax.lax.top_k(rel, min(voting_k, f_all))
-            return jnp.zeros(f_all, jnp.float32).at[top_idx].add(
-                jnp.where(rel[top_idx] > NEG_INF / 2, 1.0, 0.0))
-
-        def _elected_scan(col_hist_l, elect, sg, sh, cnt, mn, mx, fmask,
-                          child_depth):
-            """Reduce elected features' histograms and find the winner."""
-            hist_e = jax.lax.psum(jnp.take(col_hist_l, elect, axis=0),
-                                  axis_name)      # (2k, B, 3) global
-            nb_e = jnp.take(f_numbins, elect)
-            hi_e = (jnp.arange(n_elect, dtype=jnp.int32)[:, None] * col_bins
-                    + jnp.arange(col_bins, dtype=jnp.int32)[None, :])
-            hi_e = jnp.where(
-                jnp.arange(col_bins, dtype=jnp.int32)[None, :]
-                < nb_e[:, None], hi_e, n_elect * col_bins)
-            hist_f = bundle_ops.expand_column_hist(
-                hist_e, jnp.stack([sg, sh, cnt]), hi_e,
-                jnp.take(f_elide, elect), jnp.take(f_default, elect))
-            fmask_e = jnp.take(fmask, elect)
-            if has_cat:
-                is_cat_e = jnp.take(is_cat_v, elect)
-            rel, t, use_m1, prefix = split_ops.per_feature_best(
-                hist_f, sg, sh, cnt, nb_e, jnp.take(f_missing, elect),
-                jnp.take(f_default, elect),
-                fmask_e & ~is_cat_e if has_cat else fmask_e,
-                jnp.take(f_monotone, elect), mn, mx,
-                jnp.take(f_penalty, elect), None, **scan_kwargs_global)
-            fe = jnp.argmax(rel).astype(jnp.int32)
-            res = split_ops.materialize_split(
-                fe, rel, t, use_m1, prefix, sg, sh, cnt, mn, mx,
-                l1=l1, l2=l2, max_delta_step=max_delta_step)
-            if has_cat:
-                crel, caux = split_ops.per_feature_best_categorical(
-                    hist_f, sg, sh, cnt, nb_e, jnp.take(f_missing, elect),
-                    fmask_e & is_cat_e, mn, mx,
-                    jnp.take(f_penalty, elect), **cat_kwargs_global)
-                cfe = jnp.argmax(crel).astype(jnp.int32)
-                cres = split_ops.materialize_cat_split(
-                    cfe, crel, caux, hist_f, sg, sh, cnt, mn, mx,
-                    l1=l1, l2=l2, cat_l2=cat_l2_v,
-                    max_delta_step=max_delta_step)
-                res, cm = _merge_num_cat(res, cres)
-            else:
-                cm = jnp.zeros((cat_b,), jnp.float32)
-            row = best_row(res, child_depth)
-            # map the elected-subset index back to the real feature id
-            sub_f = res.feature.astype(jnp.int32)
-            return row.at[B_FEAT].set(
-                jnp.take(elect, sub_f).astype(jnp.float32)), cm
-
-        def reduce_hist(h):
-            return h                               # stays local
-
-        def search_row(col_hist, sg, sh, cnt, mn, mx, key, child_depth):
-            fmask = node_mask(key)
-            rel = _local_rel(col_hist, fmask)
-            votes = jax.lax.psum(_vote(rel), axis_name)
-            elect = jnp.argsort(
-                -votes, stable=True)[:n_elect].astype(jnp.int32)
-            return _elected_scan(col_hist, elect, sg, sh, cnt, mn, mx,
-                                 fmask, child_depth)
-
-        # batched 2-child elected reduction: ONE (2, 2k, B, 3) psum per
-        # split instead of two sequential ones — half the collective
-        # latency on real ICI. XLA:CPU's collective rendezvous fatally
-        # aborts on the batched form under the virtual mesh (hard 40s
-        # timeout, observed round 2), so the lever defaults to
-        # backend-keyed auto. LGBM_TPU_VOTING_BATCHED=0/1 overrides.
-        vb_env = _env("LGBM_TPU_VOTING_BATCHED", "auto")
-        voting_batched = (jax.default_backend() == "tpu"
-                          if vb_env == "auto" else vb_env == "1")
-
-        def search2_rows(col_hist2, sg2, sh2, cnt2, mn2, mx2, keys2,
-                         child_depth):
-            fmask2 = jax.vmap(node_mask)(keys2)
-            rel2 = jax.vmap(_local_rel)(col_hist2, fmask2)
-            votes2 = jax.lax.psum(jax.vmap(_vote)(rel2), axis_name)
-            elect2 = jnp.argsort(
-                -votes2, axis=1,
-                stable=True)[:, :n_elect].astype(jnp.int32)
-            if voting_batched:
-                rows2, cm2 = jax.vmap(
-                    _elected_scan,
-                    in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None))(
-                    col_hist2, elect2, sg2, sh2, cnt2, mn2, mx2, fmask2,
-                    child_depth)
-            else:
-                pairs = [
-                    _elected_scan(col_hist2[i], elect2[i], sg2[i], sh2[i],
-                                  cnt2[i], mn2[i], mx2[i], fmask2[i],
-                                  child_depth)
-                    for i in range(2)]
-                rows2 = jnp.stack([p[0] for p in pairs])
-                cm2 = jnp.stack([p[1] for p in pairs])
-            return rows2, cm2
+        reduce_hist, search_row, search2_rows = make_voting_search(
+            axis_name=axis_name, voting_k=voting_k, c_cols=c_cols,
+            col_bins=col_bins, base_mask=base_mask,
+            f_numbins=f_numbins, f_missing=f_missing,
+            f_default=f_default, f_monotone=f_monotone,
+            f_penalty=f_penalty, f_elide=f_elide, hist_idx=hist_idx,
+            f_categorical=f_categorical, has_cat=has_cat,
+            cat_statics=cat_statics, helper_kwargs=helper_kwargs)
     elif not sliced:
         (node_mask, scan, store_best, scan2,
          best_row) = _tree_helpers(
@@ -1083,6 +1111,7 @@ def grow_tree_chunk_core(
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         partition: str = "sort", chunk_rows: int = 65536,
         fuse_hist: bool = True, feature_shards: int = 0,
+        scatter_cols: int = 0, voting_k: int = 0,
         axis_name=None, cat_statics=None):
     """Switch-free whole-tree growth over fixed-size chunks.
 
@@ -1114,15 +1143,25 @@ def grow_tree_chunk_core(
     The smaller child's histogram accumulates over its chunks after the
     move (sibling = parent - smaller, FeatureHistogram::Subtract).
 
-    axis_name enables the sharded modes: data-parallel psum (rows
-    sharded; root and smaller-child histograms psum-replicate and every
-    shard runs the identical scan — the compact core's non-sliced
-    reduction, reference data_parallel_tree_learner.cpp:149-164 in its
-    replicated rendering), and with feature_shards > 1 the
-    feature-parallel mode (rows replicated, histograms built and
-    scanned per column slice, winners elected via make_sliced_search —
-    feature_parallel_tree_learner.cpp:33-76). The scatter and voting
-    reductions and the LRU-capped pool stay on the compact strategy.
+    axis_name enables the sharded modes, all four of the compact
+    core's reductions:
+      * data-parallel psum (rows sharded; root and smaller-child
+        histograms psum-replicate and every shard runs the identical
+        scan — data_parallel_tree_learner.cpp:149-164 in its
+        replicated rendering);
+      * scatter_cols > 1: the reference comm pattern — per-chunk
+        histograms accumulate full-width locally, ONE lax.psum_scatter
+        per split tiles the column axis so each shard scans only the
+        C/D columns it owns, and the winner is elected from a (D, 12+B)
+        all_gather of candidate rows (make_sliced_search;
+        data_parallel_tree_learner.cpp:149-200 + SyncUpGlobalBestSplit);
+      * voting_k > 0: PV-Tree 2-stage voting — local scan + top-k vote,
+        elect 2k, reduce only the elected features' histograms
+        (make_voting_search; voting_parallel_tree_learner.cpp:170-260);
+      * feature_shards > 1: feature-parallel (rows replicated,
+        histograms built and scanned per column slice, winners elected
+        via make_sliced_search — feature_parallel_tree_learner.cpp:33-76).
+    The LRU-capped histogram pool stays on the compact strategy.
     """
     from ..ops.histogram import build_histogram
     n = grad.shape[0]
@@ -1140,12 +1179,15 @@ def grow_tree_chunk_core(
         min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
         bynode_k=bynode_k)
     fp = feature_shards > 1 and axis_name is not None
+    scatter = scatter_cols > 1 and axis_name is not None and not fp
+    voting = voting_k > 0 and axis_name is not None and not (scatter or fp)
     per_w = 32 // item_bits
     if fp:
         # feature-parallel: rows replicated, each shard builds and scans
         # only its word-aligned column slice; the winner is elected from
         # the all_gather of candidate rows (make_sliced_search)
-        (_, search_row, search2, cs, shard, _start) = make_sliced_search(
+        (reduce_hist, search_row, search2, cs, shard,
+         _start) = make_sliced_search(
             axis_name=axis_name, fp=True, D=feature_shards,
             c_cols=c_cols, col_bins=col_bins, item_bits=item_bits,
             base_mask=base_mask, f_numbins=f_numbins, f_missing=f_missing,
@@ -1163,6 +1205,35 @@ def grow_tree_chunk_core(
             wsl = jax.lax.dynamic_slice(
                 words2d, (jnp.int32(0), w0), (words2d.shape[0], cs_words))
             return _unpack_codes(wsl, cs, item_bits)
+    elif scatter:
+        # per-chunk histograms accumulate FULL-width locally; one
+        # psum_scatter per split hands each shard its column slice
+        (reduce_hist, search_row, search2, cs, shard,
+         _start) = make_sliced_search(
+            axis_name=axis_name, fp=False, D=scatter_cols,
+            c_cols=c_cols, col_bins=col_bins, item_bits=item_bits,
+            base_mask=base_mask, f_numbins=f_numbins, f_missing=f_missing,
+            f_default=f_default, f_monotone=f_monotone,
+            f_penalty=f_penalty, f_elide=f_elide,
+            f_categorical=f_categorical, has_cat=has_cat,
+            cat_statics=cat_statics, helper_kwargs=helper_kwargs)
+        hist_w = cs
+
+        def decode_hist_cols(words2d):
+            return _unpack_codes(words2d[:, :cw], c_cols, item_bits)
+    elif voting:
+        reduce_hist, search_row, search2 = make_voting_search(
+            axis_name=axis_name, voting_k=voting_k, c_cols=c_cols,
+            col_bins=col_bins, base_mask=base_mask,
+            f_numbins=f_numbins, f_missing=f_missing,
+            f_default=f_default, f_monotone=f_monotone,
+            f_penalty=f_penalty, f_elide=f_elide, hist_idx=hist_idx,
+            f_categorical=f_categorical, has_cat=has_cat,
+            cat_statics=cat_statics, helper_kwargs=helper_kwargs)
+        hist_w = c_cols
+
+        def decode_hist_cols(words2d):
+            return _unpack_codes(words2d[:, :cw], c_cols, item_bits)
     else:
         (node_mask, scan, store_best, scan2,
          best_row) = _tree_helpers(
@@ -1180,6 +1251,13 @@ def grow_tree_chunk_core(
             return best_row(res, child_depth), cm
 
         search2 = search2_simple(scan2, best_row)
+
+        if axis_name is not None:
+            def reduce_hist(h):
+                return jax.lax.psum(h, axis_name)
+        else:
+            def reduce_hist(h):
+                return h
 
     gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)
     ids = jnp.arange(n, dtype=jnp.uint32)[:, None]
@@ -1201,9 +1279,14 @@ def grow_tree_chunk_core(
     else:
         hist0 = build_histogram(codes_row, gh, col_bins,
                                 use_pallas=use_pallas)
-        if axis_name is not None:
-            hist0 = jax.lax.psum(hist0, axis_name)
-        totals = hist0[0].sum(axis=0)
+        if scatter or voting:
+            # global totals first: the post-reduce histogram is a
+            # column slice (scatter) / stays local (voting)
+            totals = jax.lax.psum(hist0[0].sum(axis=0), axis_name)
+            hist0 = reduce_hist(hist0)
+        else:
+            hist0 = reduce_hist(hist0)
+            totals = hist0[0].sum(axis=0)
     root_key, loop_key = jax.random.split(rng_key)
     row0, cm0 = search_row(hist0, totals[0], totals[1], totals[2],
                            jnp.float32(-np.inf), jnp.float32(np.inf),
@@ -1245,7 +1328,11 @@ def grow_tree_chunk_core(
         # the GLOBALLY smaller child (replicated record counts) decides
         # which side's rows accumulate the fused histogram
         left_small = row[B_LCNT] <= row[B_RCNT]
-        hist_zero = jnp.zeros((hist_w, col_bins, 3), jnp.float32)
+        # scatter accumulates chunks FULL-width locally (the one
+        # psum_scatter afterwards maps it to this shard's hist_w slice);
+        # every other mode accumulates at pool width directly
+        acc_w = c_cols if scatter else hist_w
+        hist_zero = jnp.zeros((acc_w, col_bins, 3), jnp.float32)
 
         def chunk_hist(rows_win, count):
             codes = decode_hist_cols(rows_win[:, :cw])
@@ -1332,8 +1419,8 @@ def grow_tree_chunk_core(
 
             hist_small = jax.lax.fori_loop(0, -(-sc // CH), pass_h,
                                            hist_zero)
-        if axis_name is not None and not fp:
-            hist_small = jax.lax.psum(hist_small, axis_name)
+        # psum / psum_scatter-to-slice / identity (fp, voting, serial)
+        hist_small = reduce_hist(hist_small)
 
         sibling = c.pool[l] - hist_small
         hist_l = jnp.where(left_small, hist_small, sibling)

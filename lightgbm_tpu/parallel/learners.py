@@ -91,8 +91,8 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
 def _sharded_chunk_opt_in(learner) -> str:
     """The ONE copy of the sharded learners' chunk opt-in: honor
     LGBM_TPU_STRATEGY=chunk when the learner class supports the chunk
-    core (DP psum / FP sliced; voting's 2-stage election lives in the
-    compact core's reduction seams only), warn when it cannot."""
+    core (all four reductions since round 4: DP psum, DP scatter,
+    voting, FP sliced), warn when it cannot."""
     from ..utils.envs import strategy_env
     want = strategy_env()
     capable = getattr(learner, "_chunk_capable", True)
@@ -499,8 +499,6 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
     reduced histograms). No host round-trips inside a tree.
     """
 
-    # voting overrides to False: its 2-stage election lives in the
-    # compact core's reduction seams only
     _chunk_capable = True
 
     def __init__(self, config: Config, dataset: Dataset,
@@ -514,12 +512,11 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         self.mesh = mesh or make_mesh(axis_name="data")
         self.shards = int(self.mesh.devices.size)
         # reduce-scatter mode needs the identity feature->column mapping
-        # and shard-independent feature masks (see grow_tree_compact_core);
-        # the chunk core reduces by psum only
+        # and shard-independent feature masks (see grow_tree_compact_core
+        # / grow_tree_chunk_core — both cores carry the scatter seam)
         mode = dp_reduce_mode_env()
         self.scatter_cols = (
             self.shards if (mode != "psum"
-                            and self.strategy != "chunk"
                             and dataset.bundle_arrays() is None
                             and not (0.0 < config.feature_fraction_bynode
                                      < 1.0)
@@ -552,6 +549,7 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
             return dict(c_cols=self.c_cols, item_bits=self.item_bits,
                         chunk_rows=self.chunk_rows,
                         fuse_hist=not flag("LGBM_TPU_CHUNK_NO_FUSE_HIST"),
+                        scatter_cols=self.scatter_cols,
                         partition=self._partition_mode,
                         **self._statics())
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
@@ -751,9 +749,9 @@ class DeviceVotingParallelTreeLearner(DeviceDataParallelTreeLearner):
     local top-k election by locally-scanned gains, vote psum, and a
     reduction of ONLY the elected 2k features' histograms
     (voting_parallel_tree_learner.cpp:170-260). Communication per split
-    is O(2k*B), constant in feature count."""
-
-    _chunk_capable = False
+    is O(2k*B), constant in feature count. Both growth cores carry the
+    voting seam (make_voting_search), so LGBM_TPU_STRATEGY=chunk works
+    here too."""
 
     def __init__(self, config: Config, dataset: Dataset,
                  mesh: Optional[Mesh] = None):

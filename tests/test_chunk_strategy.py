@@ -239,3 +239,98 @@ def test_chunk_fused_training_end_to_end(monkeypatch):
     assert rank_auc(p, y[:20000]) > 0.75
     b2 = lgb.Booster(model_str=bst.model_to_string())
     assert np.allclose(p, b2.predict(x[:20000]))
+
+
+def test_chunk_scatter_matches_chunk_psum(monkeypatch):
+    # round 4: the chunk core's column-tiled psum_scatter reduction
+    # (reference comm pattern) must grow the identical tree as its
+    # replicated-psum mode — same algorithm, different collective
+    from lightgbm_tpu.parallel.learners import DeviceDataParallelTreeLearner
+
+    r = np.random.RandomState(17)
+    n, f = 70000, 6
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 1] - 0.4 * x[:, 3] + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+
+    def grow(reduce_mode):
+        monkeypatch.setenv("LGBM_TPU_STRATEGY", "chunk")
+        monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+        if reduce_mode == "psum":
+            monkeypatch.setenv("LGBM_TPU_DP_REDUCE", "psum")
+        else:
+            monkeypatch.delenv("LGBM_TPU_DP_REDUCE", raising=False)
+        cfg = Config({"objective": "binary", "num_leaves": 31,
+                      "max_bin": 63, "min_data_in_leaf": 20,
+                      "verbosity": -1})
+        ds = Dataset(x, config=cfg, label=y)
+        lrn = DeviceDataParallelTreeLearner(cfg, ds)
+        assert lrn.strategy == "chunk"
+        assert lrn.scatter_cols == (0 if reduce_mode == "psum" else 8)
+        return lrn.train(g, h).to_string()
+
+    assert grow("scatter") == grow("psum")
+
+
+def test_chunk_scatter_categorical_matches_psum(monkeypatch):
+    # categorical winners' left-bin masks must transport through the
+    # chunk core's scatter election exactly as through its psum scan
+    from lightgbm_tpu.parallel.learners import DeviceDataParallelTreeLearner
+
+    r = np.random.RandomState(23)
+    n = 70000
+    xc = r.randint(0, 6, n).astype(np.float32)
+    xn = r.randn(n, 5).astype(np.float32)
+    x = np.column_stack([xn[:, :1], xc, xn[:, 1:]])
+    y = ((np.isin(xc, [1, 4]) * 1.2 + xn[:, 0]
+          + 0.3 * r.randn(n)) > 0.5).astype(np.float64)
+    g, h = exact_grads(r, n)
+
+    def grow(reduce_mode):
+        monkeypatch.setenv("LGBM_TPU_STRATEGY", "chunk")
+        monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+        if reduce_mode == "psum":
+            monkeypatch.setenv("LGBM_TPU_DP_REDUCE", "psum")
+        else:
+            monkeypatch.delenv("LGBM_TPU_DP_REDUCE", raising=False)
+        cfg = Config({"objective": "binary", "num_leaves": 31,
+                      "max_bin": 63, "min_data_in_leaf": 20,
+                      "categorical_feature": "1", "verbosity": -1})
+        ds = Dataset(x, config=cfg, label=y)
+        lrn = DeviceDataParallelTreeLearner(cfg, ds)
+        assert lrn.strategy == "chunk"
+        return lrn.train(g, h).to_string()
+
+    scatter_tree = grow("scatter")
+    assert "cat_threshold" in scatter_tree
+    assert scatter_tree == grow("psum")
+
+
+def test_chunk_voting_matches_compact_voting(monkeypatch):
+    # round 4: the chunk core's PV-Tree seam (make_voting_search) must
+    # elect and split exactly like the compact core's voting mode
+    from lightgbm_tpu.parallel.learners import DeviceVotingParallelTreeLearner
+
+    r = np.random.RandomState(41)
+    n, f = 70000, 10
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] - 0.5 * x[:, 4] + 0.4 * x[:, 7]
+          + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+
+    def grow(strategy):
+        monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+        if strategy == "chunk":
+            monkeypatch.setenv("LGBM_TPU_STRATEGY", "chunk")
+        else:
+            monkeypatch.delenv("LGBM_TPU_STRATEGY", raising=False)
+        cfg = Config({"objective": "binary", "num_leaves": 31,
+                      "max_bin": 63, "min_data_in_leaf": 20,
+                      "top_k": 3, "verbosity": -1})
+        ds = Dataset(x, config=cfg, label=y)
+        lrn = DeviceVotingParallelTreeLearner(cfg, ds)
+        assert lrn.strategy == strategy
+        assert lrn.scatter_cols == 0
+        return lrn.train(g, h).to_string()
+
+    assert grow("chunk") == grow("compact")
