@@ -134,6 +134,30 @@ class Dataset:
         data = self.data
         label = self.label
         feature_names = None
+        if isinstance(data, str) and Config(self.params).two_round \
+                and self.reference is None:
+            # out-of-core path: two streaming passes, no float matrix
+            # (reference dataset_loader.cpp:168 two_round)
+            from .io.two_round import load_two_round
+            cfg2 = Config(self.params)
+            cats = self.categorical_feature
+            inner, y = load_two_round(
+                data, cfg2,
+                categorical_feature=(cats if isinstance(cats,
+                                                        (list, tuple))
+                                     else None))
+            if self.label is not None:
+                inner.metadata.set_label(self.label)
+            if self.weight is not None:
+                inner.metadata.set_weight(self.weight)
+            if self.group is not None:
+                inner.metadata.set_group(self.group)
+            if self.init_score is not None:
+                inner.metadata.set_init_score(self.init_score)
+            if isinstance(self.feature_name, (list, tuple)):
+                inner.feature_names = list(self.feature_name)
+            self._inner = inner
+            return self
         if isinstance(data, str):
             x, y, qb = _load_data_from_file(data)
             data = x
@@ -587,21 +611,35 @@ class Booster:
                                            self.pandas_categorical)
         elif hasattr(x, "values"):
             x = x.values
-        try:
-            import scipy.sparse as sp
-            if sp.issparse(x):
-                x = np.asarray(x.todense())
-        except ImportError:
-            pass
         if num_iteration is None:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else None)
-        return self._gbdt.predict(
-            x, num_iteration=num_iteration, raw_score=raw_score,
-            pred_leaf=pred_leaf, pred_contrib=pred_contrib,
-            start_iteration=start_iteration, pred_early_stop=pred_early_stop,
-            pred_early_stop_freq=pred_early_stop_freq,
-            pred_early_stop_margin=pred_early_stop_margin)
+
+        def run(mat):
+            return self._gbdt.predict(
+                mat, num_iteration=num_iteration, raw_score=raw_score,
+                pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                start_iteration=start_iteration,
+                pred_early_stop=pred_early_stop,
+                pred_early_stop_freq=pred_early_stop_freq,
+                pred_early_stop_margin=pred_early_stop_margin)
+        try:
+            import scipy.sparse as sp
+            is_sp = sp.issparse(x)
+        except ImportError:
+            is_sp = False
+        if is_sp:
+            # row-batched sparse prediction: peak dense memory is one
+            # (B, F) batch, never the whole matrix (the reference
+            # iterates sparse rows directly, c_api.cpp PredictForCSR)
+            x = x.tocsr()
+            batch = 65536
+            if x.shape[0] <= batch:
+                return run(np.asarray(x.todense()))
+            parts = [run(np.asarray(x[i:i + batch].todense()))
+                     for i in range(0, x.shape[0], batch)]
+            return np.concatenate(parts, axis=0)
+        return run(x)
 
     def refit(self, data, label, decay_rate=0.9, **kwargs):
         """Refit leaf values on new data (reference Booster.refit)."""

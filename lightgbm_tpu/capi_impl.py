@@ -62,8 +62,10 @@ def dataset_create_from_mat(mv, data_type, nrow, ncol, is_row_major,
 def dataset_create_from_csr(indptr_mv, indptr_type, indices_mv, data_mv,
                             data_type, nindptr, nelem, num_col, parameters,
                             reference):
-    mat = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
-                        data_type, nindptr, nelem, num_col)
+    # the matrix stays sparse end-to-end: io/dataset.py bins straight
+    # off the CSC structure (reference: src/io/sparse_bin.hpp:73)
+    mat = _csr_view(indptr_mv, indptr_type, indices_mv, data_mv,
+                    data_type, nindptr, nelem, num_col)
     params = parse_config_str(parameters or "")
     ref = _get(reference) if reference else None
     ds = Dataset(mat, reference=ref, params=params)
@@ -264,25 +266,23 @@ def network_free():
 # Extended dataset constructors (reference: src/c_api.cpp dataset section)
 # ---------------------------------------------------------------------------
 
-def _csc_to_dense(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
-                  data_type, ncol_ptr, nelem, num_row):
+def _csc_view(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+              data_type, ncol_ptr, nelem, num_row):
+    """scipy CSC over the caller's buffers — no dense materialization."""
+    import scipy.sparse as sp
     col_ptr = np.frombuffer(col_ptr_mv, dtype=C_DTYPE[col_ptr_type])[:ncol_ptr]
     indices = np.frombuffer(indices_mv, dtype=np.int32)[:nelem]
     data = np.frombuffer(data_mv, dtype=C_DTYPE[data_type])[:nelem]
-    ncol = ncol_ptr - 1
-    mat = np.zeros((num_row, ncol))
-    for j in range(ncol):
-        lo, hi = int(col_ptr[j]), int(col_ptr[j + 1])
-        mat[indices[lo:hi], j] = data[lo:hi]
-    return mat
+    return sp.csc_matrix((data, indices, col_ptr),
+                         shape=(num_row, ncol_ptr - 1), copy=True)
 
 
 def dataset_create_from_csc(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
                             data_type, ncol_ptr, nelem, num_row, parameters,
                             reference):
     """reference: LGBM_DatasetCreateFromCSC (c_api.h:191)."""
-    mat = _csc_to_dense(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
-                        data_type, ncol_ptr, nelem, num_row)
+    mat = _csc_view(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                    data_type, ncol_ptr, nelem, num_row)
     params = parse_config_str(parameters or "")
     ref = _get(reference) if reference else None
     ds = Dataset(mat, reference=ref, params=params)
@@ -315,7 +315,9 @@ class _StreamingDataset:
 
     def __init__(self, num_row: int, num_col: int, params: str,
                  reference=None):
-        self.buf = np.zeros((num_row, num_col), dtype=np.float64)
+        self.shape = (num_row, num_col)
+        self.buf = None                       # dense buffer, lazy
+        self._sparse_chunks = []              # [(start_row, csr)]
         self.params = parse_config_str(params or "")
         self.reference = reference
         self.filled = 0
@@ -323,10 +325,51 @@ class _StreamingDataset:
         self._pending_fields: Dict[str, np.ndarray] = {}
         self._pending_names = None
 
+    def _dense_buf(self) -> np.ndarray:
+        if self.buf is None:
+            self.buf = np.zeros(self.shape, dtype=np.float64)
+            for start, chunk in self._sparse_chunks:
+                co = chunk.tocoo()
+                self.buf[co.row + start, co.col] = co.data
+            self._sparse_chunks = []
+        return self.buf
+
     def push_rows(self, arr: np.ndarray, start_row: int) -> None:
-        self.buf[start_row:start_row + arr.shape[0], :] = arr
+        self._dense_buf()[start_row:start_row + arr.shape[0], :] = arr
         self.filled = max(self.filled, start_row + arr.shape[0])
         self._ds = None
+
+    def push_rows_sparse(self, csr, start_row: int) -> None:
+        """CSR push that never densifies: chunks accumulate and assemble
+        into ONE sparse matrix at materialization (unless a dense
+        push_rows already forced the dense buffer, then they scatter into
+        it). The reference's PushRowsByCSR feeds sparse bins the same
+        way (c_api.cpp PushRowsByCSR -> sparse_bin.hpp Push)."""
+        if self.buf is not None:
+            co = csr.tocoo()
+            self.buf[co.row + start_row, co.col] = co.data
+        else:
+            self._sparse_chunks.append((start_row, csr))
+        self.filled = max(self.filled, start_row + csr.shape[0])
+        self._ds = None
+
+    def _assembled(self):
+        """The pushed data in its cheapest faithful form."""
+        if self.buf is not None:
+            return self.buf
+        if self._sparse_chunks:
+            import scipy.sparse as sp
+            rows, cols, vals = [], [], []
+            for start, c in self._sparse_chunks:
+                co = c.tocoo()
+                rows.append(co.row.astype(np.int64) + start)
+                cols.append(co.col)
+                vals.append(co.data)
+            return sp.csr_matrix(
+                (np.concatenate(vals),
+                 (np.concatenate(rows), np.concatenate(cols))),
+                shape=self.shape)
+        return self._dense_buf()
 
     def set_field(self, name, data):
         self._pending_fields[name] = np.asarray(data)
@@ -358,7 +401,7 @@ class _StreamingDataset:
 
     def _materialize(self) -> Dataset:
         if self._ds is None:
-            ds = Dataset(self.buf, reference=self.reference,
+            ds = Dataset(self._assembled(), reference=self.reference,
                          params=self.params)
             if getattr(self, "_pending_names", None):
                 ds.set_feature_name(self._pending_names)
@@ -406,11 +449,11 @@ def dataset_push_rows(h, mv, data_type, nrow, ncol, start_row):
 def dataset_push_rows_by_csr(h, indptr_mv, indptr_type, indices_mv, data_mv,
                              data_type, nindptr, nelem, num_col, start_row):
     ds = _get(h)
-    mat = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
-                        data_type, nindptr, nelem, num_col)
+    mat = _csr_view(indptr_mv, indptr_type, indices_mv, data_mv,
+                    data_type, nindptr, nelem, num_col)
     if not isinstance(ds, _StreamingDataset):
         raise ValueError("PushRowsByCSR requires a streaming dataset")
-    ds.push_rows(mat, start_row)
+    ds.push_rows_sparse(mat, start_row)
     return 0
 
 
@@ -696,32 +739,32 @@ def booster_predict_for_file(h, data_filename, data_has_header,
     return 0
 
 
-def _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv, data_type,
-                  nindptr, nelem, num_col):
+def _csr_view(indptr_mv, indptr_type, indices_mv, data_mv, data_type,
+              nindptr, nelem, num_col):
+    """scipy CSR over the caller's buffers — no dense materialization."""
+    import scipy.sparse as sp
     indptr = np.frombuffer(indptr_mv, dtype=C_DTYPE[indptr_type])[:nindptr]
     indices = np.frombuffer(indices_mv, dtype=np.int32)[:nelem]
     data = np.frombuffer(data_mv, dtype=C_DTYPE[data_type])[:nelem]
-    nrow = nindptr - 1
-    mat = np.zeros((nrow, num_col))
-    for i in range(nrow):
-        lo, hi = int(indptr[i]), int(indptr[i + 1])
-        mat[i, indices[lo:hi]] = data[lo:hi]
-    return mat
+    return sp.csr_matrix((data, indices, indptr),
+                         shape=(nindptr - 1, num_col), copy=True)
 
 
 def booster_predict_for_csr(h, indptr_mv, indptr_type, indices_mv, data_mv,
                             data_type, nindptr, nelem, num_col,
                             predict_type, num_iteration, parameter):
-    mat = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
-                        data_type, nindptr, nelem, num_col)
+    # basic.Booster.predict row-batches sparse input; memory stays
+    # bounded by the batch, not the matrix
+    mat = _csr_view(indptr_mv, indptr_type, indices_mv, data_mv,
+                    data_type, nindptr, nelem, num_col)
     return _predict_dense(_get(h), mat, predict_type, num_iteration)
 
 
 def booster_predict_for_csc(h, col_ptr_mv, col_ptr_type, indices_mv, data_mv,
                             data_type, ncol_ptr, nelem, num_row,
                             predict_type, num_iteration, parameter):
-    mat = _csc_to_dense(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
-                        data_type, ncol_ptr, nelem, num_row)
+    mat = _csc_view(col_ptr_mv, col_ptr_type, indices_mv, data_mv,
+                    data_type, ncol_ptr, nelem, num_row).tocsr()
     return _predict_dense(_get(h), mat, predict_type, num_iteration)
 
 
@@ -750,8 +793,8 @@ def booster_predict_for_csr_single_row(h, indptr_mv, indptr_type, indices_mv,
                                        data_mv, data_type, nindptr, nelem,
                                        num_col, predict_type, num_iteration,
                                        parameter):
-    mat = _csr_to_dense(indptr_mv, indptr_type, indices_mv, data_mv,
-                        data_type, nindptr, nelem, num_col)
+    mat = _csr_view(indptr_mv, indptr_type, indices_mv, data_mv,
+                    data_type, nindptr, nelem, num_col)
     return _predict_dense(_get(h), mat, predict_type, num_iteration)
 
 

@@ -85,8 +85,9 @@ class Dataset:
                  params: Optional[Dict[str, Any]] = None,
                  bin_mappers=None):
         self.config = config or Config(params or {})
-        data = self._to_numpy(data)
-        self.num_data, self.num_total_features = data.shape
+        data, sparse = self._prep_data(data)
+        self.num_data, self.num_total_features = (
+            sparse.shape if sparse is not None else data.shape)
         self.metadata = Metadata(self.num_data)
         if label is not None:
             self.metadata.set_label(label)
@@ -113,7 +114,10 @@ class Dataset:
                 default=1)
         else:
             cat_idx = self._resolve_categorical(categorical_feature)
-            self.bin_mappers = self._build_mappers(data, cat_idx)
+            self.bin_mappers = (
+                self._build_mappers_sparse(sparse, cat_idx)
+                if sparse is not None
+                else self._build_mappers(data, cat_idx))
             self.used_features = [i for i, m in enumerate(self.bin_mappers)
                                   if not m.is_trivial]
             if not self.used_features:
@@ -121,7 +125,8 @@ class Dataset:
             self.max_num_bins = max(
                 [self.bin_mappers[i].num_bin for i in self.used_features], default=1)
 
-        self.binned = self._bin_data(data)
+        self.binned = (self._bin_data_sparse(sparse) if sparse is not None
+                       else self._bin_data(data))
         # EFB: plan storage columns and encode the bundled matrix
         # (reference: dataset.cpp:69-225 FindGroups/FastFeatureBundling).
         # self.binned stays the logical per-feature view for generic
@@ -133,12 +138,62 @@ class Dataset:
         self._device_cache: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------
+    @classmethod
+    def from_binned(cls, binned: np.ndarray, bin_mappers, config,
+                    label=None, weight=None, group=None, init_score=None,
+                    feature_names=None) -> "Dataset":
+        """Construct from an already-binned code matrix + its mappers —
+        the two-round loader's entry (io/two_round.py round 2 bins
+        chunks straight into `binned`; the float matrix never existed,
+        reference dataset_loader.cpp:168 two_round role). `binned` holds
+        the NON-trivial features' columns, in mapper order."""
+        self = cls.__new__(cls)
+        self.config = config
+        self.num_data = int(binned.shape[0])
+        self.num_total_features = len(bin_mappers)
+        self.metadata = Metadata(self.num_data)
+        if label is not None:
+            self.metadata.set_label(label)
+        self.metadata.set_weight(weight)
+        self.metadata.set_group(group)
+        self.metadata.set_init_score(init_score)
+        self.feature_names = (list(feature_names) if feature_names else
+                              [f"Column_{i}"
+                               for i in range(self.num_total_features)])
+        self.reference = None
+        self.bin_mappers = list(bin_mappers)
+        self.used_features = [i for i, m in enumerate(self.bin_mappers)
+                              if not m.is_trivial]
+        if not self.used_features:
+            log.warning("All features are trivial (constant); "
+                        "nothing to train on")
+        self.max_num_bins = max(
+            [self.bin_mappers[i].num_bin for i in self.used_features],
+            default=1)
+        assert binned.shape[1] == max(len(self.used_features), 1), \
+            "binned width must match the non-trivial feature count"
+        self.binned = binned
+        self.columns = self._plan_bundles()
+        self.bundled = self._encode_bundles() if self.columns else None
+        self._device_cache: Dict[str, Any] = {}
+        return self
+
+    # ------------------------------------------------------------------
     @staticmethod
-    def _to_numpy(data) -> np.ndarray:
+    def _prep_data(data):
+        """Returns (dense, csc): exactly one is non-None. Sparse input is
+        NEVER densified to a float matrix (the reference bins sparse
+        input directly, src/io/sparse_bin.hpp:73 Push); it is canonical
+        CSC for per-column nonzero iteration, and the only dense
+        materialization downstream is the (N, F) uint8/16 code matrix —
+        the designed post-bin storage."""
         try:
             import scipy.sparse as sp
             if sp.issparse(data):
-                return np.asarray(data.todense(), dtype=np.float64)
+                csc = data.tocsc().astype(np.float64)
+                csc.sum_duplicates()
+                csc.sort_indices()
+                return None, csc
         except ImportError:
             pass
         if hasattr(data, "values"):  # pandas
@@ -146,7 +201,7 @@ class Dataset:
         arr = np.asarray(data, dtype=np.float64)
         if arr.ndim == 1:
             arr = arr.reshape(-1, 1)
-        return arr
+        return arr, None
 
     def _resolve_categorical(self, categorical_feature) -> set:
         cats = set()
@@ -190,6 +245,64 @@ class Dataset:
         out = np.zeros((self.num_data, max(n_used, 1)), dtype=dtype)
         for j, f in enumerate(self.used_features):
             out[:, j] = self.bin_mappers[f].values_to_bins(data[:, f]).astype(dtype)
+        return out
+
+    def _build_mappers_sparse(self, csc, cat_idx: set) -> List[BinMapper]:
+        """Per-column find-bin straight off the CSC structure: only each
+        column's sampled NONZERO values are handed to the mapper (zeros
+        implied by the sample count — find_bin's sparse contract, the
+        reference's DatasetLoader sampling + sparse_bin.hpp ingestion
+        semantics). Peak extra memory is O(nnz of one column)."""
+        cfg = self.config
+        n = self.num_data
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        rng = np.random.RandomState(cfg.data_random_seed)
+        if sample_cnt < n:
+            sample_rows = np.sort(rng.choice(n, sample_cnt, replace=False))
+        else:
+            sample_rows = None
+        forced_bounds = load_forced_bounds(cfg.forcedbins_filename)
+        ignore = resolve_ignore_set(cfg.ignore_column, self.feature_names)
+        indptr, indices, values = csc.indptr, csc.indices, csc.data
+        mappers = []
+        for f in range(self.num_total_features):
+            if f in ignore:
+                m = BinMapper()
+                m.is_trivial = True
+                m.num_bin = 1
+                mappers.append(m)
+                continue
+            lo, hi = int(indptr[f]), int(indptr[f + 1])
+            vals = values[lo:hi]
+            if sample_rows is not None:
+                rows = indices[lo:hi]
+                at = np.searchsorted(sample_rows, rows)
+                at[at >= len(sample_rows)] = 0
+                vals = vals[sample_rows[at] == rows]
+                total = len(sample_rows)
+            else:
+                total = n
+            mappers.append(mapper_from_sample_column(
+                vals, total, cfg, f, cat_idx, forced_bounds))
+        return mappers
+
+    def _bin_data_sparse(self, csc) -> np.ndarray:
+        """Fill the dense code matrix column-by-column from CSC: each
+        column starts at its zero-value bin and only the nonzero entries
+        are scattered — no dense float matrix ever exists."""
+        n_used = len(self.used_features)
+        dtype = np.uint8 if self.max_num_bins <= 256 else np.uint16
+        out = np.zeros((self.num_data, max(n_used, 1)), dtype=dtype)
+        indptr, indices, values = csc.indptr, csc.indices, csc.data
+        for j, f in enumerate(self.used_features):
+            m = self.bin_mappers[f]
+            zero_bin = m.value_to_bin(0.0)
+            if zero_bin:
+                out[:, j] = dtype(zero_bin)
+            lo, hi = int(indptr[f]), int(indptr[f + 1])
+            if hi > lo:
+                out[indices[lo:hi], j] = m.values_to_bins(
+                    values[lo:hi]).astype(dtype)
         return out
 
     # ------------------------------------------------------------------

@@ -1,0 +1,152 @@
+"""Two-round (out-of-core) text loading.
+
+Role of the reference's ``two_round`` loading path + PipelineReader
+(reference: src/io/dataset_loader.cpp:168 LoadFromFile two_round branch,
+include/LightGBM/utils/pipeline_reader.h:20): when the text file is too
+big for the full float matrix, stream it twice —
+
+  round 1: one sequential pass that counts rows and reservoir-samples
+           ``bin_construct_sample_cnt`` rows (seeded, order-stable), from
+           which the per-feature BinMappers are built exactly as the
+           in-memory path builds them;
+  round 2: a second sequential pass that bins each chunk of rows
+           straight into the (N, used_features) uint8/16 code matrix.
+
+Peak memory is O(sample + chunk + codes) — the dense float64 matrix
+never exists. The label/weight column streams into its (N,) vector
+during round 2.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+import numpy as np
+
+from ..utils import log
+
+
+CHUNK_ROWS = 65536
+
+
+def _open_rows(path: str, label_column: int):
+    """(format, delim, header) detection shared with io/parser.py."""
+    from .file_io import open_file
+    from .parser import _detect_format, _is_number
+    with open_file(path) as f:
+        first = f.readline()
+        while first and (first.startswith("#") or not first.strip()):
+            first = f.readline()
+    if not first:
+        raise ValueError(f"data file is empty: {path}")
+    fmt = _detect_format(first)
+    if fmt == "libsvm":
+        raise ValueError("two_round loading supports csv/tsv text files")
+    delim = {"csv": ",", "tsv": "\t", "space": None}[fmt]
+    toks = first.strip().split(delim)
+    header = not all(_is_number(t) for t in toks if t)
+    return delim, header
+
+
+def _iter_chunks(path: str, delim, header: bool, chunk_rows: int):
+    """Yield (start_row, float64 (B, C) chunk) sequentially. The header
+    (detected by _open_rows on the first NON-comment line) is skipped
+    through the same comment/blank filter, so leading '#' lines don't
+    shift it into the data."""
+    from .file_io import open_file
+    with open_file(path) as f:
+        content = (ln for ln in f if ln.strip() and not ln.startswith("#"))
+        if header:
+            next(content, None)
+        start = 0
+        while True:
+            lines = list(itertools.islice(content, chunk_rows))
+            if not lines:
+                break
+            chunk = np.genfromtxt(lines, delimiter=delim, dtype=np.float64)
+            if chunk.ndim == 1:
+                chunk = chunk.reshape(len(lines), -1)
+            yield start, chunk
+            start += chunk.shape[0]
+
+
+def load_two_round(path: str, config, label_column: int = 0,
+                   categorical_feature=None,
+                   chunk_rows: int = CHUNK_ROWS):
+    """Build a fully-binned Dataset from a text file in two streaming
+    passes. Returns (dataset, label_vector)."""
+    from .binning import (BinMapper, load_forced_bounds,
+                          mapper_from_sample_column, resolve_ignore_set)
+    from .dataset import Dataset
+
+    delim, header = _open_rows(path, label_column)
+    sample_cnt = int(config.bin_construct_sample_cnt)
+    rng = np.random.RandomState(config.data_random_seed)
+
+    # ---- round 1: count + reservoir sample (Algorithm R, seeded) ------
+    sample = None          # (S, C) float64
+    n = 0
+    for start, chunk in _iter_chunks(path, delim, header, chunk_rows):
+        if sample is None:
+            sample = np.empty((sample_cnt, chunk.shape[1]), np.float64)
+        for r in range(chunk.shape[0]):
+            if n < sample_cnt:
+                sample[n] = chunk[r]
+            else:
+                j = rng.randint(0, n + 1)
+                if j < sample_cnt:
+                    sample[j] = chunk[r]
+            n += 1
+    if n == 0:
+        raise ValueError(f"data file is empty: {path}")
+    sample = sample[:min(n, sample_cnt)]
+    num_cols = sample.shape[1]
+    has_label = num_cols > 1
+    feat_of = [c for c in range(num_cols)
+               if not (has_label and c == label_column)]
+    nf = len(feat_of)
+    log.info("two_round: %d rows, %d features, %d sampled",
+             n, nf, sample.shape[0])
+
+    # ---- mappers from the sample (the one shared find-bin recipe) -----
+    feature_names = [f"Column_{i}" for i in range(nf)]
+    cat_idx = set()
+    for c in (categorical_feature or config.categorical_feature or []):
+        if isinstance(c, str):
+            if c.startswith("name:"):
+                c = c[5:]
+            if c in feature_names:
+                cat_idx.add(feature_names.index(c))
+        else:
+            cat_idx.add(int(c))
+    forced_bounds = load_forced_bounds(config.forcedbins_filename)
+    ignore = resolve_ignore_set(config.ignore_column, feature_names)
+    mappers = []
+    for j, c in enumerate(feat_of):
+        if j in ignore:
+            m = BinMapper()
+            m.is_trivial = True
+            m.num_bin = 1
+            mappers.append(m)
+            continue
+        mappers.append(mapper_from_sample_column(
+            sample[:, c], sample.shape[0], config, j, cat_idx,
+            forced_bounds))
+    used = [j for j, m in enumerate(mappers) if not m.is_trivial]
+    max_bins = max([mappers[j].num_bin for j in used], default=1)
+
+    # ---- round 2: stream + bin into the code matrix -------------------
+    dtype = np.uint8 if max_bins <= 256 else np.uint16
+    binned = np.zeros((n, max(len(used), 1)), dtype=dtype)
+    label = np.zeros(n, np.float64) if has_label else None
+    for start, chunk in _iter_chunks(path, delim, header, chunk_rows):
+        hi = start + chunk.shape[0]
+        if has_label:
+            label[start:hi] = chunk[:, label_column]
+        for k, j in enumerate(used):
+            binned[start:hi, k] = mappers[j].values_to_bins(
+                chunk[:, feat_of[j]]).astype(dtype)
+
+    ds = Dataset.from_binned(binned, mappers, config, label=label,
+                             feature_names=feature_names)
+    return ds, label

@@ -1,0 +1,61 @@
+"""Two-round (out-of-core) text loading vs the in-memory loader.
+
+At n <= bin_construct_sample_cnt both paths see every row, so mappers —
+and therefore models — must be IDENTICAL; the only difference is that
+two_round never materializes the float matrix (reference:
+src/io/dataset_loader.cpp:168 two_round + pipeline_reader.h role).
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.two_round import load_two_round
+
+
+def _write_csv(tmp_path, n=4000, f=6, seed=13):
+    rng = np.random.RandomState(seed)
+    # values quantized to 1/256 print as exact decimals, so the native
+    # FastAtof parser (in-memory path) and genfromtxt (two_round chunks)
+    # parse bit-identical doubles and the models can be compared exactly
+    x = np.round(rng.randn(n, f) * 256) / 256
+    x[rng.rand(n, f) < 0.2] = 0.0        # sparse-ish zeros
+    y = (x[:, 0] - 0.5 * x[:, 1] + 0.3 * rng.randn(n) > 0).astype(float)
+    path = tmp_path / "train.csv"
+    rows = np.column_stack([y, x])
+    np.savetxt(path, rows, delimiter=",", fmt="%.10g")
+    return str(path), x, y
+
+
+def test_two_round_loader_matches_in_memory(tmp_path):
+    path, x, y = _write_csv(tmp_path)
+    cfg = Config({"objective": "binary", "verbosity": -1})
+    ds2, label = load_two_round(path, cfg, chunk_rows=700)  # many chunks
+    from lightgbm_tpu.io.dataset import Dataset as Inner
+    ds1 = Inner(x, config=cfg, label=y)
+    np.testing.assert_array_equal(label, y)
+    assert ds2.used_features == ds1.used_features
+    for m2, m1 in zip(ds2.bin_mappers, ds1.bin_mappers):
+        assert m2.num_bin == m1.num_bin
+        np.testing.assert_allclose(m2.bin_upper_bound, m1.bin_upper_bound)
+    np.testing.assert_array_equal(ds2.binned, ds1.binned)
+
+
+def test_two_round_trains_identically(tmp_path):
+    path, x, y = _write_csv(tmp_path)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    b2 = lgb.train(dict(params, two_round=True), lgb.Dataset(path),
+                   num_boost_round=5)
+    b1 = lgb.train(params, lgb.Dataset(path), num_boost_round=5)
+
+    def strip(s):  # the params echo differs only in two_round itself
+        return "\n".join(ln for ln in s.split("\n")
+                         if not ln.startswith("[two_round:"))
+    assert strip(b2.model_to_string()) == strip(b1.model_to_string())
+
+
+def test_two_round_alias(tmp_path):
+    path, x, y = _write_csv(tmp_path, n=800)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "use_two_round_loading": True}
+    bst = lgb.train(params, lgb.Dataset(path), num_boost_round=2)
+    assert bst.current_iteration() == 2
