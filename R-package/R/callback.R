@@ -1,0 +1,176 @@
+# Training callbacks (role of reference R-package/R/callback.R).
+#
+# Protocol: a callback is a function(env) where `env` is an environment
+# the training loop refreshes every iteration with:
+#   model           the lgb.Booster being trained
+#   iteration       current 1-based iteration
+#   begin_iteration / end_iteration   loop bounds
+#   eval_list       list of list(data_name, name, value, higher_better)
+#                   for this iteration (empty when there are no valids)
+#   met_early_stop  set TRUE by a callback to stop training
+# Attributes on the function:
+#   "call_order"        callbacks run sorted by it (pre-eval ones first)
+#   "is_pre_iteration"  TRUE runs before the boosting update
+# This mirrors the reference's cb.* environment contract so user
+# callbacks written for the reference port over mechanically.
+
+CB_ENV_KEYS <- c("model", "iteration", "begin_iteration", "end_iteration",
+                 "eval_list", "met_early_stop")
+
+cb.make.env <- function(model, begin_iteration, end_iteration) {
+  env <- new.env(parent = emptyenv())
+  env$model <- model
+  env$iteration <- begin_iteration
+  env$begin_iteration <- begin_iteration
+  env$end_iteration <- end_iteration
+  env$eval_list <- list()
+  env$met_early_stop <- FALSE
+  env
+}
+
+cb.run.all <- function(callbacks, env, pre) {
+  for (cb in callbacks) {
+    if (isTRUE(attr(cb, "is_pre_iteration")) == pre) {
+      cb(env)
+    }
+  }
+  invisible(env)
+}
+
+cb.sort <- function(callbacks) {
+  if (length(callbacks) == 0L) {
+    return(callbacks)
+  }
+  ord <- vapply(callbacks, function(cb) {
+    v <- attr(cb, "call_order")
+    if (is.null(v)) 10L else as.integer(v)
+  }, integer(1))
+  callbacks[order(ord)]
+}
+
+format.eval.string <- function(rec) {
+  sprintf("%s's %s: %g", rec$data_name, rec$name, rec$value)
+}
+
+#' Print evaluation results every \code{period} iterations.
+#' @param period print frequency
+#' @export
+cb.print.evaluation <- function(period = 1L) {
+  callback <- function(env) {
+    if (period <= 0L || length(env$eval_list) == 0L) {
+      return(invisible(NULL))
+    }
+    i <- env$iteration
+    if (i %% period == 0L || i == env$begin_iteration
+        || i == env$end_iteration) {
+      msgs <- vapply(env$eval_list, format.eval.string, character(1))
+      message(sprintf("[%d]: %s", i, paste(msgs, collapse = "  ")))
+    }
+  }
+  attr(callback, "call_order") <- 20L
+  attr(callback, "name") <- "cb.print.evaluation"
+  callback
+}
+
+#' Record evaluation results into \code{model$record_evals}.
+#' @export
+cb.record.evaluation <- function() {
+  callback <- function(env) {
+    for (rec in env$eval_list) {
+      env$model$record_evals[[rec$data_name]][[rec$name]]$eval <-
+        c(env$model$record_evals[[rec$data_name]][[rec$name]]$eval,
+          rec$value)
+    }
+  }
+  attr(callback, "call_order") <- 25L
+  attr(callback, "name") <- "cb.record.evaluation"
+  callback
+}
+
+#' Reset booster parameters on a schedule.
+#'
+#' \code{new_params} is a named list; each element is either a vector of
+#' length \code{nrounds} (per-iteration values, e.g. a learning-rate
+#' decay) or a function(iteration, nrounds) returning the value. Applied
+#' through \code{LGBM_BoosterResetParameter} before each boosting update
+#' (reference cb.reset.parameters -> ResetParameter).
+#' @param new_params named list of schedules
+#' @export
+cb.reset.parameters <- function(new_params) {
+  if (!is.list(new_params) || is.null(names(new_params))) {
+    stop("cb.reset.parameters: new_params must be a named list")
+  }
+  callback <- function(env) {
+    i <- env$iteration - env$begin_iteration + 1L
+    n <- env$end_iteration - env$begin_iteration + 1L
+    cur <- list()
+    for (key in names(new_params)) {
+      sched <- new_params[[key]]
+      cur[[key]] <- if (is.function(sched)) {
+        sched(i, n)
+      } else {
+        if (length(sched) < i) {
+          stop(sprintf(
+            "cb.reset.parameters: schedule for '%s' is shorter than nrounds",
+            key))
+        }
+        sched[[i]]
+      }
+    }
+    pstr <- lgb.params2str(cur)
+    if (!is.null(env$model$handle)) {
+      .Call(LGBMTPU_BoosterResetParameter_R, env$model$handle, pstr)
+    } else if (!is.null(env$model$boosters)) {
+      # lgb.cv: the env's model is the cv aggregate; reset every fold
+      for (b in env$model$boosters) {
+        .Call(LGBMTPU_BoosterResetParameter_R, b$handle, pstr)
+      }
+    } else {
+      stop("cb.reset.parameters: no booster handle in the callback env")
+    }
+  }
+  attr(callback, "call_order") <- 5L
+  attr(callback, "is_pre_iteration") <- TRUE
+  attr(callback, "name") <- "cb.reset.parameters"
+  callback
+}
+
+#' Early stopping on the first metric of the first validation set.
+#'
+#' Stops when the watched metric has not improved for
+#' \code{stopping_rounds} iterations; records \code{best_iter} on the
+#' booster (absolute, counting init_model trees). This is the callback
+#' form of the \code{early_stopping_rounds} argument of
+#' \code{lgb.train}/\code{lgb.cv}.
+#' @param stopping_rounds patience in iterations
+#' @param verbose print the stopping message
+#' @export
+cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
+  best_score <- Inf
+  best_iter <- -1L
+  callback <- function(env) {
+    if (length(env$eval_list) == 0L) {
+      return(invisible(NULL))
+    }
+    rec <- env$eval_list[[1L]]
+    val <- if (isTRUE(rec$higher_better)) -rec$value else rec$value
+    # env$iteration is ABSOLUTE (lgb.train numbers from init_model's
+    # tree count), so best_iter needs no offset and agrees with the
+    # built-in early_stopping_rounds path
+    i <- env$iteration
+    if (val < best_score) {
+      best_score <<- val
+      best_iter <<- i
+    } else if (i - best_iter >= stopping_rounds) {
+      env$model$best_iter <- best_iter
+      if (verbose) {
+        message(sprintf("Early stopping, best iteration is: %d",
+                        best_iter))
+      }
+      env$met_early_stop <- TRUE
+    }
+  }
+  attr(callback, "call_order") <- 30L
+  attr(callback, "name") <- "cb.early.stop"
+  callback
+}

@@ -87,21 +87,14 @@ Booster <- R6::R6Class(
     },
 
     predict = function(data, raw_score = FALSE, predleaf = FALSE,
-                       predcontrib = FALSE, num_iteration = -1L) {
-      data <- as.matrix(data)
-      storage.mode(data) <- "double"
-      ptype <- 0L
-      if (raw_score) ptype <- 1L
-      if (predleaf) ptype <- 2L
-      if (predcontrib) ptype <- 3L
-      res <- .Call(LGBMTPU_BoosterPredictForMat_R, self$handle, data,
-                   nrow(data), ncol(data), ptype, as.integer(num_iteration))
-      n <- nrow(data)
-      if (length(res) > n && length(res) %% n == 0) {
-        matrix(res, nrow = n, byrow = TRUE)
-      } else {
-        res
-      }
+                       predcontrib = FALSE, num_iteration = -1L,
+                       header = FALSE) {
+      # all shaping lives in the Predictor (lgb.Predictor.R), which
+      # shares this booster's handle
+      pred <- Predictor$new(booster_handle = self$handle)
+      pred$predict(data, num_iteration = num_iteration,
+                   rawscore = raw_score, predleaf = predleaf,
+                   predcontrib = predcontrib, header = header)
     }
   ),
   private = list(valid_names = character(0))

@@ -1,0 +1,77 @@
+# Internal predictor (role of reference R-package/R/lgb.Predictor.R).
+#
+# Owns a booster handle (either shared with a live lgb.Booster or
+# created fresh from a model file) and renders every prediction flavor —
+# raw score, probability, leaf index, SHAP contribution — from matrices,
+# data.frames, or CSV/TSV files. lgb.Booster$predict delegates here so
+# the shaping logic (per-class columns, per-iteration leaf blocks) has
+# exactly one home.
+
+Predictor <- R6::R6Class(
+  "lgb.Predictor",
+  public = list(
+    handle = NULL,
+
+    initialize = function(modelfile = NULL, booster_handle = NULL) {
+      if (!is.null(modelfile)) {
+        self$handle <- .Call(LGBMTPU_BoosterCreateFromModelfile_R,
+                             modelfile)
+        private$owns <- TRUE
+      } else if (!is.null(booster_handle)) {
+        self$handle <- booster_handle
+        private$owns <- FALSE
+      } else {
+        stop("lgb.Predictor: need modelfile or booster_handle")
+      }
+    },
+
+    current_iter = function() {
+      .Call(LGBMTPU_BoosterGetCurrentIteration_R, self$handle)
+    },
+
+    num_classes = function() {
+      .Call(LGBMTPU_BoosterGetNumClasses_R, self$handle)
+    },
+
+    predict = function(data, num_iteration = -1L, rawscore = FALSE,
+                       predleaf = FALSE, predcontrib = FALSE,
+                       header = FALSE) {
+      if (is.character(data) && length(data) == 1L) {
+        # file input: sniff the separator off the first line (comma /
+        # tab / whitespace), as the CLI's loose reader does; label
+        # column (if present) is the caller's concern, as in the
+        # reference Predictor file path
+        first <- readLines(data, n = 1L)
+        sep <- if (grepl(",", first, fixed = TRUE)) {
+          ","
+        } else if (grepl("\t", first, fixed = TRUE)) {
+          "\t"
+        } else {
+          ""
+        }
+        data <- as.matrix(utils::read.table(data, header = header,
+                                            sep = sep))
+      }
+      # vectors become one single-feature column, data.frames a matrix
+      # (the pre-Predictor Booster$predict behavior)
+      data <- as.matrix(data)
+      storage.mode(data) <- "double"
+      ptype <- 0L
+      if (rawscore) ptype <- 1L
+      if (predleaf) ptype <- 2L
+      if (predcontrib) ptype <- 3L
+      res <- .Call(LGBMTPU_BoosterPredictForMat_R, self$handle, data,
+                   nrow(data), ncol(data), ptype,
+                   as.integer(num_iteration))
+      n <- nrow(data)
+      if (length(res) > n && length(res) %% n == 0L) {
+        # (n, k) row-major across the ABI: k = classes (normal/raw),
+        # classes * iterations (leaf), or (ncol + 1) * classes (SHAP)
+        matrix(res, nrow = n, byrow = TRUE)
+      } else {
+        res
+      }
+    }
+  ),
+  private = list(owns = FALSE)
+)

@@ -19,11 +19,13 @@
 #' @export
 lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
                    nfold = 5L, early_stopping_rounds = NULL, verbose = 1L,
-                   folds = NULL) {
+                   folds = NULL, callbacks = list()) {
   nfold <- as.integer(nfold)
   if (is.na(nfold) || nfold < 2L) {
     stop("lgb.cv: nfold must be an integer >= 2")
   }
+  params <- lgb.standardize.params(params)
+  callbacks <- cb.sort(callbacks)
   from_dataset <- inherits(data, "lgb.Dataset")
   if (!from_dataset) {
     data <- as.matrix(data)
@@ -62,7 +64,16 @@ lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
   record_evals <- list(valid = list())
   best_iter <- -1L
   best_score <- Inf
+  # the callback env's "model" is a cv aggregate: record_evals/best_iter
+  # live on it the way they live on a Booster in lgb.train
+  cv_agg <- new.env(parent = emptyenv())
+  cv_agg$record_evals <- list()
+  cv_agg$best_iter <- -1L
+  cv_agg$boosters <- boosters     # cb.reset.parameters resets each fold
+  cb_env <- cb.make.env(cv_agg, 1L, nrounds)
   for (i in seq_len(nrounds)) {
+    cb_env$iteration <- i
+    cb.run.all(callbacks, cb_env, pre = TRUE)
     evs <- lapply(boosters, function(b) {
       b$update()
       b$eval(1L)
@@ -86,6 +97,7 @@ lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
                      error = function(e) logical(0))
       higher_better <- length(hb) > 0 && isTRUE(hb[[1]])
     }
+    round_evals <- list()
     for (mi in seq_len(n_metrics)) {
       vals <- vapply(evs, function(ev) ev[[mi]], numeric(1))
       mname <- metric_names[[mi]]
@@ -93,6 +105,9 @@ lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
         c(record_evals$valid[[mname]]$eval, mean(vals))
       record_evals$valid[[mname]]$eval_err <-
         c(record_evals$valid[[mname]]$eval_err, stats::sd(vals))
+      round_evals[[length(round_evals) + 1L]] <- list(
+        data_name = "valid", name = mname, value = mean(vals),
+        higher_better = (mi == 1L && higher_better))
     }
     first <- vapply(evs, function(ev) ev[[1]], numeric(1))
     if (anyNA(first) || any(is.nan(first))) {
@@ -103,6 +118,13 @@ lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
     record[i, ] <- c(mean(first), stats::sd(first))
     if (verbose > 0) {
       message(sprintf("[%d] cv: %.6f + %.6f", i, record[i, 1], record[i, 2]))
+    }
+    cb_env$eval_list <- round_evals
+    cb.run.all(callbacks, cb_env, pre = FALSE)
+    if (isTRUE(cb_env$met_early_stop)) {
+      record <- record[seq_len(i), , drop = FALSE]
+      if (best_iter < 0L) best_iter <- cv_agg$best_iter
+      break
     }
     score <- if (higher_better) -record[i, 1] else record[i, 1]
     if (score < best_score) {

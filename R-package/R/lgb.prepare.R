@@ -1,0 +1,37 @@
+# Categorical preprocessing (role of reference R-package/R/lgb.prepare.R
+# and lgb.prepare2.R).
+
+#' Convert factor/character columns to numeric codes.
+#'
+#' lightgbm consumes numeric matrices; this maps every factor column to
+#' its integer level codes and every character column to the codes of
+#' \code{factor(column)} (levels sorted, as factor() does). Numeric
+#' columns pass through untouched. Use \code{lgb.prepare_rules} instead
+#' when the same mapping must be replayed on new data (train/test
+#' consistency).
+#' @param data data.frame (or data.table) to convert
+#' @param to_integer return integer codes instead of numeric
+#'   (the reference's lgb.prepare2 variant)
+#' @return the converted data.frame
+#' @export
+lgb.prepare <- function(data, to_integer = FALSE) {
+  if (!is.data.frame(data)) {
+    stop("lgb.prepare: data must be a data.frame")
+  }
+  cast <- if (to_integer) as.integer else as.numeric
+  for (col in names(data)) {
+    v <- data[[col]]
+    if (is.factor(v)) {
+      data[[col]] <- cast(v)
+    } else if (is.character(v)) {
+      data[[col]] <- cast(factor(v))
+    }
+  }
+  data
+}
+
+#' @rdname lgb.prepare
+#' @export
+lgb.prepare2 <- function(data) {
+  lgb.prepare(data, to_integer = TRUE)
+}

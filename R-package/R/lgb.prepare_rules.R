@@ -1,0 +1,50 @@
+# Rule-carrying categorical preprocessing (role of reference
+# R-package/R/lgb.prepare_rules.R and lgb.prepare_rules2.R).
+
+#' Convert factor/character columns to numeric codes, returning the
+#' level-to-code rules so NEW data maps identically.
+#'
+#' First call (no \code{rules}): builds one named code vector per
+#' factor/character column and applies it. Later calls (with the
+#' returned \code{rules}): applies the saved mapping — unseen levels
+#' become \code{NA} (consumed as missing), exactly what train/test
+#' consistency requires.
+#' @param data data.frame to convert
+#' @param rules rules from a previous call, to replay
+#' @param to_integer return integer codes (reference lgb.prepare_rules2)
+#' @return list(data = converted data.frame, rules = named list of
+#'   level-code vectors)
+#' @export
+lgb.prepare_rules <- function(data, rules = NULL, to_integer = FALSE) {
+  if (!is.data.frame(data)) {
+    stop("lgb.prepare_rules: data must be a data.frame")
+  }
+  cast <- if (to_integer) as.integer else as.numeric
+  if (is.null(rules)) {
+    rules <- list()
+    for (col in names(data)) {
+      v <- data[[col]]
+      if (is.factor(v) || is.character(v)) {
+        f <- if (is.factor(v)) v else factor(v)
+        codes <- seq_along(levels(f))
+        names(codes) <- levels(f)
+        rules[[col]] <- codes
+      }
+    }
+  }
+  for (col in names(rules)) {
+    if (!col %in% names(data)) {
+      next
+    }
+    codes <- rules[[col]]
+    v <- as.character(data[[col]])
+    data[[col]] <- cast(unname(codes[v]))   # unseen level -> NA
+  }
+  list(data = data, rules = rules)
+}
+
+#' @rdname lgb.prepare_rules
+#' @export
+lgb.prepare_rules2 <- function(data, rules = NULL) {
+  lgb.prepare_rules(data, rules = rules, to_integer = TRUE)
+}

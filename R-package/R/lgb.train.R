@@ -25,14 +25,24 @@
 #' @param feval custom eval: function(preds, dtrain) returning
 #'   list(name = ..., value = ..., higher_better = ...); recorded into
 #'   record_evals next to (or instead of) built-in metrics
+#' @param callbacks list of callback functions (see callback.R:
+#'   cb.print.evaluation, cb.record.evaluation, cb.reset.parameters,
+#'   cb.early.stop) applied during training, in addition to the
+#'   built-in printing/recording/early-stopping this function wires up
+#'   from its own arguments
 #' @export
 lgb.train <- function(params = list(), data, nrounds = 100L,
                       valids = list(), early_stopping_rounds = NULL,
                       init_model = NULL, verbose = 1L,
-                      obj = NULL, feval = NULL) {
+                      obj = NULL, feval = NULL, callbacks = list()) {
   if (!is.list(params)) {
     stop("lgb.train: params must be a named list")
   }
+  if (!is.list(callbacks)
+      || !all(vapply(callbacks, is.function, logical(1)))) {
+    stop("lgb.train: callbacks must be a list of functions")
+  }
+  params <- lgb.standardize.params(params)
   if (is.function(params$objective)) {
     obj <- params$objective
     params$objective <- NULL
@@ -89,6 +99,7 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   # direction of the first metric (auc/ndcg/map maximize); queried from the
   # C ABI so it tracks whatever metric the params resolved to
   eval_sign <- 1
+  hb <- logical(0)
   start_iter <- booster$current_iter()
   stopped <- FALSE
   nclass <- booster$num_classes()
@@ -104,7 +115,13 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
     }
     v
   }
+  callbacks <- cb.sort(callbacks)
+  # absolute iteration numbering: init_model's trees count, so
+  # cb.early.stop's best_iter matches the built-in path's
+  cb_env <- cb.make.env(booster, start_iter + 1L, start_iter + nrounds)
   for (i in seq_len(nrounds)) {
+    cb_env$iteration <- start_iter + i
+    cb.run.all(callbacks, cb_env, pre = TRUE)
     if (is.null(obj)) {
       finished <- booster$update()
     } else {
@@ -114,6 +131,7 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
       }
       finished <- booster$update_custom(gh$grad, gh$hess)
     }
+    round_evals <- list()
     if (length(valids) > 0) {
       if (length(metric_names) == 0) {
         metric_names <- tryCatch(booster$eval_names(),
@@ -135,6 +153,9 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
             }
             booster$record_evals[[vname]][[mname]]$eval <-
               c(booster$record_evals[[vname]][[mname]]$eval, ev[[mi]])
+            round_evals[[length(round_evals) + 1L]] <- list(
+              data_name = vname, name = mname, value = ev[[mi]],
+              higher_better = (mi <= length(hb) && isTRUE(hb[[mi]])))
           }
           if (verbose > 0) {
             message(sprintf("[%d] %s: %s", i, vname,
@@ -157,6 +178,9 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
           }
           booster$record_evals[[vname]][[fname]]$eval <-
             c(booster$record_evals[[vname]][[fname]]$eval, fe$value)
+          round_evals[[length(round_evals) + 1L]] <- list(
+            data_name = vname, name = fname, value = fe$value,
+            higher_better = isTRUE(fe$higher_better))
           if (is.null(stop_val)) {
             # no built-in metric (e.g. custom objective): the feval
             # drives early stopping, honoring its direction
@@ -182,6 +206,9 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
         }
       }
     }
+    cb_env$eval_list <- round_evals
+    cb.run.all(callbacks, cb_env, pre = FALSE)
+    if (isTRUE(cb_env$met_early_stop)) stopped <- TRUE
     if (stopped || isTRUE(finished)) break
   }
   booster

@@ -1,0 +1,36 @@
+# Package unloader (role of reference R-package/R/lgb.unloader.R).
+
+#' Unload the lightgbm.tpu package.
+#'
+#' Detaches and unloads the shared library so a rebuilt package can be
+#' reloaded in the same session. Booster/Dataset handles are external
+#' pointers into the library — they die with it, so \code{wipe = TRUE}
+#' also removes every lgb.Booster/lgb.Dataset object from \code{envir}
+#' to keep dangling handles from being touched afterwards.
+#' @param restore reload the package after unloading
+#' @param wipe remove lgb.Booster/lgb.Dataset objects from envir first
+#' @param envir environment to scrub when wipe = TRUE
+#' @export
+lgb.unloader <- function(restore = TRUE, wipe = FALSE,
+                         envir = .GlobalEnv) {
+  if (wipe) {
+    objs <- ls(envir = envir)
+    is_lgb <- vapply(objs, function(nm) {
+      x <- get(nm, envir = envir)
+      inherits(x, "lgb.Booster") || inherits(x, "lgb.Dataset")
+    }, logical(1))
+    if (any(is_lgb)) {
+      rm(list = objs[is_lgb], envir = envir)
+    }
+    gc()
+  }
+  if ("package:lightgbm_tpu" %in% search()) {
+    detach("package:lightgbm_tpu", unload = TRUE)
+  }
+  library.dynam.unload("lightgbm_tpu",
+                       system.file(package = "lightgbm_tpu"))
+  if (restore) {
+    library(lightgbm_tpu)
+  }
+  invisible(NULL)
+}

@@ -1,0 +1,20 @@
+# RDS restore half (role of reference R-package/R/readRDS.lgb.Booster.R).
+
+#' Restore a Booster saved with saveRDS.lgb.Booster
+#'
+#' Rebuilds a live handle from the serialized model string and reattaches
+#' the R-side metadata (best_iter, record_evals). Also accepts a plain
+#' RDS file containing such a payload written by an older session.
+#' @param file path to the RDS file
+#' @return a restored lgb.Booster
+#' @export
+readRDS.lgb.Booster <- function(file) {
+  payload <- readRDS(file)
+  if (!identical(payload$class, "lgb.Booster.rds")) {
+    stop("file was not written by saveRDS.lgb.Booster")
+  }
+  bst <- Booster$new(model_str = payload$model_str)
+  bst$best_iter <- payload$best_iter
+  bst$record_evals <- payload$record_evals
+  bst
+}

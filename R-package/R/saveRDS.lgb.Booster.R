@@ -1,7 +1,7 @@
-# RDS round-trip (role of reference R-package/R/saveRDS.lgb.Booster.R +
-# readRDS.lgb.Booster.R). Booster handles are external pointers into the
-# embedded runtime and do not survive R serialization; the model travels
-# as its text form instead.
+# RDS save half (role of reference R-package/R/saveRDS.lgb.Booster.R).
+# Booster handles are external pointers into the embedded runtime and do
+# not survive R serialization; the model travels as its text form
+# instead. The restore half lives in readRDS.lgb.Booster.R.
 
 #' Save a Booster to an RDS file
 #'
@@ -17,17 +17,4 @@ saveRDS.lgb.Booster <- function(object, file, num_iteration = -1L,
     class = "lgb.Booster.rds")
   saveRDS(payload, file = file, compress = compress)
   invisible(object)
-}
-
-#' Restore a Booster saved with saveRDS.lgb.Booster
-#' @export
-readRDS.lgb.Booster <- function(file) {
-  payload <- readRDS(file)
-  if (!identical(payload$class, "lgb.Booster.rds")) {
-    stop("file was not written by saveRDS.lgb.Booster")
-  }
-  bst <- Booster$new(model_str = payload$model_str)
-  bst$best_iter <- payload$best_iter
-  bst$record_evals <- payload$record_evals
-  bst
 }

@@ -1,0 +1,92 @@
+# Shared internal helpers (role of reference R-package/R/utils.R).
+# The C-glue string/param plumbing lives here; every user-facing file
+# funnels its checks through these so behavior stays uniform.
+
+# Type guards -----------------------------------------------------------
+
+lgb.is.Booster <- function(x) {
+  inherits(x, "lgb.Booster") || (is(x, "R6") && inherits(x, "lgb.Booster"))
+}
+
+lgb.is.Dataset <- function(x) {
+  inherits(x, "lgb.Dataset")
+}
+
+lgb.check.r6 <- function(x, cls, what) {
+  if (!inherits(x, cls)) {
+    stop(sprintf("%s: expected a %s", what, cls))
+  }
+  invisible(TRUE)
+}
+
+# Parameter plumbing ----------------------------------------------------
+
+#' Fold alias names onto canonical parameter names.
+#'
+#' The reference resolves every Config alias before training
+#' (src/io/config.cpp ParameterAlias::KeyAliasTransform); here the alias
+#' table is generated from the same schema that drives the Python and C
+#' surfaces (tools/gen_r_aliases.py), so an R user writing
+#' \code{list(n_estimators = 10)} trains the same booster as
+#' \code{list(num_iterations = 10)}. The FIRST name wins on conflicts,
+#' matching the reference's alias priority.
+#' @keywords internal
+lgb.standardize.params <- function(params) {
+  if (length(params) == 0L) {
+    return(params)
+  }
+  out <- list()
+  for (key in names(params)) {
+    canonical <- key
+    for (name in names(.PARAMETER_ALIASES)) {
+      if (key == name || key %in% .PARAMETER_ALIASES[[name]]) {
+        canonical <- name
+        break
+      }
+    }
+    if (is.null(out[[canonical]])) {
+      out[[canonical]] <- params[[key]]
+    }
+  }
+  out
+}
+
+# The one params -> "k1=v1 k2=v2" renderer; the C side parses this exact
+# shape (capi parse_config_str). Vectors join with commas
+# (metric = c("auc", "binary_logloss") -> metric=auc,binary_logloss).
+lgb.params2str <- function(params) {
+  if (length(params) == 0L) {
+    return("")
+  }
+  pieces <- character(0)
+  for (key in names(params)) {
+    val <- params[[key]]
+    if (is.logical(val)) {
+      val <- ifelse(val, "true", "false")
+    }
+    pieces <- c(pieces, paste0(key, "=", paste(val, collapse = ",")))
+  }
+  paste(pieces, collapse = " ")
+}
+
+# Interaction checks ----------------------------------------------------
+
+lgb.check.obj <- function(params, obj) {
+  if (is.function(obj)) {
+    params$objective <- "none"
+  } else if (!is.null(obj)) {
+    params$objective <- obj
+  }
+  params
+}
+
+# first-metric name for early stopping displays
+lgb.first.metric <- function(booster) {
+  nm <- tryCatch(booster$eval_names(), error = function(e) character(0))
+  if (length(nm) > 0L) nm[[1L]] else "metric"
+}
+
+# last C-side error, surfaced on failed .Call paths
+lgb.last.error <- function() {
+  stop("lightgbm.tpu C library error (see stderr for details)")
+}

@@ -53,6 +53,7 @@ int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
 int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
                            int64_t* out_len, double* out_result);
 int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters);
 int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out_iteration);
 int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
 int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
@@ -398,6 +399,13 @@ SEXP LGBMTPU_BoosterLoadModelFromString_R(SEXP model_str) {
   return WrapHandle(h, BoosterFinalizer);
 }
 
+SEXP LGBMTPU_BoosterResetParameter_R(SEXP handle, SEXP params) {
+  CheckCall(LGBM_BoosterResetParameter(R_ExternalPtrAddr(handle),
+                                       CHAR(Rf_asChar(params))),
+            "BoosterResetParameter");
+  return R_NilValue;
+}
+
 SEXP LGBMTPU_BoosterDumpModel_R(SEXP handle, SEXP num_iteration) {
   int64_t out_len = 0;
   // first call sizes the buffer
@@ -486,6 +494,7 @@ static const R_CallMethodDef CallEntries[] = {
     {"LGBMTPU_BoosterLoadModelFromString_R", (DL_FUNC)&LGBMTPU_BoosterLoadModelFromString_R, 1},
     {"LGBMTPU_BoosterDumpModel_R", (DL_FUNC)&LGBMTPU_BoosterDumpModel_R, 2},
     {"LGBMTPU_BoosterPredictForMat_R", (DL_FUNC)&LGBMTPU_BoosterPredictForMat_R, 6},
+    {"LGBMTPU_BoosterResetParameter_R", (DL_FUNC)&LGBMTPU_BoosterResetParameter_R, 2},
     {"LGBMTPU_BoosterFeatureImportance_R", (DL_FUNC)&LGBMTPU_BoosterFeatureImportance_R, 3},
     {NULL, NULL, 0}};
 

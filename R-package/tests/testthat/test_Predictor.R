@@ -1,0 +1,62 @@
+# Predictor + RDS round trip + unloader surface (parity targets:
+# reference lgb.Predictor.R / readRDS.lgb.Booster.R behaviors).
+
+context("predictor and persistence")
+
+.pred_data <- function(n = 600L, f = 5L, seed = 21L) {
+  set.seed(seed)
+  x <- matrix(rnorm(n * f), ncol = f)
+  y <- as.numeric(x[, 1L] + 0.5 * x[, 2L] + rnorm(n) * 0.3 > 0)
+  list(x = x, y = y)
+}
+
+test_that("Predictor shares a live booster handle", {
+  d <- .pred_data()
+  bst <- lightgbm(data = d$x, label = d$y, nrounds = 5L,
+                  objective = "binary", verbose = -1L)
+  pred <- lightgbm_tpu:::Predictor$new(booster_handle = bst$handle)
+  expect_equal(pred$current_iter(), 5L)
+  expect_equal(pred$num_classes(), 1L)
+  p_direct <- predict(bst, d$x, raw_score = TRUE)
+  p_pred <- pred$predict(d$x, rawscore = TRUE)
+  expect_equal(p_direct, p_pred)
+})
+
+test_that("Predictor loads from a model file", {
+  d <- .pred_data()
+  bst <- lightgbm(data = d$x, label = d$y, nrounds = 3L,
+                  objective = "binary", verbose = -1L)
+  f <- tempfile(fileext = ".txt")
+  lgb.save(bst, f)
+  pred <- lightgbm_tpu:::Predictor$new(modelfile = f)
+  expect_equal(pred$current_iter(), 3L)
+  expect_equal(pred$predict(d$x), predict(bst, d$x))
+  unlink(f)
+})
+
+test_that("leaf and contribution predictions shape per row", {
+  d <- .pred_data()
+  bst <- lightgbm(data = d$x, label = d$y, nrounds = 4L,
+                  objective = "binary", verbose = -1L)
+  leaves <- predict(bst, d$x[1:10L, ], predleaf = TRUE)
+  expect_equal(nrow(leaves), 10L)
+  expect_equal(ncol(leaves), 4L)            # one column per iteration
+  contrib <- predict(bst, d$x[1:10L, ], predcontrib = TRUE)
+  expect_equal(nrow(contrib), 10L)
+  expect_equal(ncol(contrib), ncol(d$x) + 1L)  # + bias column
+  # SHAP columns sum to the raw score
+  raw <- predict(bst, d$x[1:10L, ], raw_score = TRUE)
+  expect_equal(rowSums(contrib), as.numeric(raw), tolerance = 1e-6)
+})
+
+test_that("saveRDS/readRDS round trip preserves predictions", {
+  d <- .pred_data()
+  bst <- lightgbm(data = d$x, label = d$y, nrounds = 4L,
+                  objective = "binary", verbose = -1L)
+  f <- tempfile(fileext = ".rds")
+  saveRDS.lgb.Booster(bst, f)
+  restored <- readRDS.lgb.Booster(f)
+  expect_equal(predict(restored, d$x), predict(bst, d$x))
+  expect_equal(restored$best_iter, bst$best_iter)
+  unlink(f)
+})

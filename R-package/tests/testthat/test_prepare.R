@@ -1,0 +1,63 @@
+# lgb.prepare / lgb.prepare_rules behaviors (parity targets:
+# reference R-package lgb.prepare*.R semantics).
+
+context("categorical preparation")
+
+.mixed_frame <- function() {
+  data.frame(
+    num = c(1.5, 2.5, 3.5, 4.5),
+    fac = factor(c("b", "a", "b", "c")),
+    chr = c("x", "y", "x", "z"),
+    stringsAsFactors = FALSE
+  )
+}
+
+test_that("lgb.prepare converts factor and character columns", {
+  out <- lgb.prepare(.mixed_frame())
+  expect_true(is.numeric(out$num))
+  expect_true(is.numeric(out$fac))
+  expect_true(is.numeric(out$chr))
+  # factor codes follow level order (a=1, b=2, c=3)
+  expect_equal(out$fac, c(2, 1, 2, 3))
+  expect_equal(out$chr, c(1, 2, 1, 3))
+})
+
+test_that("lgb.prepare2 returns integer codes", {
+  out <- lgb.prepare2(.mixed_frame())
+  expect_true(is.integer(out$fac))
+  expect_true(is.integer(out$chr))
+})
+
+test_that("lgb.prepare_rules replays identically on new data", {
+  first <- lgb.prepare_rules(.mixed_frame())
+  expect_true(is.list(first$rules))
+  expect_true(all(c("fac", "chr") %in% names(first$rules)))
+  newdata <- data.frame(
+    num = c(9.9, 8.8),
+    fac = factor(c("c", "a")),
+    chr = c("z", "unseen"),
+    stringsAsFactors = FALSE
+  )
+  replay <- lgb.prepare_rules(newdata, rules = first$rules)
+  expect_equal(replay$data$fac, c(3, 1))
+  expect_equal(replay$data$chr[1L], 3)
+  expect_true(is.na(replay$data$chr[2L]))  # unseen level -> NA (missing)
+  # rules pass through unchanged on replay
+  expect_identical(replay$rules, first$rules)
+})
+
+test_that("prepared frame trains end-to-end", {
+  set.seed(5L)
+  n <- 400L
+  df <- data.frame(
+    a = rnorm(n),
+    b = factor(sample(c("u", "v", "w"), n, replace = TRUE)),
+    stringsAsFactors = FALSE
+  )
+  y <- as.numeric(df$a + (df$b == "v") + rnorm(n) * 0.3 > 0.5)
+  conv <- lgb.prepare_rules(df)
+  bst <- lightgbm(data = as.matrix(conv$data), label = y,
+                  nrounds = 5L, objective = "binary",
+                  categorical_feature = 2L, verbose = -1L)
+  expect_true(inherits(bst, "lgb.Booster"))
+})

@@ -1,0 +1,91 @@
+"""R package consistency checks runnable without an R runtime.
+
+The R surface itself can only execute under R (testthat files ship for
+that); what CI can still pin here: (a) the generated alias table stays
+in sync with the one parameter schema, (b) every .Call target in the R
+sources is registered in the C glue (typos in the untestable surface
+fail fast), (c) the R sources are delimiter-balanced — the crude
+syntax screen that catches a broken edit.
+"""
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+R_DIR = os.path.join(REPO, "R-package", "R")
+
+
+def test_aliases_generated_in_sync():
+    """aliases.R is generated from params_schema.py; a schema edit that
+    forgets to regenerate leaves R resolving stale aliases."""
+    path = os.path.join(R_DIR, "aliases.R")
+    committed = open(path).read()
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "gen_r_aliases.py")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    regenerated = open(path).read()
+    assert committed == regenerated, \
+        "R-package/R/aliases.R is stale; run tools/gen_r_aliases.py"
+
+
+def test_r_call_targets_registered():
+    """Every .Call(LGBMTPU_*_R, ...) symbol used by the R sources must be
+    registered in lightgbm_tpu_R.cpp's CallEntries."""
+    glue = open(os.path.join(REPO, "R-package", "src",
+                             "lightgbm_tpu_R.cpp")).read()
+    registered = set(re.findall(r'\{"(LGBMTPU_\w+_R)"', glue))
+    assert registered, "no CallEntries found in the glue"
+    used = set()
+    for fn in os.listdir(R_DIR):
+        if fn.endswith(".R"):
+            src = open(os.path.join(R_DIR, fn)).read()
+            used |= set(re.findall(r"\.Call\(\s*(LGBMTPU_\w+_R)", src))
+    missing = used - registered
+    assert not missing, f"R sources call unregistered glue: {missing}"
+
+
+def _strip_r(src: str) -> str:
+    """Remove comments and string literals (quote/escape aware) so
+    delimiter counting sees only code."""
+    out = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c in "\"'":
+            q = c
+            i += 1
+            while i < n and src[i] != q:
+                i += 2 if src[i] == "\\" else 1
+            i += 1
+        elif c == "#":
+            while i < n and src[i] != "\n":
+                i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def test_r_sources_balanced():
+    files = [f for f in os.listdir(R_DIR) if f.endswith(".R")]
+    assert len(files) >= 18, f"R surface shrank: {sorted(files)}"
+    for fn in files:
+        code = _strip_r(open(os.path.join(R_DIR, fn)).read())
+        for o, c in ("()", "{}", "[]"):
+            assert code.count(o) == code.count(c), \
+                f"{fn}: unbalanced {o}{c} " \
+                f"({code.count(o)} vs {code.count(c)})"
+
+
+def test_r_namespace_exports_exist():
+    """Everything NAMESPACE exports must be defined somewhere in R/."""
+    ns = open(os.path.join(REPO, "R-package", "NAMESPACE")).read()
+    exported = re.findall(r"export\(([\w.]+)\)", ns)
+    all_src = "\n".join(
+        open(os.path.join(R_DIR, f)).read()
+        for f in os.listdir(R_DIR) if f.endswith(".R"))
+    for sym in exported:
+        pat = re.escape(sym) + r"\s*(<-|=)\s*function"
+        assert re.search(pat, all_src), f"exported {sym} is not defined"
