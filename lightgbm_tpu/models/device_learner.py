@@ -1614,6 +1614,29 @@ def exact_k_bag_weights(bag_key: jax.Array, n: int, bag_k: int) -> jax.Array:
     return (u <= cut).astype(jnp.float32)
 
 
+def goss_sample(g, h, bag_key, n: int, top_k: int, other_k: int,
+                multiply: float):
+    """The ONE copy of in-program GOSS sampling (reference
+    src/boosting/goss.hpp:60-117), shared by the serial and the
+    feature-parallel fused steps: rank-based exact top_k by |g*h|
+    (gradient ties cannot change the subset size), exactly other_k of
+    the rest uniformly, amplified by `multiply` (goss.hpp:91). Returns
+    (g, h, w, bag_idx, oob_idx) — amplified gradients, 0/1 weights, and
+    the in-bag / out-of-bag row ids for bag compaction."""
+    gmag = jnp.abs(g * h)
+    ridx = jnp.argsort(-gmag, stable=True)
+    top_idx, rest = ridx[:top_k], ridx[top_k:]
+    perm = jnp.argsort(jax.random.uniform(bag_key, (n - top_k,)))
+    other_idx = jnp.take(rest, perm[:other_k])
+    oob_idx = jnp.take(rest, perm[other_k:])
+    bag_idx = jnp.concatenate([top_idx, other_idx])
+    amp = jnp.ones((n,), jnp.float32).at[other_idx].set(
+        jnp.float32(multiply), unique_indices=True)
+    w = jnp.zeros((n,), jnp.float32).at[bag_idx].set(
+        1.0, unique_indices=True)
+    return g * amp, h * amp, w, bag_idx, oob_idx
+
+
 def route_rows_by_rec(codes_pack_rows: jax.Array, rec: jax.Array,
                       k: jax.Array, f_numbins, f_missing, f_default,
                       f_col, f_base, f_elide, *, item_bits: int,
@@ -2138,24 +2161,8 @@ class DeviceTreeLearner:
             g, h = objective.get_gradients(score_row)
             bag_idx = oob_idx = None
             if goss is not None:
-                # exactly top_k rows by |g*h| always kept (rank-based, so
-                # gradient ties cannot change the subset size), exactly
-                # other_k of the rest sampled uniformly with gradient
-                # amplification (goss.hpp:91)
-                gmag = jnp.abs(g * h)
-                ridx = jnp.argsort(-gmag, stable=True)
-                top_idx, rest = ridx[:top_k], ridx[top_k:]
-                perm = jnp.argsort(
-                    jax.random.uniform(bag_key, (n - top_k,)))
-                other_idx = jnp.take(rest, perm[:other_k])
-                oob_idx = jnp.take(rest, perm[other_k:])
-                bag_idx = jnp.concatenate([top_idx, other_idx])
-                amp = jnp.ones((n,), jnp.float32).at[other_idx].set(
-                    jnp.float32(multiply), unique_indices=True)
-                g = g * amp
-                h = h * amp
-                w = jnp.zeros((n,), jnp.float32).at[bag_idx].set(
-                    1.0, unique_indices=True)
+                g, h, w, bag_idx, oob_idx = goss_sample(
+                    g, h, bag_key, n, top_k, other_k, multiply)
             elif bag_on:
                 w = exact_k_bag_weights(bag_key, n, bag_k)
                 inbag = w > 0

@@ -224,8 +224,8 @@ class GBDT:
         from .device_learner import DeviceTreeLearner
         if self.__class__ is GOSS and not getattr(
                 self.learner, "supports_fused_goss", False):
-            # learners without in-program GOSS sampling (the feature-
-            # parallel device learner) fall back to the generic path
+            # every current device learner carries in-program GOSS; the
+            # guard protects future device learners that opt out
             return False
         return (self.__class__ in (GBDT, GOSS)
                 and isinstance(self.learner, DeviceTreeLearner)

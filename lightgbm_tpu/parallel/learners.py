@@ -776,7 +776,7 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
     with the entire leaf-wise tree grown inside one shard_map program
     instead of one host round-trip per split."""
 
-    supports_fused_goss = False   # make_fused_step(goss=...) raises
+    supports_fused_goss = True    # rows replicated: single-chip GOSS
 
     def __init__(self, config: Config, dataset: Dataset,
                  mesh: Optional[Mesh] = None):
@@ -850,18 +850,24 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
     def make_fused_step(self, objective, goss=None, bagging=True):
         """Fused boosting iteration over the feature mesh: one sharded
         whole-tree program per iteration (rows replicated, columns
-        sliced), same contract as DeviceTreeLearner.make_fused_step."""
-        if goss is not None:
-            raise NotImplementedError(
-                "fused GOSS is not supported on the feature-parallel "
-                "learner")
+        sliced), same contract as DeviceTreeLearner.make_fused_step.
+
+        goss = (top_k, other_k, multiply): rows are REPLICATED on every
+        shard, so GOSS is the single-chip in-program sampling verbatim
+        (global exact top_k by |g*h| + uniform rest + amplification,
+        reference src/boosting/goss.hpp) — computed once in the outer
+        jit and handed to the shard_map replicated."""
         from ..models.device_learner import leaf_values_from_rec
         cfg = self.config
         n = self.dataset.num_data
         L = int(cfg.num_leaves)
-        bag_on = (bagging and cfg.bagging_freq > 0
-                  and cfg.bagging_fraction < 1.0)
-        bag_k = max(1, int(n * cfg.bagging_fraction))
+        if goss is not None:
+            top_k, other_k, multiply = goss
+            bag_on = False
+        else:
+            bag_on = (bagging and cfg.bagging_freq > 0
+                      and cfg.bagging_fraction < 1.0)
+            bag_k = max(1, int(n * cfg.bagging_fraction))
         fn = self._sharded_tree_fn()
 
         has_cat = self._has_cat
@@ -869,7 +875,11 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         @jax.jit
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
             g, h = objective.get_gradients(score_row)
-            if bag_on:
+            if goss is not None:
+                from ..models.device_learner import goss_sample
+                g, h, w, _, _ = goss_sample(
+                    g, h, bag_key, n, top_k, other_k, multiply)
+            elif bag_on:
                 from ..models.device_learner import exact_k_bag_weights
                 w = exact_k_bag_weights(bag_key, n, bag_k)
             else:

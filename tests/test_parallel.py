@@ -316,3 +316,28 @@ def test_voting_categorical_quality():
     assert _has_cat_split(bv, 12), "no categorical split exercised"
     auc = _auc(y, bv.predict(x, raw_score=True))
     assert auc > 0.85
+
+
+def test_feature_parallel_fused_goss_matches_serial(monkeypatch):
+    """FP fused GOSS (rows replicated -> single-chip sampling verbatim)
+    must agree with the serial device learner's fused GOSS tree-for-tree:
+    identical keys draw identical samples, and FP's sliced election is
+    the same algorithm as the serial scan. Both sides are pinned to the
+    compact core (serial auto would pick masked below 65536 rows, whose
+    different summation order perturbs amplified sigmoid gradients)."""
+    from lightgbm_tpu.parallel.learners import (
+        DeviceFeatureParallelTreeLearner)
+    monkeypatch.setenv("LGBM_TPU_STRATEGY", "compact")
+    x, y = make_binary(4000, 8)
+    params = dict(boosting="goss", top_rate=0.2, other_rate=0.2,
+                  learning_rate=0.5)
+    # 4 rounds: per-round fp drift (sliced vs serial summation order on
+    # GOSS-amplified sigmoid gradients) compounds through the scores and
+    # can push a later tree's gain past the structural tolerance
+    bs = _train(x, y, "serial", rounds=4, **params)
+    bf = _train(x, y, "feature", rounds=4, **params)
+    assert isinstance(bf.learner, DeviceFeatureParallelTreeLearner)
+    # both must actually run the fused GOSS program (goss fkey True)
+    assert bs._fused_step and True in bs._fused_step
+    assert bf._fused_step and True in bf._fused_step
+    assert_trees_structurally_equal(bs, bf, 4, "fp-fused-goss")
