@@ -146,6 +146,7 @@ class Dataset:
                 categorical_feature=(cats if isinstance(cats,
                                                         (list, tuple))
                                      else None))
+            self._load_side_files(data)
             if self.label is not None:
                 inner.metadata.set_label(self.label)
             if self.weight is not None:
@@ -194,15 +195,8 @@ class Dataset:
         if self.reference is not None:
             self.reference.construct()
             ref_inner = self.reference._inner
-        # side files (reference: Metadata loads <data>.weight/.query)
         if isinstance(self.data, str):
-            import os
-            wpath = self.data + ".weight"
-            qpath = self.data + ".query"
-            if self.weight is None and os.path.exists(wpath):
-                self.weight = np.loadtxt(wpath)
-            if self.group is None and os.path.exists(qpath):
-                self.group = np.loadtxt(qpath).astype(np.int64)
+            self._load_side_files(self.data)
         self._inner = _InnerDataset(
             data, config=cfg, label=label, weight=self.weight,
             group=self.group, init_score=self.init_score,
@@ -211,6 +205,16 @@ class Dataset:
         if self.free_raw_data and not isinstance(self.data, str):
             self.data = None
         return self
+
+    def _load_side_files(self, path: str) -> None:
+        """<data>.weight / <data>.query ride along with a file dataset
+        (reference: Metadata::LoadWeights/LoadQueryBoundaries) — the ONE
+        copy shared by the in-memory and two_round construct branches."""
+        import os
+        if self.weight is None and os.path.exists(path + ".weight"):
+            self.weight = np.loadtxt(path + ".weight")
+        if self.group is None and os.path.exists(path + ".query"):
+            self.group = np.loadtxt(path + ".query").astype(np.int64)
 
     def _update_params(self, params: Dict[str, Any]) -> None:
         if self._inner is not None:

@@ -59,3 +59,17 @@ def test_two_round_alias(tmp_path):
               "use_two_round_loading": True}
     bst = lgb.train(params, lgb.Dataset(path), num_boost_round=2)
     assert bst.current_iteration() == 2
+
+
+def test_two_round_loads_side_files(tmp_path):
+    # <data>.weight / <data>.query ride along like the in-memory path
+    path, x, y = _write_csv(tmp_path, n=600)
+    w = np.linspace(0.5, 1.5, 600)
+    np.savetxt(path + ".weight", w, fmt="%.6f")
+    np.savetxt(path + ".query", np.full(6, 100), fmt="%d")
+    ds = lgb.Dataset(path, params={"two_round": True,
+                                   "objective": "lambdarank",
+                                   "verbosity": -1}).construct()
+    got_w = ds._inner.metadata.weight
+    np.testing.assert_allclose(got_w, w, rtol=1e-5)
+    assert ds._inner.metadata.query_boundaries is not None
