@@ -2157,7 +2157,15 @@ class DeviceTreeLearner:
             grow, grow_kw = grow_tree, {}
 
         @jax.jit
-        def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+        def step_impl(codes_pack, codes_row, score_row, base_mask,
+                      tree_key, bag_key, shrinkage):
+            # the code buffers are explicit ARGUMENTS, not closure
+            # captures: closed-over device arrays lower as HLO constants,
+            # which baked the whole binned dataset into the program
+            # (~112 MB of StableHLO at 1M x 28 vs 8 MB with args) —
+            # bloating the remote-compile payload and keying the
+            # persistent compile cache on the dataset bytes instead of
+            # just shapes. Masked strategy passes (codes_t, codes_t).
             g, h = objective.get_gradients(score_row)
             bag_idx = oob_idx = None
             if goss is not None:
@@ -2175,13 +2183,13 @@ class DeviceTreeLearner:
                         stable=True)
                     bag_idx, oob_idx = order[:bag_k], order[bag_k:]
                 rec, rec_cat, leaf_b, k, _ = grow(
-                    jnp.take(self.codes_pack, bag_idx, axis=0),
-                    jnp.take(self.codes_row, bag_idx, axis=0),
+                    jnp.take(codes_pack, bag_idx, axis=0),
+                    jnp.take(codes_row, bag_idx, axis=0),
                     jnp.take(g, bag_idx), jnp.take(h, bag_idx),
                     jnp.ones((bag_k,), jnp.float32), base_mask,
                     *meta, tree_key, **grow_kw, **statics)
                 leaf_o = route_rows_by_rec(
-                    jnp.take(self.codes_pack, oob_idx, axis=0), rec, k,
+                    jnp.take(codes_pack, oob_idx, axis=0), rec, k,
                     self.f_numbins, self.f_missing, self.f_default,
                     self.f_col, self.f_base, self.f_elide,
                     item_bits=self.item_bits, num_leaves=L,
@@ -2191,17 +2199,24 @@ class DeviceTreeLearner:
                     .at[oob_idx].set(leaf_o, unique_indices=True)
             elif use_compact:
                 rec, rec_cat, leaf_id, k, _ = grow(
-                    self.codes_pack, self.codes_row, g, h, w, base_mask,
+                    codes_pack, codes_row, g, h, w, base_mask,
                     *meta, tree_key, **grow_kw, **statics)
             else:
                 rec, rec_cat, leaf_id, k, _ = grow(
-                    self.codes_t, g, h, w, base_mask, *meta, tree_key,
+                    codes_pack, g, h, w, base_mask, *meta, tree_key,
                     **statics)
 
             # on-device leaf-value replay avoids any H2D of leaf values
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
             return score_row + delta, rec, rec_cat, leaf_id, k
+
+        codes_args = ((self.codes_pack, self.codes_row) if use_compact
+                      else (self.codes_t, self.codes_t))
+
+        def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+            return step_impl(*codes_args, score_row, base_mask, tree_key,
+                             bag_key, shrinkage)
 
         return step
 

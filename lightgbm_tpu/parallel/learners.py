@@ -727,18 +727,25 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         has_cat = self._has_cat
 
         @jax.jit
-        def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+        def step_impl(codes_pack, codes_row, score_row, base_mask,
+                      tree_key, bag_key, shrinkage):
+            # codes as args, not closure constants — see the serial
+            # make_fused_step note (program-size / compile payload)
             g, h = objective.get_gradients(score_row)
             g = jnp.pad(g, (0, npad - n))
             h = jnp.pad(h, (0, npad - n))
             rec, rec_cat, leaf_id_pad, k, _ = fn(
-                self.codes_pack, self.codes_row,
+                codes_pack, codes_row,
                 g, h, bag_key, base_mask, tree_key)
             leaf_id = leaf_id_pad[:n]
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
             return (score_row + delta, rec, rec_cat if has_cat else None,
                     leaf_id, k)
+
+        def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+            return step_impl(self.codes_pack, self.codes_row, score_row,
+                             base_mask, tree_key, bag_key, shrinkage)
 
         return step
 
@@ -873,7 +880,10 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
         has_cat = self._has_cat
 
         @jax.jit
-        def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+        def step_impl(codes_pack, codes_row, score_row, base_mask,
+                      tree_key, bag_key, shrinkage):
+            # codes as args, not closure constants — see the serial
+            # make_fused_step note (program-size / compile payload)
             g, h = objective.get_gradients(score_row)
             if goss is not None:
                 from ..models.device_learner import goss_sample
@@ -884,13 +894,16 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                 w = exact_k_bag_weights(bag_key, n, bag_k)
             else:
                 w = jnp.ones((n,), jnp.float32)
-            rec, rec_cat, leaf_id, k, _ = fn(self.codes_pack,
-                                             self.codes_row,
+            rec, rec_cat, leaf_id, k, _ = fn(codes_pack, codes_row,
                                              g, h, w, base_mask, tree_key)
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
             return (score_row + delta, rec, rec_cat if has_cat else None,
                     leaf_id, k)
+
+        def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+            return step_impl(self.codes_pack, self.codes_row, score_row,
+                             base_mask, tree_key, bag_key, shrinkage)
 
         return step
 
