@@ -14,9 +14,6 @@
 # This mirrors the reference's cb.* environment contract so user
 # callbacks written for the reference port over mechanically.
 
-CB_ENV_KEYS <- c("model", "iteration", "begin_iteration", "end_iteration",
-                 "eval_list", "met_early_stop")
-
 cb.make.env <- function(model, begin_iteration, end_iteration) {
   env <- new.env(parent = emptyenv())
   env$model <- model
@@ -149,6 +146,12 @@ cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
   best_score <- Inf
   best_iter <- -1L
   callback <- function(env) {
+    if (env$iteration == env$begin_iteration) {
+      # reset closure state so one callback object can serve several
+      # trainings without carrying the previous run's best
+      best_score <<- Inf
+      best_iter <<- -1L
+    }
     if (length(env$eval_list) == 0L) {
       return(invisible(NULL))
     }

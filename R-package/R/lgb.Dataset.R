@@ -105,13 +105,5 @@ lgb.Dataset <- function(data, params = list(), label = NULL, weight = NULL,
   Dataset$new(data, params, label, weight, group, init_score, reference)
 }
 
-# params list -> "k1=v1 k2=v2" string through the C ABI (the same free-form
-# contract the Python binding uses, reference basic.py param_dict_to_str)
-lgb.params2str <- function(params) {
-  if (length(params) == 0) return("")
-  paste(vapply(names(params), function(k) {
-    v <- params[[k]]
-    if (is.logical(v)) v <- tolower(as.character(v))
-    paste0(k, "=", paste(v, collapse = ","))
-  }, character(1)), collapse = " ")
-}
+# lgb.params2str (params list -> "k1=v1 k2=v2") lives in utils.R — the
+# one renderer shared by every .Call site.

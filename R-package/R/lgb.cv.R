@@ -26,7 +26,7 @@ lgb.cv <- function(params = list(), data, label = NULL, nrounds = 100L,
   }
   params <- lgb.standardize.params(params)
   callbacks <- cb.sort(callbacks)
-  from_dataset <- inherits(data, "lgb.Dataset")
+  from_dataset <- lgb.is.Dataset(data)
   if (!from_dataset) {
     data <- as.matrix(data)
     storage.mode(data) <- "double"

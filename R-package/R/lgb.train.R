@@ -56,7 +56,7 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   if (!is.null(feval) && !is.function(feval)) {
     stop("lgb.train: feval must be a function(preds, dtrain)")
   }
-  if (!inherits(data, "lgb.Dataset")) {
+  if (!lgb.is.Dataset(data)) {
     stop("lgb.train: data must be an lgb.Dataset")
   }
   nrounds <- as.integer(nrounds)
@@ -67,7 +67,7 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
     if (is.null(names(valids)) || any(names(valids) == "")) {
       stop("lgb.train: every element of valids must be named")
     }
-    if (!all(vapply(valids, inherits, logical(1), "lgb.Dataset"))) {
+    if (!all(vapply(valids, lgb.is.Dataset, logical(1)))) {
       stop("lgb.train: valids must contain lgb.Dataset objects")
     }
   }
@@ -80,7 +80,7 @@ lgb.train <- function(params = list(), data, nrounds = 100L,
   if (!is.null(init_model)) {
     prev <- if (is.character(init_model)) {
       Booster$new(modelfile = init_model)
-    } else if (inherits(init_model, "lgb.Booster")) {
+    } else if (lgb.is.Booster(init_model)) {
       init_model
     } else {
       stop("init_model must be a file path or an lgb.Booster")

@@ -24,13 +24,13 @@ lgb.unloader <- function(restore = TRUE, wipe = FALSE,
     }
     gc()
   }
-  if ("package:lightgbm_tpu" %in% search()) {
-    detach("package:lightgbm_tpu", unload = TRUE)
+  if ("package:lightgbm.tpu" %in% search()) {
+    detach("package:lightgbm.tpu", unload = TRUE)
   }
-  library.dynam.unload("lightgbm_tpu",
-                       system.file(package = "lightgbm_tpu"))
+  library.dynam.unload("lightgbm.tpu",
+                       system.file(package = "lightgbm.tpu"))
   if (restore) {
-    library(lightgbm_tpu)
+    library(lightgbm.tpu)
   }
   invisible(NULL)
 }

@@ -2,21 +2,14 @@
 # The C-glue string/param plumbing lives here; every user-facing file
 # funnels its checks through these so behavior stays uniform.
 
-# Type guards -----------------------------------------------------------
+# Type guards (used by lgb.train / lgb.cv argument validation) ----------
 
 lgb.is.Booster <- function(x) {
-  inherits(x, "lgb.Booster") || (is(x, "R6") && inherits(x, "lgb.Booster"))
+  inherits(x, "lgb.Booster")
 }
 
 lgb.is.Dataset <- function(x) {
   inherits(x, "lgb.Dataset")
-}
-
-lgb.check.r6 <- function(x, cls, what) {
-  if (!inherits(x, cls)) {
-    stop(sprintf("%s: expected a %s", what, cls))
-  }
-  invisible(TRUE)
 }
 
 # Parameter plumbing ----------------------------------------------------
@@ -28,24 +21,39 @@ lgb.check.r6 <- function(x, cls, what) {
 #' table is generated from the same schema that drives the Python and C
 #' surfaces (tools/gen_r_aliases.py), so an R user writing
 #' \code{list(n_estimators = 10)} trains the same booster as
-#' \code{list(num_iterations = 10)}. The FIRST name wins on conflicts,
-#' matching the reference's alias priority.
+#' \code{list(num_iterations = 10)}. On a conflict the CANONICAL name
+#' wins over any alias (the reference keeps the canonical value and
+#' only warns about the losing alias).
 #' @keywords internal
 lgb.standardize.params <- function(params) {
   if (length(params) == 0L) {
     return(params)
   }
-  out <- list()
-  for (key in names(params)) {
-    canonical <- key
+  canon_of <- function(key) {
     for (name in names(.PARAMETER_ALIASES)) {
       if (key == name || key %in% .PARAMETER_ALIASES[[name]]) {
-        canonical <- name
-        break
+        return(name)
       }
     }
-    if (is.null(out[[canonical]])) {
-      out[[canonical]] <- params[[key]]
+    key
+  }
+  out <- list()
+  keys <- names(params)
+  canon <- vapply(keys, canon_of, character(1))
+  # canonical spellings first, then aliases (first alias wins among
+  # aliases); a losing entry warns like the reference's alias transform
+  for (pass in 1:2) {
+    for (i in seq_along(keys)) {
+      is_canonical <- keys[[i]] == canon[[i]]
+      if ((pass == 1L) != is_canonical) {
+        next
+      }
+      if (is.null(out[[canon[[i]]]])) {
+        out[[canon[[i]]]] <- params[[i]]
+      } else {
+        warning(sprintf("parameter '%s' is ignored: '%s' already set",
+                        keys[[i]], canon[[i]]))
+      }
     }
   }
   out
@@ -69,24 +77,3 @@ lgb.params2str <- function(params) {
   paste(pieces, collapse = " ")
 }
 
-# Interaction checks ----------------------------------------------------
-
-lgb.check.obj <- function(params, obj) {
-  if (is.function(obj)) {
-    params$objective <- "none"
-  } else if (!is.null(obj)) {
-    params$objective <- obj
-  }
-  params
-}
-
-# first-metric name for early stopping displays
-lgb.first.metric <- function(booster) {
-  nm <- tryCatch(booster$eval_names(), error = function(e) character(0))
-  if (length(nm) > 0L) nm[[1L]] else "metric"
-}
-
-# last C-side error, surfaced on failed .Call paths
-lgb.last.error <- function() {
-  stop("lightgbm.tpu C library error (see stderr for details)")
-}

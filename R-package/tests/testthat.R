@@ -3,6 +3,6 @@
 # exercised there indirectly via tests/test_c_api.py::test_r_behavior_mirror,
 # which drives the same scenarios through the C ABI the R glue binds.
 library(testthat)
-library(lightgbm_tpu)
+library(lightgbm.tpu)
 
-test_check("lightgbm_tpu")
+test_check("lightgbm.tpu")

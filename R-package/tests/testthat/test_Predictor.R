@@ -14,7 +14,7 @@ test_that("Predictor shares a live booster handle", {
   d <- .pred_data()
   bst <- lightgbm(data = d$x, label = d$y, nrounds = 5L,
                   objective = "binary", verbose = -1L)
-  pred <- lightgbm_tpu:::Predictor$new(booster_handle = bst$handle)
+  pred <- lightgbm.tpu:::Predictor$new(booster_handle = bst$handle)
   expect_equal(pred$current_iter(), 5L)
   expect_equal(pred$num_classes(), 1L)
   p_direct <- predict(bst, d$x, raw_score = TRUE)
@@ -28,7 +28,7 @@ test_that("Predictor loads from a model file", {
                   objective = "binary", verbose = -1L)
   f <- tempfile(fileext = ".txt")
   lgb.save(bst, f)
-  pred <- lightgbm_tpu:::Predictor$new(modelfile = f)
+  pred <- lightgbm.tpu:::Predictor$new(modelfile = f)
   expect_equal(pred$current_iter(), 3L)
   expect_equal(pred$predict(d$x), predict(bst, d$x))
   unlink(f)

@@ -147,6 +147,15 @@ def find_bin_with_zero_as_one_bin(distinct_values: np.ndarray, counts: np.ndarra
 class BinMapper:
     """Per-feature value->bin mapping (numerical or categorical)."""
 
+    @classmethod
+    def trivial(cls) -> "BinMapper":
+        """One-bin mapper for an ignored/constant feature — the one
+        copy shared by the in-memory, sparse and two-round loaders."""
+        m = cls()
+        m.is_trivial = True
+        m.num_bin = 1
+        return m
+
     def __init__(self):
         self.num_bin: int = 1
         self.missing_type: int = MISSING_NONE

@@ -23,6 +23,22 @@ from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN,
                       resolve_ignore_set)
 
 
+def resolve_categorical_set(spec, feature_names) -> set:
+    """categorical_feature spec (indices / names / 'name:x') -> column
+    index set — the one copy shared by the in-memory, sparse and
+    two-round loaders."""
+    cats = set()
+    for c in (spec or []):
+        if isinstance(c, str):
+            if c.startswith("name:"):
+                c = c[5:]
+            if c in feature_names:
+                cats.add(feature_names.index(c))
+        else:
+            cats.add(int(c))
+    return cats
+
+
 class Metadata:
     """Labels, weights, query boundaries, init scores
     (reference: dataset.h:41-250, src/io/metadata.cpp)."""
@@ -204,16 +220,9 @@ class Dataset:
         return arr, None
 
     def _resolve_categorical(self, categorical_feature) -> set:
-        cats = set()
-        for c in (categorical_feature or self.config.categorical_feature or []):
-            if isinstance(c, str):
-                if c.startswith("name:"):
-                    c = c[5:]
-                if c in self.feature_names:
-                    cats.add(self.feature_names.index(c))
-            else:
-                cats.add(int(c))
-        return cats
+        return resolve_categorical_set(
+            categorical_feature or self.config.categorical_feature,
+            self.feature_names)
 
     def _build_mappers(self, data: np.ndarray, cat_idx: set) -> List[BinMapper]:
         cfg = self.config
@@ -229,10 +238,7 @@ class Dataset:
         mappers = []
         for f in range(self.num_total_features):
             if f in ignore:
-                m = BinMapper()
-                m.is_trivial = True
-                m.num_bin = 1
-                mappers.append(m)
+                mappers.append(BinMapper.trivial())
                 continue
             mappers.append(mapper_from_sample_column(
                 data[sample_rows, f], len(sample_rows), cfg, f, cat_idx,
@@ -267,10 +273,7 @@ class Dataset:
         mappers = []
         for f in range(self.num_total_features):
             if f in ignore:
-                m = BinMapper()
-                m.is_trivial = True
-                m.num_bin = 1
-                mappers.append(m)
+                mappers.append(BinMapper.trivial())
                 continue
             lo, hi = int(indptr[f]), int(indptr[f + 1])
             vals = values[lo:hi]

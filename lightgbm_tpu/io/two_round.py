@@ -77,26 +77,31 @@ def load_two_round(path: str, config, label_column: int = 0,
     passes. Returns (dataset, label_vector)."""
     from .binning import (BinMapper, load_forced_bounds,
                           mapper_from_sample_column, resolve_ignore_set)
-    from .dataset import Dataset
+    from .dataset import Dataset, resolve_categorical_set
 
     delim, header = _open_rows(path, label_column)
     sample_cnt = int(config.bin_construct_sample_cnt)
     rng = np.random.RandomState(config.data_random_seed)
 
-    # ---- round 1: count + reservoir sample (Algorithm R, seeded) ------
+    # ---- round 1: count + reservoir sample (Algorithm R, seeded, one
+    # vectorized draw per chunk — numpy fancy assignment applies in
+    # index order, so a later row overwriting an earlier one at the
+    # same slot reproduces the sequential algorithm exactly) ------------
     sample = None          # (S, C) float64
     n = 0
     for start, chunk in _iter_chunks(path, delim, header, chunk_rows):
+        b = chunk.shape[0]
         if sample is None:
             sample = np.empty((sample_cnt, chunk.shape[1]), np.float64)
-        for r in range(chunk.shape[0]):
-            if n < sample_cnt:
-                sample[n] = chunk[r]
-            else:
-                j = rng.randint(0, n + 1)
-                if j < sample_cnt:
-                    sample[j] = chunk[r]
-            n += 1
+        take = min(max(sample_cnt - n, 0), b)
+        if take:
+            sample[n:n + take] = chunk[:take]
+        if take < b:
+            pos = np.arange(n + take, n + b, dtype=np.int64)
+            j = (rng.random_sample(b - take) * (pos + 1)).astype(np.int64)
+            hit = j < sample_cnt
+            sample[j[hit]] = chunk[take:][hit]
+        n += b
     if n == 0:
         raise ValueError(f"data file is empty: {path}")
     sample = sample[:min(n, sample_cnt)]
@@ -110,24 +115,14 @@ def load_two_round(path: str, config, label_column: int = 0,
 
     # ---- mappers from the sample (the one shared find-bin recipe) -----
     feature_names = [f"Column_{i}" for i in range(nf)]
-    cat_idx = set()
-    for c in (categorical_feature or config.categorical_feature or []):
-        if isinstance(c, str):
-            if c.startswith("name:"):
-                c = c[5:]
-            if c in feature_names:
-                cat_idx.add(feature_names.index(c))
-        else:
-            cat_idx.add(int(c))
+    cat_idx = resolve_categorical_set(
+        categorical_feature or config.categorical_feature, feature_names)
     forced_bounds = load_forced_bounds(config.forcedbins_filename)
     ignore = resolve_ignore_set(config.ignore_column, feature_names)
     mappers = []
     for j, c in enumerate(feat_of):
         if j in ignore:
-            m = BinMapper()
-            m.is_trivial = True
-            m.num_bin = 1
-            mappers.append(m)
+            mappers.append(BinMapper.trivial())
             continue
         mappers.append(mapper_from_sample_column(
             sample[:, c], sample.shape[0], config, j, cat_idx,

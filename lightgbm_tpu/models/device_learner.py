@@ -2211,10 +2211,12 @@ class DeviceTreeLearner:
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
             return score_row + delta, rec, rec_cat, leaf_id, k
 
-        codes_args = ((self.codes_pack, self.codes_row) if use_compact
-                      else (self.codes_t, self.codes_t))
-
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
+            # read self.codes_* at CALL time like the DP/FP wrappers, so
+            # a rebuilt code buffer is never silently shadowed by a
+            # stale snapshot
+            codes_args = ((self.codes_pack, self.codes_row) if use_compact
+                          else (self.codes_t, self.codes_t))
             return step_impl(*codes_args, score_row, base_mask, tree_key,
                              bag_key, shrinkage)
 

@@ -73,3 +73,19 @@ def test_two_round_loads_side_files(tmp_path):
     got_w = ds._inner.metadata.weight
     np.testing.assert_allclose(got_w, w, rtol=1e-5)
     assert ds._inner.metadata.query_boundaries is not None
+
+
+def test_two_round_sampled_reservoir(tmp_path):
+    # n > bin_construct_sample_cnt engages the vectorized reservoir
+    # (Algorithm R) across chunk boundaries; sampling differs from the
+    # in-memory loader so assert structural sanity, not equality
+    path, x, y = _write_csv(tmp_path, n=3000)
+    cfg = Config({"objective": "binary", "verbosity": -1,
+                  "bin_construct_sample_cnt": 500})
+    ds, label = load_two_round(path, cfg, chunk_rows=800)
+    assert ds.num_data == 3000
+    np.testing.assert_array_equal(label, y)
+    for j, f in enumerate(ds.used_features):
+        m = ds.bin_mappers[f]
+        assert 1 < m.num_bin <= 256
+        assert int(ds.binned[:, j].max()) < m.num_bin
