@@ -38,6 +38,7 @@ payload. Forced splits and CEGB fall back to the host-loop learner
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import NamedTuple, Optional
 
@@ -1606,6 +1607,37 @@ def packed_go_left(win: jax.Array, feat, thr, dleft,
     return jnp.where(f_categorical[feat] != 0, cat_left, num_left)
 
 
+def objective_buffer_names(objective):
+    """Names of the objective's device buffers (label, weights,
+    transformed labels, lambdarank's segment tensors ...) read inside
+    get_gradients. The fused steps pass these as jit ARGUMENTS via a
+    trace-time attribute swap so they lower as parameters instead of HLO
+    constants — the same payload/cache argument as the code buffers.
+    Objectives declare them via device_buffer_names(); the per-row
+    heuristic remains for duck-typed custom objectives."""
+    fn = getattr(objective, "device_buffer_names", None)
+    if fn is not None:
+        return list(fn())
+    n = getattr(objective, "num_data", None)
+    if not n:
+        return []
+    return sorted(
+        k for k, v in vars(objective).items()
+        if isinstance(v, jax.Array) and v.ndim >= 1 and v.shape[0] == n)
+
+
+@contextlib.contextmanager
+def swapped_attrs(obj, names, values):
+    saved = [getattr(obj, k) for k in names]
+    for k, v in zip(names, values):
+        setattr(obj, k, v)
+    try:
+        yield
+    finally:
+        for k, v in zip(names, saved):
+            setattr(obj, k, v)
+
+
 def exact_k_bag_weights(bag_key: jax.Array, n: int, bag_k: int) -> jax.Array:
     """0/1 weight vector with exactly bag_k ones, deterministic per key
     (reference Bagging, gbdt.cpp:210-276)."""
@@ -2156,17 +2188,21 @@ class DeviceTreeLearner:
         else:
             grow, grow_kw = grow_tree, {}
 
+        obj_keys = objective_buffer_names(objective)
+
         @jax.jit
-        def step_impl(codes_pack, codes_row, score_row, base_mask,
-                      tree_key, bag_key, shrinkage):
-            # the code buffers are explicit ARGUMENTS, not closure
-            # captures: closed-over device arrays lower as HLO constants,
-            # which baked the whole binned dataset into the program
-            # (~112 MB of StableHLO at 1M x 28 vs 8 MB with args) —
-            # bloating the remote-compile payload and keying the
-            # persistent compile cache on the dataset bytes instead of
-            # just shapes. Masked strategy passes (codes_t, codes_t).
-            g, h = objective.get_gradients(score_row)
+        def step_impl(codes_pack, codes_row, obj_bufs, score_row,
+                      base_mask, tree_key, bag_key, shrinkage):
+            # the code buffers (and the objective's per-row buffers) are
+            # explicit ARGUMENTS, not closure captures: closed-over
+            # device arrays lower as HLO constants, which baked the
+            # whole binned dataset into the program (~112 MB of
+            # StableHLO at 1M x 28 vs 8 MB with args) — bloating the
+            # remote-compile payload and keying the persistent compile
+            # cache on the dataset bytes instead of just shapes. Masked
+            # strategy passes (codes_t, codes_t).
+            with swapped_attrs(objective, obj_keys, obj_bufs):
+                g, h = objective.get_gradients(score_row)
             bag_idx = oob_idx = None
             if goss is not None:
                 g, h, w, bag_idx, oob_idx = goss_sample(
@@ -2217,8 +2253,9 @@ class DeviceTreeLearner:
             # stale snapshot
             codes_args = ((self.codes_pack, self.codes_row) if use_compact
                           else (self.codes_t, self.codes_t))
-            return step_impl(*codes_args, score_row, base_mask, tree_key,
-                             bag_key, shrinkage)
+            obj_bufs = tuple(getattr(objective, k) for k in obj_keys)
+            return step_impl(*codes_args, obj_bufs, score_row, base_mask,
+                             tree_key, bag_key, shrinkage)
 
         return step
 

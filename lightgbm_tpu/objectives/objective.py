@@ -86,6 +86,18 @@ class Objective:
     def get_gradients(self, score: jax.Array):
         raise NotImplementedError
 
+    def device_buffer_names(self):
+        """Attribute names of the device buffers get_gradients reads.
+        The fused training step passes these as jit ARGUMENTS (via a
+        trace-time attribute swap) so they lower as parameters instead
+        of per-dataset HLO constants — see device_learner
+        objective_buffer_names. Default: every nontrivial device array
+        attribute (covers label/weight/transformed-label vectors AND
+        shaped buffers like lambdarank's (Q, L) segment tensors)."""
+        return sorted(
+            k for k, v in vars(self).items()
+            if isinstance(v, jax.Array) and v.ndim >= 1 and v.size >= 256)
+
     def boost_from_score(self, class_id: int) -> float:
         return 0.0
 
@@ -677,6 +689,13 @@ class LambdarankNDCG(Objective):
         return grad, hess
 
     def get_gradients(self, score):
+        import jax.core as _core
+        if isinstance(score, _core.Tracer):
+            # already under a jit trace (the fused step): call the impl
+            # directly so the swapped buffer tracers flow through —
+            # dispatching into the cached inner jit would splice its
+            # previously-traced jaxpr with the buffers as constants
+            return self._gradients_impl(score)
         return self._grad_fn(score)
 
 

@@ -40,7 +40,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..io.dataset import Dataset
-from ..models.device_learner import DeviceTreeLearner, padded_shard_cols
+from ..models.device_learner import (DeviceTreeLearner,
+                                     objective_buffer_names,
+                                     padded_shard_cols, swapped_attrs)
 from ..models.serial_learner import SerialTreeLearner, _bucket, _MIN_BUCKET
 from ..models.tree import Tree
 from ..ops import histogram as hist_ops
@@ -726,12 +728,15 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
 
         has_cat = self._has_cat
 
+        obj_keys = objective_buffer_names(objective)
+
         @jax.jit
-        def step_impl(codes_pack, codes_row, score_row, base_mask,
-                      tree_key, bag_key, shrinkage):
-            # codes as args, not closure constants — see the serial
-            # make_fused_step note (program-size / compile payload)
-            g, h = objective.get_gradients(score_row)
+        def step_impl(codes_pack, codes_row, obj_bufs, score_row,
+                      base_mask, tree_key, bag_key, shrinkage):
+            # codes + objective buffers as args, not closure constants —
+            # see the serial make_fused_step note (compile payload)
+            with swapped_attrs(objective, obj_keys, obj_bufs):
+                g, h = objective.get_gradients(score_row)
             g = jnp.pad(g, (0, npad - n))
             h = jnp.pad(h, (0, npad - n))
             rec, rec_cat, leaf_id_pad, k, _ = fn(
@@ -744,8 +749,10 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                     leaf_id, k)
 
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
-            return step_impl(self.codes_pack, self.codes_row, score_row,
-                             base_mask, tree_key, bag_key, shrinkage)
+            obj_bufs = tuple(getattr(objective, k) for k in obj_keys)
+            return step_impl(self.codes_pack, self.codes_row, obj_bufs,
+                             score_row, base_mask, tree_key, bag_key,
+                             shrinkage)
 
         return step
 
@@ -879,12 +886,15 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
 
         has_cat = self._has_cat
 
+        obj_keys = objective_buffer_names(objective)
+
         @jax.jit
-        def step_impl(codes_pack, codes_row, score_row, base_mask,
-                      tree_key, bag_key, shrinkage):
-            # codes as args, not closure constants — see the serial
-            # make_fused_step note (program-size / compile payload)
-            g, h = objective.get_gradients(score_row)
+        def step_impl(codes_pack, codes_row, obj_bufs, score_row,
+                      base_mask, tree_key, bag_key, shrinkage):
+            # codes + objective buffers as args, not closure constants —
+            # see the serial make_fused_step note (compile payload)
+            with swapped_attrs(objective, obj_keys, obj_bufs):
+                g, h = objective.get_gradients(score_row)
             if goss is not None:
                 from ..models.device_learner import goss_sample
                 g, h, w, _, _ = goss_sample(
@@ -902,8 +912,10 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                     leaf_id, k)
 
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
-            return step_impl(self.codes_pack, self.codes_row, score_row,
-                             base_mask, tree_key, bag_key, shrinkage)
+            obj_bufs = tuple(getattr(objective, k) for k in obj_keys)
+            return step_impl(self.codes_pack, self.codes_row, obj_bufs,
+                             score_row, base_mask, tree_key, bag_key,
+                             shrinkage)
 
         return step
 
