@@ -2193,14 +2193,16 @@ class DeviceTreeLearner:
         @jax.jit
         def step_impl(codes_pack, codes_row, obj_bufs, score_row,
                       base_mask, tree_key, bag_key, shrinkage):
-            # the code buffers (and the objective's per-row buffers) are
+            # the code buffers (and the objective's device buffers) are
             # explicit ARGUMENTS, not closure captures: closed-over
             # device arrays lower as HLO constants, which baked the
-            # whole binned dataset into the program (~112 MB of
-            # StableHLO at 1M x 28 vs 8 MB with args) — bloating the
+            # whole dataset into the program — 120.5 MB of StableHLO at
+            # 1M x 28 x 255 (codes ~112 MB + objective vectors ~8 MB)
+            # vs 0.24 MB with everything as args — bloating the
             # remote-compile payload and keying the persistent compile
             # cache on the dataset bytes instead of just shapes. Masked
             # strategy passes (codes_t, codes_t).
+            # tests/test_program_size.py pins the property.
             with swapped_attrs(objective, obj_keys, obj_bufs):
                 g, h = objective.get_gradients(score_row)
             bag_idx = oob_idx = None
@@ -2257,6 +2259,9 @@ class DeviceTreeLearner:
             return step_impl(*codes_args, obj_bufs, score_row, base_mask,
                              tree_key, bag_key, shrinkage)
 
+        # contract surface for tests/tools (program-size pinning)
+        step.impl = step_impl
+        step.obj_keys = obj_keys
         return step
 
     # ------------------------------------------------------------------

@@ -754,6 +754,9 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                              score_row, base_mask, tree_key, bag_key,
                              shrinkage)
 
+        # contract surface for tests/tools (program-size pinning)
+        step.impl = step_impl
+        step.obj_keys = obj_keys
         return step
 
 
@@ -917,6 +920,9 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                              score_row, base_mask, tree_key, bag_key,
                              shrinkage)
 
+        # contract surface for tests/tools (program-size pinning)
+        step.impl = step_impl
+        step.obj_keys = obj_keys
         return step
 
 

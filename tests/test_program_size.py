@@ -1,0 +1,77 @@
+"""The fused training program must stay shape-keyed.
+
+Round-4 finding: closed-over device arrays lower as HLO constants, so a
+fused step that captures the code buffers or the objective's label
+vectors bakes the DATASET into the program (120.5 MB of StableHLO at
+1M x 28 before the fix, 0.24 MB after). This test pins the property by
+lowering the real fused step at a moderate shape and bounding the
+module size — any regression that re-embeds an (N,)-sized buffer blows
+the bound by an order of magnitude.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.models.device_learner import (DeviceTreeLearner,
+                                                objective_buffer_names)
+from lightgbm_tpu.objectives.objective import create_objective
+
+
+def _lowered_size(objective_name, n=100_000, f=10, **meta):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, f).astype(np.float32)
+    y = (meta.pop("label_fn", lambda v: (v[:, 0] > 0).astype(np.float64)))(x)
+    cfg = Config({"objective": objective_name, "num_leaves": 31,
+                  "verbosity": -1})
+    ds = Dataset(x, config=cfg, label=y)
+    group = meta.pop("group", None)
+    if group is not None:
+        ds.metadata.set_group(group)
+    lrn = DeviceTreeLearner(cfg, ds, strategy="chunk")
+    obj = create_objective(objective_name, cfg)
+    obj.init(ds.metadata, n)
+    step = lrn.make_fused_step(obj)
+    keys = step.obj_keys
+    bufs = tuple(getattr(obj, k) for k in keys)
+    low = step.impl.lower(lrn.codes_pack, lrn.codes_row, bufs,
+                       jnp.zeros((n,), jnp.float32),
+                       jnp.ones((f,), bool), jax.random.PRNGKey(0),
+                       jax.random.PRNGKey(1), jnp.float32(0.1))
+    return len(low.as_text()), keys
+
+
+def test_binary_fused_program_has_no_dataset_constants():
+    size, keys = _lowered_size("binary")
+    # n=100k: one embedded f32 row vector alone would add ~0.8 MB of
+    # hex text on top of the ~0.2 MB clean program, so the bound must
+    # sit BELOW clean + one embedded vector
+    assert size < 600_000, f"fused program grew to {size/1e6:.2f} MB"
+    assert "_label_dev" in keys and "_signed_label" in keys
+
+
+def test_lambdarank_fused_program_has_no_dataset_constants():
+    n = 50_000
+    size, keys = _lowered_size(
+        "lambdarank", n=n,
+        label_fn=lambda v: np.clip(v[:, 0].round() + 1, 0, 3),
+        group=np.full(n // 50, 50))
+    # n=50k: one embedded f32 vector adds ~0.4 MB over the ~0.25 MB
+    # clean program
+    assert size < 500_000, f"fused program grew to {size/1e6:.2f} MB"
+    assert "_idx" in keys and "_labels_pad" in keys
+
+
+def test_objective_buffer_names_cover_per_row_arrays():
+    rng = np.random.RandomState(1)
+    n = 2000
+    x = rng.randn(n, 5).astype(np.float32)
+    y = np.abs(x[:, 0])
+    cfg = Config({"objective": "regression", "verbosity": -1})
+    ds = Dataset(x, config=cfg, label=y,
+                 weight=np.linspace(0.5, 1.5, n))
+    obj = create_objective("regression", cfg)
+    obj.init(ds.metadata, n)
+    names = objective_buffer_names(obj)
+    assert "_label_dev" in names and "_weight_dev" in names
