@@ -18,11 +18,17 @@
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <cstdarg>
+#include <condition_variable>
+#include <deque>
 #include <functional>
 #include <mutex>
 #include <string>
+#include <thread>
+
+#include <unistd.h>
 #include <vector>
 
 // LGBM_API and the handle typedefs come from c_api.h; including the
@@ -905,11 +911,225 @@ LGBM_API int LGBM_BoosterPredictForCSR(BoosterHandle handle,
                         out_result);
 }
 
+namespace {
+
+// ---------------------------------------------------------------------------
+// Concurrent single-row prediction dispatcher.
+//
+// The reference serializes booster *mutation* only and lets concurrent
+// readers run OMP-parallel (reference: src/c_api.cpp:98 — the lock scope
+// around Boosting ends before Predict). Here the engine is the embedded
+// Python/JAX runtime: every call into it must hold the GIL, so naive
+// concurrent single-row predicts from host threads (Java/Spark scoring,
+// R parallel predict) would serialize on the interpreter, one full
+// interpreter round-trip per row. Instead of queueing callers on the
+// GIL, single-row predicts enqueue GIL-free into this dispatcher; a
+// worker thread coalesces every waiting request with an identical
+// (booster, dtype, ncol, predict params) signature into ONE vectorized
+// k-row predict and scatters the per-row results back. Concurrency
+// becomes batching: k threads pay ~one interpreter round-trip instead
+// of k, so aggregate throughput *rises* with caller concurrency.
+// Disable with LGBM_TPU_PREDICT_BATCH=0 (falls back to the direct,
+// GIL-serialized path).
+struct PredictReq {
+  intptr_t handle = 0;
+  std::vector<char> row;  // one densified row in the staging dtype
+  int data_type = 1;      // 0 = float32, 1 = float64
+  int ncol = 0;
+  int predict_type = 0;
+  int num_iteration = -1;
+  std::string param;
+  double* out = nullptr;
+  int64_t* out_len = nullptr;
+  int rc = 0;
+  bool done = false;
+  std::string err;
+};
+
+class PredictDispatcher {
+ public:
+  static PredictDispatcher& Get() {
+    static PredictDispatcher* d = new PredictDispatcher();  // leak on purpose:
+    return *d;  // outlives any caller; worker thread is detached
+  }
+
+  int Submit(PredictReq* req) {
+    std::unique_lock<std::mutex> lk(mu_);
+    // fork() kills the (detached) worker thread but not the latched
+    // flag: a child inheriting worker_started_=true would enqueue and
+    // wait forever. Re-spawn per-pid; inherited queue entries are the
+    // parent's stack pointers, dead in this process — drop them.
+    if (worker_started_ && worker_pid_ != getpid()) {
+      worker_started_ = false;
+      queue_.clear();
+    }
+    if (!worker_started_) {
+      worker_started_ = true;
+      worker_pid_ = getpid();
+      std::thread([this] { Run(); }).detach();
+    }
+    queue_.push_back(req);
+    cv_work_.notify_one();
+    cv_done_.wait(lk, [req] { return req->done; });
+    n_reqs_ += 1;
+    // the worker's error lands in ITS thread-local g_last_error; copy it
+    // into the caller's so LGBM_GetLastError works from this thread
+    if (req->rc != 0 && !req->err.empty()) SetError(req->err);
+    return req->rc;
+  }
+
+  void Stats(int64_t* reqs, int64_t* batches, int64_t* max_batch) {
+    std::lock_guard<std::mutex> lk(mu_);
+    *reqs = n_reqs_;
+    *batches = n_batches_;
+    *max_batch = max_batch_;
+  }
+
+ private:
+  static bool SameBatch(const PredictReq* a, const PredictReq* b) {
+    return a->handle == b->handle && a->data_type == b->data_type &&
+           a->ncol == b->ncol && a->predict_type == b->predict_type &&
+           a->num_iteration == b->num_iteration && a->param == b->param;
+  }
+
+  void Run() {
+    for (;;) {
+      std::vector<PredictReq*> batch;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_work_.wait(lk, [this] { return !queue_.empty(); });
+        // take the front request plus every queued request it can batch
+        // with; the rest keep their order (no starvation: the next
+        // round starts from the first unmatched request)
+        PredictReq* front = queue_.front();
+        std::deque<PredictReq*> rest;
+        for (PredictReq* r : queue_) {
+          (SameBatch(front, r) ? (void)batch.push_back(r)
+                               : (void)rest.push_back(r));
+        }
+        queue_.swap(rest);
+        n_batches_ += 1;
+        if (static_cast<int64_t>(batch.size()) > max_batch_)
+          max_batch_ = static_cast<int64_t>(batch.size());
+      }
+      ExecBatch(batch);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        for (PredictReq* r : batch) r->done = true;
+      }
+      cv_done_.notify_all();
+    }
+  }
+
+  void ExecBatch(std::vector<PredictReq*>& batch) {
+    PredictReq* f = batch.front();
+    const size_t rowb = static_cast<size_t>(f->ncol) * DtypeSize(f->data_type);
+    std::vector<char> dense(batch.size() * rowb);
+    for (size_t i = 0; i < batch.size(); ++i)
+      std::memcpy(dense.data() + i * rowb, batch[i]->row.data(), rowb);
+    Gil gil;
+    PyObject* args = Py_BuildValue(
+        "(LNiiiiiis)", (long long)f->handle,
+        MemView(dense.data(), static_cast<Py_ssize_t>(dense.size())),
+        f->data_type, static_cast<int>(batch.size()), f->ncol,
+        /*is_row_major=*/1, f->predict_type, f->num_iteration,
+        f->param.c_str());
+    PyObject* r = Call("booster_predict_for_mat", args);
+    char* buf = nullptr;
+    Py_ssize_t nbytes = 0;
+    if (r && PyBytes_AsStringAndSize(r, &buf, &nbytes) != 0) {
+      CheckPyErr();
+      Py_DECREF(r);
+      r = nullptr;
+    }
+    if (!r) {
+      for (PredictReq* q : batch) {
+        q->rc = -1;
+        q->err = g_last_error;  // worker TLS; Submit republishes it
+      }
+      return;
+    }
+    // every row yields the same number of doubles (same model + params)
+    const int64_t per = nbytes / 8 / static_cast<int64_t>(batch.size());
+    for (size_t i = 0; i < batch.size(); ++i) {
+      std::memcpy(batch[i]->out, buf + i * per * 8,
+                  static_cast<size_t>(per) * 8);
+      *batch[i]->out_len = per;
+    }
+    Py_DECREF(r);
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_done_;
+  std::deque<PredictReq*> queue_;
+  bool worker_started_ = false;
+  pid_t worker_pid_ = -1;
+  int64_t n_reqs_ = 0, n_batches_ = 0, max_batch_ = 0;
+};
+
+bool DispatchEnabled() {
+  static const int enabled = [] {
+    const char* e = std::getenv("LGBM_TPU_PREDICT_BATCH");
+    return (e && std::string(e) == "0") ? 0 : 1;
+  }();
+  if (!enabled) return false;
+  // A caller that already holds the GIL (embedded host on its main
+  // thread, ctypes.PyDLL) would deadlock the dispatcher: it parks on
+  // cv_done_ holding the GIL the worker needs. The direct path's
+  // PyGILState_Ensure is re-entrant — send GIL holders there.
+  if (Py_IsInitialized() && PyGILState_Check()) return false;
+  return true;
+}
+
+}  // namespace
+
+// Extension beyond the reference ABI: dispatcher observability, so tests
+// (and operators) can assert concurrent predicts really batched instead
+// of serializing one-by-one.
+LGBM_API int LGBM_TPU_PredictDispatchStats(int64_t* out_reqs,
+                                           int64_t* out_batches,
+                                           int64_t* out_max_batch) {
+  PredictDispatcher::Get().Stats(out_reqs, out_batches, out_max_batch);
+  return 0;
+}
+
 LGBM_API int LGBM_BoosterPredictForCSRSingleRow(
     BoosterHandle handle, const void* indptr, int indptr_type,
     const int32_t* indices, const void* data, int data_type, int64_t nindptr,
     int64_t nelem, int64_t num_col, int predict_type, int num_iteration,
     const char* parameter, int64_t* out_len, double* out_result) {
+  // densify-to-zeros is exactly the CSR semantic (missing entries are
+  // 0.0, capi_impl._csr_view -> toarray), so a single CSR row can ride
+  // the batching dispatcher as a dense float64 row. Very wide rows
+  // (> 1M cols = 8 MB staging each) keep the direct sparse path.
+  if (DispatchEnabled() && nindptr == 2 && num_col > 0 &&
+      num_col <= (int64_t(1) << 20)) {
+    PredictReq req;
+    req.handle = reinterpret_cast<intptr_t>(handle);
+    req.row.assign(static_cast<size_t>(num_col) * 8, 0);
+    double* drow = reinterpret_cast<double*>(req.row.data());
+    const int64_t lo = indptr_type == 2
+                           ? static_cast<const int32_t*>(indptr)[0]
+                           : static_cast<const int64_t*>(indptr)[0];
+    const int64_t hi = indptr_type == 2
+                           ? static_cast<const int32_t*>(indptr)[1]
+                           : static_cast<const int64_t*>(indptr)[1];
+    for (int64_t e = lo; e < hi && e < nelem; ++e) {
+      const int32_t j = indices[e];
+      if (j < 0 || j >= num_col) continue;
+      drow[j] = data_type == 0
+                    ? static_cast<double>(static_cast<const float*>(data)[e])
+                    : static_cast<const double*>(data)[e];
+    }
+    req.data_type = 1;
+    req.ncol = static_cast<int>(num_col);
+    req.predict_type = predict_type;
+    req.num_iteration = num_iteration;
+    req.param = parameter ? parameter : "";
+    req.out = out_result;
+    req.out_len = out_len;
+    return PredictDispatcher::Get().Submit(&req);
+  }
   Gil gil;
   PyObject* args = Py_BuildValue(
       "(LNiNNiLLLiis)", (long long)(intptr_t)handle,
@@ -947,6 +1167,21 @@ LGBM_API int LGBM_BoosterPredictForMatSingleRow(
     BoosterHandle handle, const void* data, int data_type, int ncol,
     int is_row_major, int predict_type, int num_iteration,
     const char* parameter, int64_t* out_len, double* out_result) {
+  (void)is_row_major;  // a single row has one layout
+  if (DispatchEnabled() && ncol > 0) {
+    PredictReq req;
+    req.handle = reinterpret_cast<intptr_t>(handle);
+    const char* p = static_cast<const char*>(data);
+    req.row.assign(p, p + static_cast<size_t>(ncol) * DtypeSize(data_type));
+    req.data_type = data_type;
+    req.ncol = ncol;
+    req.predict_type = predict_type;
+    req.num_iteration = num_iteration;
+    req.param = parameter ? parameter : "";
+    req.out = out_result;
+    req.out_len = out_len;
+    return PredictDispatcher::Get().Submit(&req);
+  }
   Gil gil;
   PyObject* args = Py_BuildValue(
       "(LNiiiiis)", (long long)(intptr_t)handle,

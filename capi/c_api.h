@@ -244,6 +244,14 @@ LGBM_API int LGBM_BoosterPredictForCSC(BoosterHandle handle,
                                        int num_iteration,
                                        const char* parameter,
                                        int64_t* out_len, double* out_result);
+/* Extension beyond the reference ABI (not in LightGBM): stats of the
+ * concurrent single-row predict dispatcher — total requests, vectorized
+ * batches executed, and the largest batch. Concurrent SingleRow predict
+ * calls coalesce into one vectorized predict per batch (set
+ * LGBM_TPU_PREDICT_BATCH=0 to disable and serialize directly). */
+LGBM_API int LGBM_TPU_PredictDispatchStats(int64_t* out_reqs,
+                                           int64_t* out_batches,
+                                           int64_t* out_max_batch);
 
 /* ---- model export ------------------------------------------------------- */
 LGBM_API int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
