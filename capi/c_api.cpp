@@ -28,6 +28,7 @@
 #include <string>
 #include <thread>
 
+#include <pthread.h>
 #include <unistd.h>
 #include <vector>
 
@@ -949,8 +950,27 @@ struct PredictReq {
 class PredictDispatcher {
  public:
   static PredictDispatcher& Get() {
-    static PredictDispatcher* d = new PredictDispatcher();  // leak on purpose:
-    return *d;  // outlives any caller; worker thread is detached
+    static PredictDispatcher* d = [] {
+      auto* p = new PredictDispatcher();  // leaked on purpose: outlives
+      // any caller; worker thread is detached.
+      // fork() while the worker (or a Submit) holds mu_ would leave the
+      // child's mutex locked by a thread that no longer exists; the
+      // classic atfork protocol serializes fork against the lock and
+      // rebuilds child state from scratch.
+      instance_ = p;
+      pthread_atfork(
+          [] { instance_->mu_.lock(); },
+          [] { instance_->mu_.unlock(); },
+          [] {
+            new (&instance_->mu_) std::mutex();
+            new (&instance_->cv_work_) std::condition_variable();
+            new (&instance_->cv_done_) std::condition_variable();
+            instance_->queue_.clear();
+            instance_->worker_started_ = false;
+          });
+      return p;
+    }();
+    return *d;
   }
 
   int Submit(PredictReq* req) {
@@ -1022,6 +1042,23 @@ class PredictDispatcher {
   }
 
   void ExecBatch(std::vector<PredictReq*>& batch) {
+    if (ExecGroup(batch) || batch.size() == 1) return;
+    // a failing vectorized call must not fate-share: one request's
+    // error (or a transient failure only the k-row shape triggers)
+    // would otherwise poison every coalesced neighbor. Retry each
+    // request singly so exactly the guilty ones fail, like the
+    // serialized direct path.
+    for (PredictReq* q : batch) {
+      std::vector<PredictReq*> one{q};
+      q->rc = 0;
+      q->err.clear();
+      ExecGroup(one);
+    }
+  }
+
+  // Returns true on success; on failure marks every request in the
+  // group failed with the worker-thread error text.
+  bool ExecGroup(std::vector<PredictReq*>& batch) {
     PredictReq* f = batch.front();
     const size_t rowb = static_cast<size_t>(f->ncol) * DtypeSize(f->data_type);
     std::vector<char> dense(batch.size() * rowb);
@@ -1047,7 +1084,7 @@ class PredictDispatcher {
         q->rc = -1;
         q->err = g_last_error;  // worker TLS; Submit republishes it
       }
-      return;
+      return false;
     }
     // every row yields the same number of doubles (same model + params)
     const int64_t per = nbytes / 8 / static_cast<int64_t>(batch.size());
@@ -1057,6 +1094,7 @@ class PredictDispatcher {
       *batch[i]->out_len = per;
     }
     Py_DECREF(r);
+    return true;
   }
 
   std::mutex mu_;
@@ -1065,7 +1103,10 @@ class PredictDispatcher {
   bool worker_started_ = false;
   pid_t worker_pid_ = -1;
   int64_t n_reqs_ = 0, n_batches_ = 0, max_batch_ = 0;
+  static PredictDispatcher* instance_;
 };
+
+PredictDispatcher* PredictDispatcher::instance_ = nullptr;
 
 bool DispatchEnabled() {
   static const int enabled = [] {
@@ -1099,27 +1140,36 @@ LGBM_API int LGBM_BoosterPredictForCSRSingleRow(
     int64_t nelem, int64_t num_col, int predict_type, int num_iteration,
     const char* parameter, int64_t* out_len, double* out_result) {
   // densify-to-zeros is exactly the CSR semantic (missing entries are
-  // 0.0, capi_impl._csr_view -> toarray), so a single CSR row can ride
-  // the batching dispatcher as a dense float64 row. Very wide rows
-  // (> 1M cols = 8 MB staging each) keep the direct sparse path.
-  if (DispatchEnabled() && nindptr == 2 && num_col > 0 &&
-      num_col <= (int64_t(1) << 20)) {
+  // 0.0, capi_impl._csr_view -> toarray; duplicate indices SUM, as
+  // scipy's does), so a single CSR row can ride the batching dispatcher
+  // as a dense float64 row. Very wide rows (> 1M cols = 8 MB staging
+  // each) and malformed input (index out of range, indptr outside
+  // [0, nelem]) keep the direct sparse path — the latter so the error
+  // surfaces loudly there instead of being silently dropped here.
+  bool csr_ok = nindptr == 2 && num_col > 0 &&
+                num_col <= (int64_t(1) << 20);
+  const int64_t lo = !csr_ok ? 0
+                     : indptr_type == 2
+                         ? static_cast<const int32_t*>(indptr)[0]
+                         : static_cast<const int64_t*>(indptr)[0];
+  const int64_t hi = !csr_ok ? 0
+                     : indptr_type == 2
+                         ? static_cast<const int32_t*>(indptr)[1]
+                         : static_cast<const int64_t*>(indptr)[1];
+  if (csr_ok && (lo < 0 || hi < lo || hi > nelem)) csr_ok = false;
+  for (int64_t e = lo; csr_ok && e < hi; ++e) {
+    if (indices[e] < 0 || indices[e] >= num_col) csr_ok = false;
+  }
+  if (DispatchEnabled() && csr_ok) {
     PredictReq req;
     req.handle = reinterpret_cast<intptr_t>(handle);
     req.row.assign(static_cast<size_t>(num_col) * 8, 0);
     double* drow = reinterpret_cast<double*>(req.row.data());
-    const int64_t lo = indptr_type == 2
-                           ? static_cast<const int32_t*>(indptr)[0]
-                           : static_cast<const int64_t*>(indptr)[0];
-    const int64_t hi = indptr_type == 2
-                           ? static_cast<const int32_t*>(indptr)[1]
-                           : static_cast<const int64_t*>(indptr)[1];
-    for (int64_t e = lo; e < hi && e < nelem; ++e) {
-      const int32_t j = indices[e];
-      if (j < 0 || j >= num_col) continue;
-      drow[j] = data_type == 0
-                    ? static_cast<double>(static_cast<const float*>(data)[e])
-                    : static_cast<const double*>(data)[e];
+    for (int64_t e = lo; e < hi; ++e) {
+      drow[indices[e]] +=
+          data_type == 0
+              ? static_cast<double>(static_cast<const float*>(data)[e])
+              : static_cast<const double*>(data)[e];
     }
     req.data_type = 1;
     req.ncol = static_cast<int>(num_col);

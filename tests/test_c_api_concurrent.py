@@ -214,6 +214,64 @@ def test_concurrent_mixed_configs_and_error_isolation(lib, booster):
     assert not errors, errors[0]
 
 
+def test_fork_after_dispatch_respawns_worker(lib):
+    """fork() kills the dispatcher's worker thread; the child must
+    re-spawn it (per-pid latch + atfork mutex protocol) instead of
+    queueing forever. Fresh process so the fork happens with a live
+    dispatcher and nothing else."""
+    code = r"""
+import ctypes, os, sys, numpy as np
+lib = ctypes.CDLL(%r)
+lib.LGBM_GetLastError.restype = ctypes.c_char_p
+rng = np.random.RandomState(1)
+x = rng.randn(300, 6); y = (x[:, 0] > 0).astype(np.float32)
+xf = np.ascontiguousarray(x, dtype=np.float64)
+ds = ctypes.c_void_p()
+assert lib.LGBM_DatasetCreateFromMat(
+    xf.ctypes.data_as(ctypes.c_void_p), 1, 300, 6, 1, b"", None,
+    ctypes.byref(ds)) == 0
+assert lib.LGBM_DatasetSetField(
+    ds, b"label", y.ctypes.data_as(ctypes.c_void_p), 300, 0) == 0
+bst = ctypes.c_void_p()
+assert lib.LGBM_BoosterCreate(
+    ds, b"objective=binary num_leaves=7 verbosity=-1",
+    ctypes.byref(bst)) == 0
+fin = ctypes.c_int()
+for _ in range(3):
+    assert lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+def single_row(i):
+    out = (ctypes.c_double * 1)()
+    n = ctypes.c_int64()
+    row = np.ascontiguousarray(xf[i])
+    rc = lib.LGBM_BoosterPredictForMatSingleRow(
+        bst, row.ctypes.data_as(ctypes.c_void_p), 1, 6, 1, 0, -1, b"",
+        ctypes.byref(n), out)
+    assert rc == 0, lib.LGBM_GetLastError()
+    return out[0]
+
+before = single_row(5)          # spawns the dispatcher worker
+pid = os.fork()
+if pid == 0:                    # child: worker thread did not survive
+    try:
+        assert abs(single_row(5) - before) < 1e-12
+        os._exit(0)
+    except BaseException:
+        os._exit(1)
+_, status = os.waitpid(pid, 0)
+assert status == 0, f"child failed: {status}"
+assert abs(single_row(5) - before) < 1e-12   # parent still fine
+print("OK")
+"""
+    code = code % LIB_PATH
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(["python", "-c", code], capture_output=True,
+                       text=True, timeout=600, env=env)
+    assert r.returncode == 0, (r.stdout[-500:], r.stderr[-2000:])
+    assert "OK" in r.stdout
+
+
 def test_dispatch_disabled_fallback(lib):
     """LGBM_TPU_PREDICT_BATCH=0 must take the direct path (fresh process:
     the env is latched at first predict)."""
