@@ -1,0 +1,314 @@
+// Mock R runtime: concrete implementations of the R C API subset that
+// R-package/src/lightgbm_tpu_R.cpp uses, faithful enough to EXECUTE the
+// .Call glue without an R interpreter (none exists in this image).
+//
+// What real-R behaviors are modeled (the ones whose breakage would be
+// invisible to a syntax check):
+//   * SEXP allocation/typing: typed vectors with real payloads, so
+//     REAL()/INTEGER()/CHAR() marshalling runs against live memory;
+//   * PROTECT/UNPROTECT: a balance counter the test harness checks
+//     after every .Call — an unbalanced glue function fails the test
+//     exactly like R's "stack imbalance" warning;
+//   * Rf_error: longjmp out of the glue back to the harness (R's
+//     error mechanism), so CheckCall error paths are executable;
+//   * external pointers + R_RegisterCFinalizerEx: finalizers are
+//     recorded and can be fired by the harness like R's GC would,
+//     double-fire included (R_ClearExternalPtr contract);
+//   * .Call registration: the harness resolves entry points through
+//     R_registerRoutines' table, as R itself does.
+//
+// Built together with the real glue against tools/rstub headers and the
+// real capi/lib_lightgbm_tpu.so: make -C tools/rmock.
+#include <R.h>
+#include <Rinternals.h>
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <csetjmp>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int NILSXP = 0, CHARSXP = 9, EXTPTRSXP = 22;
+// LGLSXP/INTSXP/REALSXP/STRSXP/VECSXP come from the stub Rinternals.h
+
+struct MSEXP {
+  int type = NILSXP;
+  long len = 0;
+  std::vector<double> real;
+  std::vector<int> ints;
+  std::vector<MSEXP*> vec;   // STRSXP / VECSXP elements
+  std::string chars;         // CHARSXP payload
+  void* ext = nullptr;       // EXTPTRSXP address
+  void (*fin)(SEXP) = nullptr;
+  bool fin_on_exit = false;
+};
+
+MSEXP* M(SEXP s) { return reinterpret_cast<MSEXP*>(s); }
+SEXP S(MSEXP* m) { return reinterpret_cast<SEXP>(m); }
+
+MSEXP g_nil;  // the R_NilValue singleton
+
+int g_protect_depth = 0;
+int g_depth_floor = 0;      // set per-invoke; dipping below = underflow
+bool g_underflow = false;
+jmp_buf g_jmp;
+bool g_jmp_active = false;
+char g_error[2048];
+
+struct CallEntry {
+  std::string name;
+  void* fun;
+  int nargs;
+};
+std::vector<CallEntry> g_entries;
+
+MSEXP* NewSexp(int type, long len) {
+  MSEXP* m = new MSEXP();  // leaked: the harness process is short-lived
+  m->type = type;
+  m->len = len;
+  switch (type) {
+    case REALSXP: m->real.resize(len); break;
+    case INTSXP:
+    case LGLSXP: m->ints.resize(len); break;
+    case STRSXP:
+    case VECSXP: m->vec.resize(len, &g_nil); break;
+    default: break;
+  }
+  return m;
+}
+
+}  // namespace
+
+extern "C" {
+
+SEXP R_NilValue = S(&g_nil);
+
+// ---- allocation / scalars ------------------------------------------------
+SEXP Rf_allocVector(unsigned type, long len) {
+  return S(NewSexp(static_cast<int>(type), len));
+}
+SEXP Rf_mkChar(const char* s) {
+  MSEXP* m = NewSexp(CHARSXP, 0);
+  m->chars = s ? s : "";
+  return S(m);
+}
+SEXP Rf_mkString(const char* s) {
+  MSEXP* m = NewSexp(STRSXP, 1);
+  m->vec[0] = M(Rf_mkChar(s));
+  return S(m);
+}
+SEXP Rf_ScalarInteger(int v) {
+  MSEXP* m = NewSexp(INTSXP, 1);
+  m->ints[0] = v;
+  return S(m);
+}
+SEXP Rf_ScalarReal(double v) {
+  MSEXP* m = NewSexp(REALSXP, 1);
+  m->real[0] = v;
+  return S(m);
+}
+SEXP Rf_ScalarLogical(int v) {
+  MSEXP* m = NewSexp(LGLSXP, 1);
+  m->ints[0] = v;
+  return S(m);
+}
+
+// ---- accessors -----------------------------------------------------------
+double* REAL(SEXP s) { return M(s)->real.data(); }
+int* INTEGER(SEXP s) { return M(s)->ints.data(); }
+int* LOGICAL(SEXP s) { return M(s)->ints.data(); }
+const char* CHAR(SEXP s) { return M(s)->chars.c_str(); }
+SEXP STRING_ELT(SEXP s, long i) { return S(M(s)->vec[i]); }
+void SET_STRING_ELT(SEXP s, long i, SEXP v) { M(s)->vec[i] = M(v); }
+SEXP VECTOR_ELT(SEXP s, long i) { return S(M(s)->vec[i]); }
+void SET_VECTOR_ELT(SEXP s, long i, SEXP v) { M(s)->vec[i] = M(v); }
+long Rf_length(SEXP s) { return M(s)->len; }
+long Rf_xlength(SEXP s) { return M(s)->len; }
+int TYPEOF(SEXP s) { return M(s)->type; }
+int Rf_isNull(SEXP s) { return M(s) == &g_nil; }
+
+int Rf_asInteger(SEXP s) {
+  MSEXP* m = M(s);
+  if (m->type == INTSXP || m->type == LGLSXP) return m->ints[0];
+  if (m->type == REALSXP) return static_cast<int>(m->real[0]);
+  Rf_error("rmock: asInteger on type %d", m->type);
+  return 0;
+}
+double Rf_asReal(SEXP s) {
+  MSEXP* m = M(s);
+  if (m->type == REALSXP) return m->real[0];
+  if (m->type == INTSXP || m->type == LGLSXP) return m->ints[0];
+  Rf_error("rmock: asReal on type %d", m->type);
+  return 0;
+}
+SEXP Rf_asChar(SEXP s) {
+  MSEXP* m = M(s);
+  if (m->type == CHARSXP) return s;
+  if (m->type == STRSXP && m->len >= 1) return S(m->vec[0]);
+  Rf_error("rmock: asChar on type %d", m->type);
+  return R_NilValue;
+}
+
+// ---- protection ----------------------------------------------------------
+SEXP Rf_protect(SEXP s) {
+  ++g_protect_depth;
+  return s;
+}
+void Rf_unprotect(int n) {
+  g_protect_depth -= n;
+  // real R: "unprotect: only X protected items" — a glue that over-
+  // unprotects then re-protects nets to zero, so the final-depth check
+  // alone would miss it
+  if (g_protect_depth < g_depth_floor) g_underflow = true;
+}
+
+// ---- error ---------------------------------------------------------------
+void Rf_error(const char* fmt, ...) {
+  va_list va;
+  va_start(va, fmt);
+  vsnprintf(g_error, sizeof(g_error), fmt, va);
+  va_end(va);
+  if (g_jmp_active) longjmp(g_jmp, 1);
+  fprintf(stderr, "rmock: Rf_error outside invoke: %s\n", g_error);
+  abort();
+}
+
+// ---- external pointers ---------------------------------------------------
+SEXP R_MakeExternalPtr(void* p, SEXP, SEXP) {
+  MSEXP* m = NewSexp(EXTPTRSXP, 1);
+  m->ext = p;
+  return S(m);
+}
+void* R_ExternalPtrAddr(SEXP s) { return M(s)->ext; }
+void R_ClearExternalPtr(SEXP s) { M(s)->ext = nullptr; }
+void R_RegisterCFinalizerEx(SEXP s, R_CFinalizer_t fin, int on_exit) {
+  M(s)->fin = fin;
+  M(s)->fin_on_exit = on_exit != 0;
+}
+
+// ---- registration --------------------------------------------------------
+int R_registerRoutines(DllInfo*, const void*, const R_CallMethodDef* call,
+                       const void*, const void*) {
+  for (const R_CallMethodDef* e = call; e && e->name; ++e)
+    g_entries.push_back({e->name, e->fun, e->numArgs});
+  return 0;
+}
+int R_useDynamicSymbols(DllInfo*, int) { return 0; }
+
+// the real glue's init entry (defined in lightgbm_tpu_R.cpp)
+void R_init_lightgbm_tpu(DllInfo* dll);
+
+// ==========================================================================
+// Harness surface (consumed by tests/test_r_glue_exec.py via ctypes)
+// ==========================================================================
+int rmock_init() {
+  g_entries.clear();
+  R_init_lightgbm_tpu(nullptr);
+  return static_cast<int>(g_entries.size());
+}
+
+const char* rmock_entry_name(int i) {
+  return i >= 0 && i < static_cast<int>(g_entries.size())
+             ? g_entries[i].name.c_str()
+             : nullptr;
+}
+int rmock_entry_nargs(int i) {
+  return i >= 0 && i < static_cast<int>(g_entries.size())
+             ? g_entries[i].nargs
+             : -1;
+}
+
+SEXP rmock_nil() { return R_NilValue; }
+SEXP rmock_real_vector(const double* v, long n) {
+  SEXP s = Rf_allocVector(REALSXP, n);
+  std::memcpy(REAL(s), v, n * sizeof(double));
+  return s;
+}
+SEXP rmock_int_vector(const int* v, long n) {
+  SEXP s = Rf_allocVector(INTSXP, n);
+  std::memcpy(INTEGER(s), v, n * sizeof(int));
+  return s;
+}
+SEXP rmock_scalar_int(int v) { return Rf_ScalarInteger(v); }
+SEXP rmock_string(const char* s) { return Rf_mkString(s); }
+
+int rmock_type(SEXP s) { return TYPEOF(s); }
+long rmock_len(SEXP s) { return Rf_length(s); }
+double* rmock_real_ptr(SEXP s) { return REAL(s); }
+int* rmock_int_ptr(SEXP s) { return INTEGER(s); }
+const char* rmock_string_elt(SEXP s, long i) {
+  return CHAR(STRING_ELT(s, i));
+}
+void* rmock_extptr_addr(SEXP s) { return R_ExternalPtrAddr(s); }
+const char* rmock_last_error() { return g_error; }
+int rmock_protect_depth() { return g_protect_depth; }
+
+// Fire an external pointer's finalizer the way R's GC would.
+int rmock_run_finalizer(SEXP s) {
+  MSEXP* m = M(s);
+  if (m->type != EXTPTRSXP || !m->fin) return -1;
+  m->fin(s);
+  return 0;
+}
+
+// Invoke a registered .Call entry by name. Returns 0 on success (result
+// in *out), -1 when the glue raised Rf_error (message via
+// rmock_last_error), -2 for unknown name / arity mismatch, -3 when the
+// call left the PROTECT stack unbalanced (R would warn "stack
+// imbalance"; here it is a hard failure).
+int rmock_invoke(const char* name, SEXP* args, int nargs, SEXP* out) {
+  const CallEntry* entry = nullptr;
+  for (const auto& e : g_entries)
+    if (e.name == name) entry = &e;
+  if (!entry || entry->nargs != nargs) return -2;
+  const int depth0 = g_protect_depth;
+  g_depth_floor = depth0;
+  g_underflow = false;
+  g_error[0] = '\0';
+  g_jmp_active = true;
+  if (setjmp(g_jmp) != 0) {
+    g_jmp_active = false;
+    // R unwinds the protect stack to the call boundary on error
+    g_protect_depth = depth0;
+    return -1;
+  }
+  SEXP r = R_NilValue;
+  using F0 = SEXP (*)();
+  using F1 = SEXP (*)(SEXP);
+  using F2 = SEXP (*)(SEXP, SEXP);
+  using F3 = SEXP (*)(SEXP, SEXP, SEXP);
+  using F4 = SEXP (*)(SEXP, SEXP, SEXP, SEXP);
+  using F5 = SEXP (*)(SEXP, SEXP, SEXP, SEXP, SEXP);
+  using F6 = SEXP (*)(SEXP, SEXP, SEXP, SEXP, SEXP, SEXP);
+  void* f = entry->fun;
+  switch (nargs) {
+    case 0: r = reinterpret_cast<F0>(f)(); break;
+    case 1: r = reinterpret_cast<F1>(f)(args[0]); break;
+    case 2: r = reinterpret_cast<F2>(f)(args[0], args[1]); break;
+    case 3: r = reinterpret_cast<F3>(f)(args[0], args[1], args[2]); break;
+    case 4:
+      r = reinterpret_cast<F4>(f)(args[0], args[1], args[2], args[3]);
+      break;
+    case 5:
+      r = reinterpret_cast<F5>(f)(args[0], args[1], args[2], args[3],
+                                  args[4]);
+      break;
+    case 6:
+      r = reinterpret_cast<F6>(f)(args[0], args[1], args[2], args[3],
+                                  args[4], args[5]);
+      break;
+    default:
+      g_jmp_active = false;
+      return -2;
+  }
+  g_jmp_active = false;
+  if (g_protect_depth != depth0 || g_underflow) return -3;
+  *out = r;
+  return 0;
+}
+
+}  // extern "C"
