@@ -80,8 +80,13 @@ def build_histogram(binned_rows: jax.Array, gh: jax.Array, num_bins: int,
         b, g = chunk
         return acc + _hist_chunk(b, g, num_bins), None
 
-    init = jnp.zeros((f, num_bins, 3), dtype=jnp.float32)
-    hist, _ = jax.lax.scan(body, init, (binned_rows, gh))
+    # the carry is seeded from the FIRST chunk (not zeros) so its type
+    # carries the data's varying-manual-axes when this runs inside a
+    # shard_map region (a replicated zeros carry + varying per-chunk
+    # additions fails shard_map's carry type check); outside shard_map
+    # it is the same arithmetic with one add saved
+    init = _hist_chunk(binned_rows[0], gh[0], num_bins)
+    hist, _ = jax.lax.scan(body, init, (binned_rows[1:], gh[1:]))
     return hist
 
 

@@ -100,12 +100,15 @@ def test_scatter_engages_on_dense_100k():
 @pytest.mark.slow
 def test_voting_at_realistic_feature_count_100k():
     """PV-Tree at 100k x 128 with top_k=16: the regime it exists for
-    (C large enough that full histogram reduction dominates)."""
+    (C large enough that full histogram reduction dominates). 128
+    features is the load-bearing axis; rows stay at the 100k scale
+    floor to keep the slow suite bounded."""
     r = np.random.RandomState(3)
-    x = r.randn(100_000, 128)
+    n = 100_000
+    x = r.randn(n, 128).astype(np.float32)
     logit = (x[:, 0] * 1.5 - x[:, 7] + 0.6 * x[:, 40] * x[:, 41]
              + 0.3 * x[:, 100])
-    y = (logit + r.randn(100_000) * 0.8 > 0).astype(np.float64)
+    y = (logit + r.randn(n) * 0.8 > 0).astype(np.float64)
     b, _ = _train(x, y, "voting", rounds=2, top_k=16)
     assert len(b.models) == 2 and b.models[0].num_leaves > 16
     auc = _auc(y, b.predict(x, raw_score=True))

@@ -266,3 +266,30 @@ def test_bucketed_predict_matches_unbucketed():
     pred = bst.predict(x, raw_score=True)
     host = np.array([sum(t.predict_row(row) for t in models) for row in x])
     np.testing.assert_allclose(pred, host, rtol=1e-5, atol=1e-6)
+
+
+def test_histogram_multichunk_inside_shard_map():
+    """The scanned multi-chunk path (window > chunk_size) must build
+    inside a shard_map region: its carry is seeded from the first chunk
+    so it carries the data's varying manual axes (a replicated zeros
+    carry fails shard_map's scan carry type check — this was invisible
+    until a host-loop learner met a >2048-row window on a mesh)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    r = np.random.RandomState(0)
+    rows = r.randint(0, 64, (8 * 4096, 13)).astype(np.uint8)
+    gh = r.randn(8 * 4096, 3).astype(np.float32)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    def f(b, g):
+        return jax.lax.psum(hist_ops.build_histogram(b, g, 64), "data")
+
+    fn = jax.jit(shard_map(f, mesh=mesh,
+                           in_specs=(P("data", None), P("data", None)),
+                           out_specs=P()))
+    got = np.asarray(fn(rows, gh))
+    want = np.asarray(hist_ops.build_histogram(
+        jnp.asarray(rows), jnp.asarray(gh), 64))
+    np.testing.assert_allclose(got, want, atol=2e-3)

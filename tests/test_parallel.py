@@ -341,3 +341,17 @@ def test_feature_parallel_fused_goss_matches_serial(monkeypatch):
     assert bs._fused_step and True in bs._fused_step
     assert bf._fused_step and True in bf._fused_step
     assert_trees_structurally_equal(bs, bf, 4, "fp-fused-goss")
+
+
+def test_hostloop_voting_multichunk_window():
+    """Host-loop voting learner (top_k*2 > F forces it off the device
+    PV-Tree) with a root window larger than the histogram chunk size:
+    exercises the scanned multi-chunk build_histogram INSIDE the
+    learner's shard_map hist_fn — the path a zeros-seeded scan carry
+    broke (caught by tools/mesh_scaling_probe.py, round 5)."""
+    from lightgbm_tpu.parallel.learners import VotingParallelTreeLearner
+    x, y = make_binary(6000, 28)
+    b = _train(x, y, "voting", rounds=2, num_leaves=4, top_k=20)
+    assert isinstance(b.learner, VotingParallelTreeLearner)
+    assert len(b.models) == 2 and b.models[0].num_leaves > 1
+    assert _auc(y, b.predict(x, raw_score=True)) > 0.7
