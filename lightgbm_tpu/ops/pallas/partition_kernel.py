@@ -48,9 +48,19 @@ def _partition_kernel(starts_ref, win_ref, key_ref,
     s = pl.program_id(1)
     key = key_ref[...]                                   # (BK, 1) int32
     flag = (key == s).astype(jnp.int32)                  # (BK, 1)
-    rank = jnp.cumsum(flag, axis=0) - flag               # exclusive rank
     bk = block_rows
     iota_i = jax.lax.broadcasted_iota(jnp.int32, (bk, bk), 0)
+    iota_j = jax.lax.broadcasted_iota(jnp.int32, (bk, bk), 1)
+    # exclusive rank via strict-lower-triangular matvec: rank[i] =
+    # sum_{j<i} flag[j]. Mosaic has no cumsum lowering for TC kernels
+    # (the jnp.cumsum formulation fails to lower on real chips); the
+    # 0/1 x 0/1 products are exact and accumulate in f32 (exact to
+    # 2^24), and the MXU does the whole (BK, BK) matvec in one pass.
+    tril = (iota_j < iota_i).astype(jnp.bfloat16)
+    rank = jax.lax.dot_general(
+        tril, flag.astype(jnp.bfloat16),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(jnp.int32)
     # P[i, j] = 1 iff block row j is stream s's i-th row
     p = ((rank[:, 0][None, :] == iota_i)
          & (flag[:, 0][None, :] == 1)).astype(jnp.bfloat16)

@@ -32,6 +32,16 @@ D = 11          # 7 packed u8 code words + 3 gh words + row id
 r = np.random.RandomState(0)
 
 
+def rot(x, i):
+    # cache-defeating rotation by a traced offset. jnp.roll(x, traced_i)
+    # hits a lowering-cache KeyError in this jax version (_roll_dynamic
+    # closed_call missing from cached_primitive_lowerings when the same
+    # shape lowers twice in one module); an explicit modulo gather is the
+    # same access pattern through the ordinary take path.
+    n = x.shape[0]
+    return jnp.take(x, (jnp.arange(n) + i) % n, axis=0)
+
+
 def timed(name, make_body, *args, reps=REPS):
     @jax.jit
     def run(*a):
@@ -52,7 +62,7 @@ def timed(name, make_body, *args, reps=REPS):
 
 def part_sort(i, a):
     win, key3 = a
-    order = jnp.argsort(jnp.roll(key3, i).astype(jnp.int8), stable=True)
+    order = jnp.argsort(rot(key3, i).astype(jnp.int8), stable=True)
     return jnp.take(win, order, axis=0).astype(jnp.float32)
 
 
@@ -62,7 +72,7 @@ def part_scan(i, a):
     # key pattern; production (device_learner) has invalid rows at the
     # tail and skips the third cumsum — this measures a slight superset
     win, key3 = a
-    k = jnp.roll(key3, i)
+    k = rot(key3, i)
     go_left = k == 0
     valid = k < 2
     il = go_left.astype(jnp.int32)
@@ -82,21 +92,21 @@ def part_pallas(i, a):
     from lightgbm_tpu.ops.pallas.partition_kernel import stable_partition3
     win, key3 = a
     return stable_partition3(
-        win, jnp.roll(key3, i),
+        win, rot(key3, i),
         interpret=jax.default_backend() != "tpu").astype(jnp.float32)
 
 
 def hist_half(i, a):
     from lightgbm_tpu.ops.histogram import build_histogram
     codes, gh = a
-    return build_histogram(codes, jnp.roll(gh, i, axis=0), B,
+    return build_histogram(codes, rot(gh, i), B,
                            use_pallas=False)
 
 
 def scan_chain(i, a):
     from lightgbm_tpu.ops import split as split_ops
     hist2, nb, miss, dflt, mask, mono = a
-    hist2 = jnp.roll(hist2, i, axis=0)
+    hist2 = rot(hist2, i)
 
     def one(hist):
         tot = hist.sum(axis=(0, 1))
