@@ -25,7 +25,9 @@ block-streaming pass whose row movement rides the MXU and DMA engines:
     step waiting on its copy, so every garbage row is overwritten before
     the kernel ends (the final tail lands in the +BK slack row pad).
   * the three per-stream buffers assemble into the final window with two
-    dynamic rolls + selects in XLA — streaming passes at HBM bandwidth.
+    doubled-buffer dynamic slices + selects in XLA — streaming passes at
+    HBM bandwidth (dynamic jnp.roll both miscompiles under this jax
+    version's lowering cache and is not needed).
 
 Cost: one block load (x3 revisits), one one-hot build + matmul, and one
 block store per (block, stream) — ~2-4 ns/row/pass vs ~14 ns for
@@ -146,8 +148,21 @@ def stable_partition3(win: jax.Array, key3: jax.Array,
 
     c0, c1 = totals[0], totals[1]
     rows = jnp.arange(wp + bk, dtype=jnp.int32)
-    o1r = jnp.roll(o1, c0, axis=0)
-    o2r = jnp.roll(o2, c0 + c1, axis=0)
+    # Rotate by a traced offset WITHOUT jnp.roll (a traced shift hits a
+    # _roll_dynamic lowering-cache KeyError when two same-shape dynamic
+    # rolls lower in one module — the actual crash site in the round-5
+    # battery) and WITHOUT a modulo gather (random row gathers run at
+    # 3-10 GB/s vs ~800 GB/s HBM — the very cost this kernel avoids):
+    # dynamic_slice into a doubled buffer keeps the copy contiguous.
+    m = wp + bk
+
+    def rotate(o, shift):
+        return jax.lax.dynamic_slice(
+            jnp.concatenate([o, o], axis=0),
+            ((m - shift) % m, 0), (m, d))
+
+    o1r = rotate(o1, c0)
+    o2r = rotate(o2, c0 + c1)
     out = jnp.where((rows < c0)[:, None], o0,
                     jnp.where((rows < c0 + c1)[:, None], o1r, o2r))
     return out[:w]

@@ -16,18 +16,20 @@ def use_pallas_env() -> bool:
     return flag("LGBM_TPU_PALLAS") or flag("LGBM_TPU_PALLAS_HIST")
 
 
-def partition_mode_env() -> str:
+def partition_mode_env(default: str = "sort") -> str:
     """LGBM_TPU_PARTITION selects the compact window-split formulation:
     'sort' (argsort+take — latency-bound on TPU: the sort's O(W log W)
     passes dominate small windows, the row gather runs at 3-10 GB/s),
     'scan' (destination = cumsum of the partition flags + one row
     scatter — two linear passes, no sort), or 'pallas' (the block-
     streaming one-hot-matmul kernel, ops/pallas/partition_kernel.py).
-    LGBM_TPU_PALLAS_PART=1 is the round-2 spelling of 'pallas'."""
+    LGBM_TPU_PALLAS_PART=1 is the round-2 spelling of 'pallas'.
+    `default` carries the caller's measured backend/strategy-aware
+    choice (device_learner: scan on TPU+compact, round-5 battery)."""
     mode = os.environ.get("LGBM_TPU_PARTITION", "").strip().lower()
     if mode in ("sort", "scan", "pallas"):
         return mode
-    resolved = "pallas" if flag("LGBM_TPU_PALLAS_PART") else "sort"
+    resolved = "pallas" if flag("LGBM_TPU_PALLAS_PART") else default
     if mode:
         from . import log
         log.warning("Unknown LGBM_TPU_PARTITION=%r; using %s", mode, resolved)

@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-5b recovery chain: the tunnel wedged at ~09:49 (SIGINT landed
+# mid-remote-compile in the scan scaling probe — the r3 failure mode).
+# On recovery run a SHORT confirm sequence, not the full battery; the
+# round's A/B data is already banked in docs/bench_logs/r5_battery.log.
+#   1. bench 1M default          — confirms the scan default flip
+#   2. bench 10.5M chunk         — strategy A/B at reference scale
+#   3. bench 10.5M step-4        — window-inflation A/B at scale
+# Same hygiene as battery2: internal SIGALRM deadlines, INT-only outer
+# timeouts, probe between steps, cutoff file honored, ONE client at a
+# time on this single-core host.
+cd /root/repo
+RES=/tmp/tpu_r5b.log
+ST=/tmp/tpu_r5b_status.log
+probe() {
+  if [ -f /tmp/battery_cutoff ] \
+      && [ "$(date +%s)" -gt "$(cat /tmp/battery_cutoff)" ]; then
+    return 2
+  fi
+  timeout 150 python -c "import jax; assert jax.default_backend()=='tpu'" \
+    2>/dev/null || return 1
+}
+while true; do
+  probe; prc=$?
+  [ $prc -eq 2 ] && { echo "$(date +%H:%M:%S) cutoff while polling" >> $ST; exit 0; }
+  [ $prc -eq 0 ] && { echo "$(date +%H:%M:%S) TPU RECOVERED" >> $ST; break; }
+  echo "$(date +%H:%M:%S) tpu down" >> $ST
+  sleep 170
+done
+step() {  # step <name> <internal_deadline_s> <env...>
+  local name="$1" dl="$2"; shift 2
+  probe; local prc=$?
+  if [ $prc -eq 2 ]; then
+    echo "!! cutoff before '$name' — stopping cleanly" >> $RES
+    exit 0
+  elif [ $prc -ne 0 ]; then
+    echo "!! tunnel down before '$name' — stopping" >> $RES
+    exit 1
+  fi
+  echo "--- $name $(date +%H:%M:%S) ---" >> $RES
+  env "$@" BENCH_DEADLINE=$dl timeout -s INT -k 120 $((dl + 300)) \
+    python bench.py >> $RES 2>&1
+  echo "--- end $name rc=$? $(date +%H:%M:%S) ---" >> $RES
+}
+step "bench 1M default (scan confirm)" 900 \
+  BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+step "bench 10.5M chunk" 2400 LGBM_TPU_STRATEGY=chunk \
+  BENCH_ROWS=10500000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+step "bench 10.5M step4" 2400 LGBM_TPU_WINDOW_STEP=4 \
+  BENCH_ROWS=10500000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+echo "=== r5b chain done $(date +%H:%M:%S) ===" >> $RES
