@@ -2252,9 +2252,14 @@ class DeviceTreeLearner:
                     codes_pack, g, h, w, base_mask, *meta, tree_key,
                     **statics)
 
-            # on-device leaf-value replay avoids any H2D of leaf values
+            # on-device leaf-value replay avoids any H2D of leaf values.
+            # The k == 0 gate makes the returned score EXACTLY the input
+            # score on a no-split iteration, so the pipelined caller
+            # (gbdt._train_one_iter_fused) can commit it before k is
+            # fetched and still match the reference's stop semantics.
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
+            delta = jnp.where(k > 0, delta, jnp.zeros_like(delta))
             return score_row + delta, rec, rec_cat, leaf_id, k
 
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):

@@ -14,6 +14,7 @@ ride on host.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -28,6 +29,7 @@ from ..objectives import create_objective
 from ..objectives.objective import MAPE
 from ..ops import predict as predict_ops
 from ..utils import log
+from ..utils.envs import pipeline_env
 from .serial_learner import SerialTreeLearner
 from .tree import Tree
 
@@ -97,7 +99,17 @@ class GBDT:
                  objective=None):
         self.config = config
         self.train_set = train_set
-        self.models: List[Tree] = []
+        # fused-iteration pipelining (round 5): the most recent fused
+        # iteration's split records may still be in flight on device;
+        # `models` materializes them on read (see the property below).
+        # The lock keeps concurrent READERS (the C ABI's thread-safety
+        # contract: prediction may run concurrently with anything) from
+        # double-materializing one stash; mutation calls themselves are
+        # serialized by the caller, as in the reference.
+        self._pending_fused = None
+        self._pend_lock = threading.Lock()
+        self._pipeline = pipeline_env()
+        self._models: List[Tree] = []
         self.iter = 0
         self.num_init_iteration = 0
         self.shrinkage_rate = config.learning_rate
@@ -113,6 +125,70 @@ class GBDT:
 
         if train_set is not None:
             self._init_train(train_set)
+
+    # ------------------------------------------------------------------
+    @property
+    def models(self) -> List[Tree]:
+        """The host-side tree list. With fused-iteration pipelining the
+        newest tree's split records may still be on device; any read
+        materializes them first, so every consumer (predict, save,
+        rollback, cv, plotting, the C API) sees a consistent model."""
+        if self._pending_fused is not None:
+            self._materialize_pending()
+        return self._models
+
+    @models.setter
+    def models(self, value: List[Tree]) -> None:
+        if self._pending_fused is not None:
+            self._materialize_pending()
+        self._models = value
+
+    def _materialize_pending(self) -> None:
+        """Fetch + replay the in-flight fused iteration (if any). If that
+        iteration found no split, training should have stopped there:
+        rewind iter/score (its score delta was gated to 0 in-program, so
+        the restore is a no-op numerically) and run the generic path at
+        that iteration so the reference's stop bookkeeping — constant
+        boost-from-average tree on a first-iteration stop, warning,
+        model trimming — happens even when no further train_one_iter
+        call is coming (e.g. the no-split iteration was the last one
+        dispatched and the stop is discovered by a save/predict)."""
+        with self._pend_lock:
+            pend = self._pending_fused
+            if pend is None:
+                return
+            self._pending_fused = None
+        if self._materialize_one(pend):
+            self.score_updater.score = pend[4]
+            self.iter = pend[6]
+            self._train_one_iter_generic()
+
+    def _materialize_one(self, pend) -> bool:
+        """Replay one stashed fused iteration into a host tree. Returns
+        True when the iteration found no split (k == 0)."""
+        rec, rec_cat, leaf_id, k_dev, _score_before, init_score, it, \
+            shrinkage = pend
+        if rec_cat is None:
+            rec_h, k = jax.device_get((rec, k_dev))
+            rec_cat_h = None
+        else:
+            rec_h, rec_cat_h, k = jax.device_get((rec, rec_cat, k_dev))
+        k = int(k)
+        if k == 0:
+            return True
+        tree = self.learner.replay_tree(rec_h, k, rec_cat_h)
+        tree.apply_shrinkage(shrinkage)
+        if abs(init_score) > K_EPSILON:
+            tree.add_bias(init_score)
+        self.learner.last_leaf_id = leaf_id
+        self.learner._leaf_id_host = None
+        self.learner._bag_mask_host = None
+        self._last_leaf_ids[0] = leaf_id
+        self._last_leaf_ids_iter = it
+        for vu in self.valid_updaters:
+            vu.add_tree(tree, 0)
+        self._models.append(tree)
+        return False
 
     def _init_train(self, train_set: Dataset) -> None:
         cfg = self.config
@@ -163,7 +239,11 @@ class GBDT:
     # ------------------------------------------------------------------
     def _boost_from_average(self, class_id: int, update_scorer: bool) -> float:
         cfg = self.config
-        if (self.models or self.score_updater.has_init_score
+        # _models + pending check (NOT the materializing property): this
+        # runs at the top of every iteration, and materializing here
+        # would serialize the pipelined fused path
+        if (self._models or self._pending_fused is not None
+                or self.score_updater.has_init_score
                 or self.objective is None):
             return 0.0
         if not (cfg.boost_from_average or self.train_set.num_features == 0):
@@ -270,33 +350,50 @@ class GBDT:
         freq = 1 if self._fused_goss() else max(cfg.bagging_freq, 1)
         bag_key = jax.random.PRNGKey(
             (cfg.bagging_seed + (self.iter // freq)) % (2**31 - 1))
+        score_before = self.score_updater.score
         new_score, rec, rec_cat, leaf_id, k_dev = fused_step(
-            self.score_updater.score[0], base_mask, tree_key, bag_key,
+            score_before[0], base_mask, tree_key, bag_key,
             jnp.float32(self.shrinkage_rate))
-        if rec_cat is None:
-            rec_h, k = jax.device_get((rec, k_dev))
-            rec_cat_h = None
-        else:
-            rec_h, rec_cat_h, k = jax.device_get((rec, rec_cat, k_dev))
-        k = int(k)
-        if k == 0:
+
+        pend = (rec, rec_cat, leaf_id, k_dev, score_before, init_score,
+                self.iter, self.shrinkage_rate)
+
+        if self._pipeline:
+            # Pipelined (TPU default): commit the score immediately (the
+            # program gates the delta to 0 when k == 0, so this is safe
+            # before k is known), stash the record handles, and replay
+            # the PREVIOUS iteration's tree while this program runs on
+            # device — hiding the ~70 ms/iter record-fetch round trip
+            # and the host replay entirely (tools/profile_fused.py).
+            self.score_updater.score = score_before.at[0].set(new_score)
+            with self._pend_lock:
+                prev = self._pending_fused
+                self._pending_fused = pend
+            self.iter += 1
+            if prev is not None and self._materialize_one(prev):
+                # the PREVIOUS iteration found no split, so training
+                # should already have stopped there. Its score delta was
+                # 0, so the in-flight program saw identical gradients
+                # and is pure waste: discard it, rewind to the no-split
+                # iteration's OWN index (the generic re-run must use its
+                # seeds — prev's feature mask found no split; this
+                # iteration's fresh mask might), and let the generic
+                # path produce the reference's stop bookkeeping
+                # (constant init-score tree on a first-iteration stop,
+                # warning, model trimming).
+                with self._pend_lock:
+                    self._pending_fused = None
+                self.score_updater.score = prev[4]
+                self.iter = prev[6]
+                return self._train_one_iter_generic()
+            return False
+
+        if self._materialize_one(pend):
             # delegate the stop bookkeeping (constant init-score tree on a
             # first-iteration stop, warning, model trimming) to the generic
             # path so both paths produce identical final models
             return self._train_one_iter_generic()
-        tree = self.learner.replay_tree(rec_h, k, rec_cat_h)
-        tree.apply_shrinkage(self.shrinkage_rate)
-        if abs(init_score) > K_EPSILON:
-            tree.add_bias(init_score)
-        self.learner.last_leaf_id = leaf_id
-        self.learner._leaf_id_host = None
-        self.learner._bag_mask_host = None
-        self.score_updater.score = self.score_updater.score.at[0].set(new_score)
-        self._last_leaf_ids[0] = leaf_id
-        self._last_leaf_ids_iter = self.iter
-        for vu in self.valid_updaters:
-            vu.add_tree(tree, 0)
-        self.models.append(tree)
+        self.score_updater.score = score_before.at[0].set(new_score)
         self.iter += 1
         return False
 
@@ -421,6 +518,11 @@ class GBDT:
     # ------------------------------------------------------------------
     def eval_metrics(self) -> Dict[str, List]:
         """(dataset_name, metric_name, value, higher_better) tuples."""
+        # valid_updaters receive the pending tree only at materialization
+        # (train scores are committed at dispatch, so only the VALID side
+        # lags): sync here so per-iteration eval and early stopping see
+        # iteration N with N trees, exactly like the synchronous path
+        self._materialize_pending()
         out = []
         if self.train_metrics:
             scores = self.score_updater.host_scores()

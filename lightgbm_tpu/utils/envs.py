@@ -36,6 +36,21 @@ def partition_mode_env(default: str = "sort") -> str:
     return resolved
 
 
+def pipeline_env() -> bool:
+    """LGBM_TPU_PIPELINE: overlap the fused iteration's split-record
+    D2H fetch + host tree replay with the NEXT iteration's device
+    program (models materialize lazily through GBDT.models). Default on
+    for TPU — the record fetch costs one ~70 ms tunnel round trip per
+    iteration (tools/profile_fused.py, round 5) that the pipeline hides
+    entirely — and off elsewhere (on CPU the fetch is free and the
+    synchronous path keeps step-debugging simple)."""
+    v = os.environ.get("LGBM_TPU_PIPELINE", "").strip().lower()
+    if v:
+        return v in _TRUE
+    import jax
+    return jax.default_backend() == "tpu"
+
+
 def strategy_env(default: str = "auto") -> str:
     """LGBM_TPU_STRATEGY: auto | masked | compact | chunk — the ONE
     read shared by the device learner's resolve_strategy and the

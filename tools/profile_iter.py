@@ -33,9 +33,20 @@ bst = create_boosting(cfg, ds)
 print(f"setup {time.time()-t0:.1f}s  backend={jax.default_backend()} "
       f"learner={type(bst.learner).__name__}")
 
-# warm (compile)
+# warm (compile) the SAME programs the phased loop below uses.
+# bst.train_one_iter() would warm the FUSED program instead, leaving the
+# first phased iteration to pay the standalone grow program's compile
+# (~50 s on the tunneled TPU) inside the averages — which made the r5
+# chain's generic path look like 13 s/iter when steady state is ~20x
+# less.
 for _ in range(2):
-    bst.train_one_iter()
+    g, h = bst._compute_gradients()
+    tree = bst.learner.train(g[0], h[0], bst._bagging(bst.iter),
+                             iter_seed=bst.iter)
+    tree.apply_shrinkage(bst.shrinkage_rate)
+    bst._update_score(tree, 0)
+    bst.models.append(tree)
+    bst.iter += 1
 
 def sync(v):
     np.asarray(jax.device_get(v.ravel()[:1]))

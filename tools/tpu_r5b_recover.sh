@@ -42,7 +42,9 @@ step() {  # step <name> <internal_deadline_s> <env...>
     python bench.py >> $RES 2>&1
   echo "--- end $name rc=$? $(date +%H:%M:%S) ---" >> $RES
 }
-step "bench 1M default (scan confirm)" 900 \
+step "bench 1M default (scan+pipeline confirm)" 900 \
+  BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+step "bench 1M pipeline OFF" 900 LGBM_TPU_PIPELINE=0 \
   BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 step "bench 10.5M chunk" 2400 LGBM_TPU_STRATEGY=chunk \
   BENCH_ROWS=10500000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
