@@ -42,10 +42,26 @@ step() {  # step <name> <internal_deadline_s> <env...>
     python bench.py >> $RES 2>&1
   echo "--- end $name rc=$? $(date +%H:%M:%S) ---" >> $RES
 }
+run() {  # run <name> <outer_timeout_s> <cmd...>  (non-bench steps)
+  local name="$1" to="$2"; shift 2
+  probe; local prc=$?
+  if [ $prc -eq 2 ]; then
+    echo "!! cutoff before '$name' — stopping cleanly" >> $RES
+    exit 0
+  elif [ $prc -ne 0 ]; then
+    echo "!! tunnel down before '$name' — stopping" >> $RES
+    exit 1
+  fi
+  echo "--- $name $(date +%H:%M:%S) ---" >> $RES
+  timeout -s INT -k 120 "$to" "$@" >> $RES 2>&1
+  echo "--- end $name rc=$? $(date +%H:%M:%S) ---" >> $RES
+}
 step "bench 1M default (scan+pipeline confirm)" 900 \
   BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 step "bench 1M pipeline OFF" 900 LGBM_TPU_PIPELINE=0 \
   BENCH_ROWS=1000000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
+run "nscale probe (superlinearity knee)" 2400 \
+  python tools/nscale_probe.py 10500000 3
 step "bench 10.5M chunk" 2400 LGBM_TPU_STRATEGY=chunk \
   BENCH_ROWS=10500000 BENCH_ITERS=10 BENCH_WARMUP=3 BENCH_EVAL_EVERY=0
 step "bench 10.5M step4" 2400 LGBM_TPU_WINDOW_STEP=4 \
