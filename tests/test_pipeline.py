@@ -171,6 +171,30 @@ def test_pipeline_custom_fobj_mid_stream():
     np.testing.assert_array_equal(b0.predict(x[:256]), b1.predict(x[:256]))
 
 
+def test_pipeline_sharded_learner_parity():
+    # on real multi-chip TPU the pipeline default combines with the
+    # SHARDED learners (they share the fused-step contract); pin exact
+    # parity on the virtual mesh for the data-parallel learner
+    from lightgbm_tpu.parallel.learners import DeviceDataParallelTreeLearner
+
+    data = _data(2048)
+    params = dict(PARAMS, tree_learner="data", min_data_in_leaf=5)
+    b0, _, _ = _train(False, n_iter=4, params=params, data=data)
+    b1, _, _ = _train(True, n_iter=4, params=params, data=data)
+    g0, g1 = b0._gbdt, b1._gbdt
+    assert isinstance(g1.learner, DeviceDataParallelTreeLearner)
+    assert g1._pipeline is True
+    # the pipeline must have actually engaged (deferral happened): the
+    # newest tree is still pending before the first models read. Guards
+    # against a future _fused_eligible() change silently degrading the
+    # sharded learners to the synchronous generic path, which would make
+    # this parity check vacuous.
+    assert g1._pending_fused is not None
+    assert len(g0.models) == len(g1.models) == 4
+    for t0, t1 in zip(g0.models, g1.models):
+        assert t0.to_string() == t1.to_string()
+
+
 def test_pipeline_goss_parity():
     params = dict(PARAMS, boosting="goss", top_rate=0.3, other_rate=0.2)
     b0, _, x = _train(False, n_iter=6, params=params)
