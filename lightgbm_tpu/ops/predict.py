@@ -173,8 +173,15 @@ def predict_binned_leaf(binned, feat_missing, feat_default, feat_numbins,
 
 def predict_binned_tree_values(binned, feat_missing, feat_default,
                                feat_numbins, tree, dtype=jnp.float32):
-    """Per-row leaf values of a single (host) Tree over binned data."""
-    arr = trees_to_arrays([tree], dtype=dtype)
+    """Per-row leaf values of a single (host) Tree over binned data.
+
+    bucket=True: this runs once per ITERATION per valid set during
+    training (ScoreUpdater.add_tree), and without bucketing every
+    distinct (num_leaves, cat-width) pair retraces predict_binned_leaf
+    — a remote compile each through the tunneled TPU. Bucketing
+    collapses the shapes to O(log L) programs; the output indexes tree
+    0 only, so padding trees never contribute."""
+    arr = trees_to_arrays([tree], dtype=dtype, bucket=True)
     leaves = predict_binned_leaf(
         binned, feat_missing, feat_default, feat_numbins,
         arr.split_feature[0], arr.threshold_bin[0], arr.decision_type[0],
