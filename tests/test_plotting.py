@@ -1,0 +1,76 @@
+"""Plotting surface tests (reference: tests/python_package_test/
+test_plotting.py — axes/labels/shape assertions, no pixel comparisons).
+"""
+import numpy as np
+import pytest
+
+mpl = pytest.importorskip("matplotlib")
+mpl.use("Agg")
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu import plotting  # noqa: E402
+
+from conftest import make_binary  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def trained():
+    x, y = make_binary(1500, 6, seed=2)
+    ds = lgb.Dataset(x[:1200], y[:1200])
+    dv = lgb.Dataset(x[1200:], y[1200:], reference=ds)
+    evals = {}
+    import lightgbm_tpu.engine as eng
+    bst = eng.train({"objective": "binary", "num_leaves": 15,
+                     "metric": "binary_logloss", "verbosity": -1},
+                    ds, num_boost_round=5, valid_sets=[ds, dv],
+                    valid_names=["training", "valid"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    return bst, evals
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = plotting.plot_importance(bst)
+    assert ax.get_title() == "Feature importance"
+    assert ax.get_xlabel() == "Feature importance"
+    # only features that actually split appear; bars match that count
+    imp = bst.feature_importance()
+    assert len(ax.patches) == int(np.count_nonzero(imp))
+    ax2 = plotting.plot_importance(bst, importance_type="gain",
+                                   title="t", xlabel="x", ylabel="y")
+    assert (ax2.get_title(), ax2.get_xlabel(), ax2.get_ylabel()) \
+        == ("t", "x", "y")
+
+
+def test_plot_metric(trained):
+    bst, evals = trained
+    ax = plotting.plot_metric(evals)
+    assert ax.get_title() == "Metric during training"
+    assert ax.get_xlabel() == "Iterations"
+    lines = ax.get_lines()
+    assert len(lines) == 2  # training + valid
+    assert all(len(ln.get_ydata()) == 5 for ln in lines)
+
+
+def test_plot_split_value_histogram(trained):
+    bst, _ = trained
+    imp = bst.feature_importance()
+    feat = int(np.argmax(imp))
+    ax = plotting.plot_split_value_histogram(bst, feat)
+    assert ax.get_title().startswith("Split value histogram for feature")
+    assert len(ax.patches) > 0
+
+
+def test_plot_tree_and_digraph(trained):
+    pytest.importorskip("graphviz")
+    bst, _ = trained
+    g = plotting.create_tree_digraph(bst, tree_index=0)
+    src = getattr(g, "source", str(g))
+    assert "leaf" in src.lower()
+    try:
+        ax = plotting.plot_tree(bst, tree_index=0)
+    except Exception as exc:  # rendering needs the graphviz `dot` binary
+        if "graphviz" in f"{type(exc).__module__}{exc}".lower():
+            pytest.skip(f"graphviz rendering unavailable: {exc}")
+        raise
+    assert ax is not None
