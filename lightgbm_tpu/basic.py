@@ -16,10 +16,15 @@ import numpy as np
 from .config import Config, parse_config_str
 from .io.dataset import Dataset as _InnerDataset
 from .models.gbdt import GBDT, create_boosting
+from .ops.predict import _bucket_up
 from .utils import log
 from .utils.log import LightGBMError
 
 __all__ = ["Dataset", "Booster", "LightGBMError"]
+
+# row-batch size for sparse (CSR) prediction; module-level so tests can
+# shrink it to exercise the multi-batch + ragged-tail path cheaply
+_SPARSE_PREDICT_BATCH = 65536
 
 
 def _load_data_from_file(path: str):
@@ -637,10 +642,22 @@ class Booster:
             # (B, F) batch, never the whole matrix (the reference
             # iterates sparse rows directly, c_api.cpp PredictForCSR)
             x = x.tocsr()
-            batch = 65536
+            batch = _SPARSE_PREDICT_BATCH
             if x.shape[0] <= batch:
                 return run(np.asarray(x.todense()))
-            parts = [run(np.asarray(x[i:i + batch].todense()))
+
+            def run_padded(mat):
+                # ragged tail: pad rows up to a power-of-two bucket so
+                # the last chunk shares a compiled program across calls
+                # instead of paying a per-size XLA compile
+                n = mat.shape[0]
+                bucketed = _bucket_up(n)
+                if bucketed != n:
+                    pad = np.zeros((bucketed - n, mat.shape[1]),
+                                   dtype=mat.dtype)
+                    return run(np.concatenate([mat, pad], axis=0))[:n]
+                return run(mat)
+            parts = [run_padded(np.asarray(x[i:i + batch].todense()))
                      for i in range(0, x.shape[0], batch)]
             return np.concatenate(parts, axis=0)
         return run(x)

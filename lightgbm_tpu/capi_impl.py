@@ -625,7 +625,9 @@ def booster_get_leaf_value(h, tree_idx, leaf_idx):
 
 
 def booster_set_leaf_value(h, tree_idx, leaf_idx, val):
-    _get(h)._gbdt.models[tree_idx].set_leaf_output(leaf_idx, float(val))
+    gbdt = _get(h)._gbdt
+    gbdt.models[tree_idx].set_leaf_output(leaf_idx, float(val))
+    gbdt.invalidate_ensemble_cache()   # in-place edit: drop tensorized cache
     return 0
 
 

@@ -1,10 +1,15 @@
-"""Command-line application: train / predict / convert_model / refit.
+"""Command-line application: train / predict / convert_model / refit / serve.
 
 Equivalent of the reference CLI (reference: src/main.cpp,
 src/application/application.cpp:30-261). Usage matches the reference:
 
     python -m lightgbm_tpu config=train.conf [key=value ...]
     lightgbm-tpu task=train data=binary.train objective=binary ...
+
+`task=serve` (no reference equivalent) starts the online-inference HTTP
+server on a saved model:
+
+    lightgbm-tpu task=serve input_model=model.txt serve_port=8080
 """
 from __future__ import annotations
 
@@ -46,6 +51,11 @@ def parse_cli_args(argv) -> Dict[str, str]:
 def run(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     params = parse_cli_args(argv)
+    if params.get("task") == "serve":
+        # serve_* keys are serving-stack options, not training Config
+        # parameters: dispatch before Config so they aren't warned away
+        _serve(params)
+        return 0
     cfg = Config(params)
     if cfg.task in ("train", "refit"):
         _train(params, cfg)
@@ -148,6 +158,35 @@ def _predict(params: Dict[str, str], cfg: Config) -> None:
         for row in preds:
             f.write("\t".join(f"{v:g}" for v in np.atleast_1d(row)) + "\n")
     log.info("Prediction results saved to %s", out)
+
+
+def _serve(params: Dict[str, str], block: bool = True):
+    """task=serve: load + warm a saved model, run the HTTP server.
+
+    Options (all `serve_*` to stay clear of the training namespace):
+    serve_host, serve_port, serve_max_batch, serve_max_delay_ms,
+    serve_queue_rows, serve_timeout_ms, serve_warm_buckets (csv).
+    """
+    from .serving import ModelRegistry, ServingApp, run_http_server
+    model_file = params.get("input_model") or params.get("model")
+    if not model_file:
+        log.fatal("task=serve requires input_model")
+    warm = [int(v) for v in
+            str(params.get("serve_warm_buckets", "1,16,256")).split(",") if v]
+    registry = ModelRegistry(warm_buckets=warm)
+    app = ServingApp(
+        registry,
+        max_batch=int(params.get("serve_max_batch", 256)),
+        max_delay_ms=float(params.get("serve_max_delay_ms", 2.0)),
+        max_queue_rows=int(params.get("serve_queue_rows", 4096)),
+        default_timeout_ms=float(params.get("serve_timeout_ms", 5000.0)))
+    t0 = time.time()
+    version = registry.load(model_file)
+    log.info("Loaded + warmed model %s in %.3f seconds (buckets %s)",
+             version, time.time() - t0, warm)
+    return run_http_server(app, host=params.get("serve_host", "127.0.0.1"),
+                           port=int(params.get("serve_port", 8080)),
+                           background=not block)
 
 
 def _convert_model(params: Dict[str, str], cfg: Config) -> None:

@@ -122,6 +122,15 @@ class GBDT:
         self.best_iteration = 0
         self.label_idx = 0
         self.loaded_parameter = ""
+        # tensorized-ensemble cache: trees_to_arrays is O(T*M) host work
+        # plus a device upload, and back-to-back predicts on a static
+        # model were re-paying it every call. Keyed on a model
+        # fingerprint (length + last-tree identity + an explicit
+        # generation for in-place leaf edits), so growth, rollback and
+        # refit all invalidate. The serving registry warms through the
+        # same cache.
+        self._ensemble_cache: Dict = {}
+        self._ensemble_gen = 0
 
         if train_set is not None:
             self._init_train(train_set)
@@ -500,6 +509,7 @@ class GBDT:
     def rollback_one_iter(self) -> None:
         if self.iter <= 0:
             return
+        self.invalidate_ensemble_cache()
         for k in range(self.num_tree_per_iteration):
             tree = self.models[len(self.models) - self.num_tree_per_iteration + k]
             tree.apply_shrinkage(-1.0)
@@ -547,25 +557,55 @@ class GBDT:
     def current_iteration(self) -> int:
         return len(self.models) // max(self.num_tree_per_iteration, 1)
 
+    def invalidate_ensemble_cache(self) -> None:
+        """Drop cached tensorized ensembles. The cache key already tracks
+        tree-list growth/shrinkage; call this for IN-PLACE leaf edits
+        (refit, set_leaf_output, DART renormalization) that the
+        fingerprint cannot see."""
+        self._ensemble_gen += 1
+        self._ensemble_cache.clear()
+
+    def ensemble_arrays(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0, bucket: bool = True):
+        """Cached (EnsembleArrays, tree_class, n_models) for the model
+        slice. Repeated predicts on an unchanged model reuse one
+        tensorization + device upload instead of re-running
+        trees_to_arrays per call; tree growth changes the fingerprint and
+        naturally misses. tree_class is None for bucket=False (leaf-index
+        prediction must not pad the tree axis)."""
+        models = self._used_models(num_iteration, start_iteration)
+        if not models:
+            return None, None, 0
+        fp = (len(self._models), id(self._models[-1]), self._ensemble_gen)
+        key = (fp, start_iteration, len(models), bucket)
+        hit = self._ensemble_cache.get(key)
+        if hit is None:
+            arrays = predict_ops.trees_to_arrays(models, bucket=bucket)
+            tc = (predict_ops.padded_tree_class(
+                arrays, np.arange(len(models)) % self.num_tree_per_iteration)
+                if bucket else None)
+            hit = (arrays, tc, len(models))
+            if len(self._ensemble_cache) >= 16:   # bound stale slices
+                self._ensemble_cache.clear()
+            self._ensemble_cache[key] = hit
+        return hit
+
     def predict_raw(self, x: np.ndarray, num_iteration: Optional[int] = None,
                     start_iteration: int = 0) -> np.ndarray:
         """(N, K) raw scores over raw feature values."""
         x = np.ascontiguousarray(np.asarray(x, dtype=np.float32))
         if x.ndim == 1:
             x = x.reshape(1, -1)
-        models = self._used_models(num_iteration, start_iteration)
-        if not models:
+        arrays, tc, n_models = self.ensemble_arrays(
+            num_iteration, start_iteration, bucket=True)
+        if not n_models:
             return np.zeros((x.shape[0], self.num_class))
-        arrays = predict_ops.trees_to_arrays(models, bucket=True)
-        tc = predict_ops.padded_tree_class(
-            arrays,
-            np.arange(len(models)) % self.num_tree_per_iteration)
         out = predict_ops.predict_raw_ensemble(
             jnp.asarray(x), arrays, tc,
             max_depth=arrays.max_depth, num_class=self.num_class)
         out = np.asarray(jax.device_get(out), dtype=np.float64)
         if self.average_output:
-            out /= max(1, len(models) // self.num_tree_per_iteration)
+            out /= max(1, n_models // self.num_tree_per_iteration)
         return out
 
     def predict_raw_early_stop(self, x: np.ndarray, num_iteration=None,
@@ -611,8 +651,8 @@ class GBDT:
                 pred_early_stop=False, pred_early_stop_freq=10,
                 pred_early_stop_margin=10.0):
         if pred_leaf:
-            models = self._used_models(num_iteration, start_iteration)
-            arrays = predict_ops.trees_to_arrays(models)
+            arrays, _, _ = self.ensemble_arrays(
+                num_iteration, start_iteration, bucket=False)
             x = np.asarray(x, dtype=np.float32)
             if x.ndim == 1:
                 x = x.reshape(1, -1)
@@ -672,6 +712,7 @@ class GBDT:
         """Refit leaf values on new data keeping structure (reference:
         gbdt.cpp:298-321 RefitTree + FitByExistingTree): new_value =
         decay * old + (1 - decay) * regularized mean-gradient estimate."""
+        self.invalidate_ensemble_cache()
         grad, hess = self._compute_gradients()
         g = np.asarray(jax.device_get(grad))
         h = np.asarray(jax.device_get(hess))
@@ -869,6 +910,7 @@ class DART(GBDT):
 
     def _normalize(self, drop_index: List[int]) -> None:
         cfg = self.config
+        self.invalidate_ensemble_cache()
         k = float(len(drop_index))
         for i in drop_index:
             for c in range(self.num_tree_per_iteration):

@@ -35,7 +35,15 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:   # jax < 0.5: experimental API, check_rep not check_vma
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map_exp(f, *args, **kwargs)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config

@@ -1,0 +1,32 @@
+"""Online inference subsystem.
+
+Layers (each usable on its own):
+
+* `registry` — versioned, hot-swappable PreparedModels with warm-up
+* `predictor` — AOT-compiled, shape-bucketed predictor cache
+* `batcher` — micro-batching scheduler with admission control
+* `server` — in-process API + stdlib JSON-over-HTTP front end
+* `stats` — request counters and latency histograms
+
+Quick start::
+
+    from lightgbm_tpu.serving import ModelRegistry, MicroBatcher, ServingApp
+    app = ServingApp()
+    app.registry.load(booster)            # tensorize + pre-compile buckets
+    out, version = app.batcher.submit([[...row...]])
+
+or over HTTP: ``python -m lightgbm_tpu task=serve input_model=model.txt``.
+"""
+from .batcher import MicroBatcher, OverloadedError, RequestTimeout
+from .predictor import PredictorCache, PreparedModel
+from .registry import ModelNotFound, ModelRegistry
+from .server import ServingApp, make_http_server, run_http_server
+from .stats import LatencyHistogram, ServingStats
+
+__all__ = [
+    "MicroBatcher", "OverloadedError", "RequestTimeout",
+    "PredictorCache", "PreparedModel",
+    "ModelNotFound", "ModelRegistry",
+    "ServingApp", "make_http_server", "run_http_server",
+    "LatencyHistogram", "ServingStats",
+]

@@ -1,0 +1,256 @@
+"""Micro-batching scheduler: coalesce small requests into device batches.
+
+Single-row traffic is the worst case for an accelerator predictor — each
+dispatch pays host->device transfer and kernel launch for one row. The
+batcher amortizes that: concurrent requests queue up and a background
+worker flushes them as one padded batch when either (a) `max_batch` rows
+have accumulated or (b) the oldest request has waited `max_delay_ms`.
+
+Operational guarantees:
+
+* Admission control — a full queue (`max_queue_rows`) fast-fails new
+  requests with OverloadedError instead of building unbounded latency.
+* Per-request timeout — requests that exceed their deadline while queued
+  are failed at flush time, and waiters give up on their own clock.
+* Version consistency — the model version is resolved ONCE per request
+  (before any splitting) and once per flush group, so every row of a
+  response comes from a single model even while a hot swap lands
+  mid-flight; the version used is returned with the result.
+* Oversize requests — inputs larger than `max_batch` are split into
+  batch-sized chunks pinned to one resolved version and reassembled.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+from .stats import ServingStats
+
+
+class OverloadedError(RuntimeError):
+    """Queue depth cap hit: shed load instead of queueing."""
+
+
+class RequestTimeout(TimeoutError):
+    """Request exceeded its deadline before a result was produced."""
+
+
+class _Pending:
+    """One queued request; waiters block on `event`."""
+
+    __slots__ = ("x", "n", "version", "raw_score", "t_enqueue", "deadline",
+                 "event", "result", "result_version", "error")
+
+    def __init__(self, x, version, raw_score, timeout_s):
+        now = time.monotonic()
+        self.x = x
+        self.n = x.shape[0]
+        self.version = version           # concrete version tag
+        self.raw_score = raw_score
+        self.t_enqueue = now
+        self.deadline = now + timeout_s if timeout_s else None
+        self.event = threading.Event()
+        self.result = None
+        self.result_version = None
+        self.error = None
+
+    def finish(self, result=None, version=None, error=None):
+        self.result = result
+        self.result_version = version
+        self.error = error
+        self.event.set()
+
+    def wait(self, timeout_s: Optional[float]):
+        if not self.event.wait(timeout_s):
+            raise RequestTimeout("request timed out waiting for batch")
+        if self.error is not None:
+            raise self.error
+        return self.result, self.result_version
+
+
+class MicroBatcher:
+    """Request queue + flush worker in front of a PredictorCache.
+
+    `start=False` skips the worker thread: nothing flushes until
+    `flush()` is called, which makes batching behavior deterministic for
+    tests and embedders with their own event loop.
+    """
+
+    def __init__(self, registry, max_batch: int = 256,
+                 max_delay_ms: float = 2.0, max_queue_rows: int = 4096,
+                 default_timeout_ms: float = 5000.0,
+                 stats: Optional[ServingStats] = None, start: bool = True):
+        self.registry = registry
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1e3
+        self.max_queue_rows = int(max_queue_rows)
+        self.default_timeout_s = float(default_timeout_ms) / 1e3
+        self.stats = stats or ServingStats()
+        self._queue: deque = deque()
+        self._queued_rows = 0
+        self._cv = threading.Condition()
+        self._closed = False
+        self._worker = None
+        if start:
+            self._worker = threading.Thread(
+                target=self._run, name="lgbm-tpu-batcher", daemon=True)
+            self._worker.start()
+
+    # -- client side ----------------------------------------------------
+    def submit(self, rows, version: Optional[str] = None,
+               raw_score: bool = False,
+               timeout_ms: Optional[float] = None
+               ) -> Tuple[np.ndarray, str]:
+        """Blocking predict through the batch queue. Returns
+        (scores (N, num_class), model version used)."""
+        handles = self.submit_async(rows, version, raw_score, timeout_ms)
+        timeout_s = (self.default_timeout_s if timeout_ms is None
+                     else timeout_ms / 1e3)
+        # grace on top of the request deadline: expiry is reported by the
+        # flusher; the waiter clock is only a backstop against a dead worker
+        parts, ver = [], None
+        for h in handles:
+            out, ver = h.wait(timeout_s + 1.0)
+            parts.append(out)
+        return (parts[0] if len(parts) == 1
+                else np.concatenate(parts, axis=0)), ver
+
+    def submit_async(self, rows, version: Optional[str] = None,
+                     raw_score: bool = False,
+                     timeout_ms: Optional[float] = None) -> List[_Pending]:
+        """Enqueue without blocking for the result; returns the pending
+        handles (one per <=max_batch chunk, in row order)."""
+        x = np.ascontiguousarray(np.asarray(rows, dtype=np.float32))
+        if x.ndim == 1:
+            x = x.reshape(1, -1)
+        timeout_s = (self.default_timeout_s if timeout_ms is None
+                     else timeout_ms / 1e3)
+        # pin the version before splitting: every chunk of one request
+        # must be served by the same model even across a hot swap
+        concrete = self.registry.get(version).version
+        chunks = ([x] if x.shape[0] <= self.max_batch else
+                  [x[i:i + self.max_batch]
+                   for i in range(0, x.shape[0], self.max_batch)])
+        if len(chunks) > 1:
+            self.stats.incr("serve_requests_split")
+        handles = []
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._queued_rows + x.shape[0] > self.max_queue_rows:
+                self.stats.incr("serve_rejected_overload")
+                raise OverloadedError(
+                    f"queue full ({self._queued_rows} rows queued, "
+                    f"cap {self.max_queue_rows})")
+            for chunk in chunks:
+                item = _Pending(chunk, concrete, raw_score, timeout_s)
+                self._queue.append(item)
+                self._queued_rows += chunk.shape[0]
+                handles.append(item)
+            self.stats.incr("serve_requests")
+            self._cv.notify_all()
+        return handles
+
+    # -- flush side -----------------------------------------------------
+    def flush(self) -> int:
+        """Drain and execute one batch group synchronously; returns rows
+        flushed (0 on an empty queue — a no-op)."""
+        batch = self._pop_batch()
+        if not batch:
+            return 0
+        return self._execute(batch)
+
+    def _pop_batch(self) -> List[_Pending]:
+        """Pop a FIFO prefix of compatible requests (same version +
+        raw_score) totalling <= max_batch rows."""
+        with self._cv:
+            if not self._queue:
+                return []
+            first = self._queue[0]
+            group_key = (first.version, first.raw_score)
+            batch, rows = [], 0
+            while self._queue:
+                item = self._queue[0]
+                if (item.version, item.raw_score) != group_key:
+                    break
+                if batch and rows + item.n > self.max_batch:
+                    break
+                batch.append(self._queue.popleft())
+                rows += item.n
+            self._queued_rows -= rows
+            return batch
+
+    def _execute(self, batch: List[_Pending]) -> int:
+        now = time.monotonic()
+        live: List[_Pending] = []
+        for item in batch:
+            if item.deadline is not None and now > item.deadline:
+                self.stats.incr("serve_timeouts")
+                item.finish(error=RequestTimeout(
+                    "request expired in queue before flush"))
+            else:
+                live.append(item)
+        if not live:
+            return 0
+        version, raw_score = live[0].version, live[0].raw_score
+        x = (live[0].x if len(live) == 1
+             else np.concatenate([i.x for i in live], axis=0))
+        try:
+            t0 = time.monotonic()
+            model = self.registry.get(version)
+            out = self.registry.predictor.predict(model, x, raw_score)
+            self.stats.observe("serve_batch_exec", time.monotonic() - t0)
+            self.stats.incr("serve_batches")
+            self.stats.incr("serve_rows", x.shape[0])
+        except Exception as exc:   # noqa: BLE001 — propagate to waiters
+            log.warning("serving: batch of %d rows failed: %s",
+                        x.shape[0], exc)
+            self.stats.incr("serve_batch_errors")
+            for item in live:
+                item.finish(error=exc)
+            return x.shape[0]
+        off = 0
+        for item in live:
+            item.finish(result=out[off:off + item.n], version=version)
+            off += item.n
+        return x.shape[0]
+
+    # -- worker ---------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._queue:
+                    return
+                first = self._queue[0]
+                flush_at = first.t_enqueue + self.max_delay_s
+                # linger for more rows until the batch fills or the
+                # oldest request's coalescing deadline passes
+                while (self._queued_rows < self.max_batch
+                       and not self._closed):
+                    remaining = flush_at - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+            batch = self._pop_batch()
+            if batch:
+                self._execute(batch)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+        while True:
+            batch = self._pop_batch()
+            if not batch:
+                break
+            for item in batch:
+                item.finish(error=RuntimeError("batcher closed"))

@@ -1,0 +1,118 @@
+"""Versioned model registry with warm-up and hot swap.
+
+The serving unit of deployment is a PreparedModel: tensorized once
+(through the GBDT ensemble-arrays cache), warmed by pre-compiling the
+scoring executable for the configured batch buckets, then published
+atomically. Readers never see a half-loaded model: `get()` resolves
+against an immutable snapshot, and swapping is one dict+pointer update
+under the lock. Old versions stay queryable until `unload()`.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from ..utils import log
+from ..utils.timer import timer
+from .predictor import PredictorCache, PreparedModel
+
+DEFAULT_WARM_BUCKETS = (1, 16, 256)
+
+
+class ModelNotFound(KeyError):
+    pass
+
+
+class ModelRegistry:
+    """Holds live model versions and the shared compiled-predictor cache."""
+
+    def __init__(self, predictor: Optional[PredictorCache] = None,
+                 warm_buckets: Sequence[int] = DEFAULT_WARM_BUCKETS,
+                 warm_raw_score: Sequence[bool] = (False,)):
+        self.predictor = predictor or PredictorCache()
+        self.warm_buckets = tuple(warm_buckets)
+        self.warm_raw_score = tuple(warm_raw_score)
+        self._lock = threading.RLock()
+        self._models: Dict[str, PreparedModel] = {}
+        self._latest: Optional[str] = None
+        self._version_counter = itertools.count(1)
+
+    # ------------------------------------------------------------------
+    def load(self, source, version: Optional[str] = None,
+             num_iteration: Optional[int] = None,
+             warm: bool = True) -> str:
+        """Prepare `source` (Booster, GBDT, model string, or model file
+        path) for serving and publish it as `version` (auto 'v<N>' when
+        None). Warm-up happens BEFORE publication, so a hot swap never
+        exposes a cold model to traffic. Returns the version id."""
+        gbdt = self._to_gbdt(source)
+        if num_iteration is None:
+            # parity with Booster.predict: an early-stopped booster
+            # serves its best iteration unless told otherwise
+            best = getattr(source, "best_iteration", -1)
+            if isinstance(best, int) and best > 0:
+                num_iteration = best
+        with self._lock:
+            ver = version or f"v{next(self._version_counter)}"
+            if ver in self._models:
+                raise ValueError(f"model version {ver!r} already loaded")
+        with timer("serve_model_load"):
+            prepared = PreparedModel(gbdt, ver, num_iteration)
+            if warm:
+                for raw in self.warm_raw_score:
+                    for b in self.warm_buckets:
+                        self.predictor.warm(prepared, b, raw_score=raw)
+        with self._lock:
+            self._models[ver] = prepared
+            self._latest = ver
+        log.info("serving: loaded model %s (%d trees, %d features)",
+                 ver, prepared.n_trees, prepared.num_features)
+        return ver
+
+    def _to_gbdt(self, source):
+        if hasattr(source, "_gbdt"):           # Booster
+            return source._gbdt
+        if hasattr(source, "ensemble_arrays"):  # GBDT
+            return source
+        from ..models.gbdt import GBDT
+        if isinstance(source, str):
+            if "\n" in source or "Tree=" in source:
+                return GBDT.load_model_from_string(source)
+            return GBDT.load_model(source)
+        raise TypeError(f"cannot load model from {type(source).__name__}")
+
+    # ------------------------------------------------------------------
+    def get(self, version: Optional[str] = None) -> PreparedModel:
+        """Resolve a version tag (None/'latest' -> newest) to its model."""
+        with self._lock:
+            if version in (None, "latest"):
+                version = self._latest
+            if version is None:
+                raise ModelNotFound("no model loaded")
+            model = self._models.get(version)
+            if model is None:
+                raise ModelNotFound(f"unknown model version {version!r}")
+            return model
+
+    def unload(self, version: str) -> None:
+        with self._lock:
+            if version not in self._models:
+                raise ModelNotFound(f"unknown model version {version!r}")
+            del self._models[version]
+            if self._latest == version:
+                self._latest = (max(self._models) if self._models else None)
+
+    def versions(self) -> List[dict]:
+        with self._lock:
+            return [{"version": v,
+                     "latest": v == self._latest,
+                     "num_trees": m.n_trees,
+                     "num_features": m.num_features,
+                     "num_class": m.num_class}
+                    for v, m in sorted(self._models.items())]
+
+    @property
+    def latest(self) -> Optional[str]:
+        with self._lock:
+            return self._latest

@@ -1,0 +1,185 @@
+"""In-process serving API + stdlib HTTP front end (JSON, no deps).
+
+The app layer (`ServingApp`) is plain dict-in/dict-out so embedders and
+tests drive it without sockets; the HTTP layer is a thin
+ThreadingHTTPServer adapter over it.
+
+Endpoints:
+
+* ``POST /predict``  {"rows": [[...], ...], "raw_score": false,
+  "version": "v1" | "latest", "timeout_ms": 100} ->
+  {"predictions": [...], "version": "v1", "num_rows": N}
+* ``GET  /stats``    counters + latency histograms (p50/p95/p99) +
+  compiled-predictor cache info
+* ``GET  /models``   loaded versions
+* ``POST /models``   {"model_file": path} | {"model_str": text}
+  [, "version": tag] — load + warm + hot-swap to latest
+* ``GET  /healthz``  liveness + whether a model is loaded
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..utils import log
+from .batcher import MicroBatcher, OverloadedError, RequestTimeout
+from .registry import ModelNotFound, ModelRegistry
+from .stats import ServingStats
+
+
+class BadRequest(ValueError):
+    pass
+
+
+class ServingApp:
+    """Transport-agnostic serving facade: registry + batcher + stats."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 batcher: Optional[MicroBatcher] = None,
+                 stats: Optional[ServingStats] = None,
+                 **batcher_kwargs):
+        self.registry = registry or ModelRegistry()
+        self.stats = stats or ServingStats()
+        self.batcher = batcher or MicroBatcher(
+            self.registry, stats=self.stats, **batcher_kwargs)
+
+    # ------------------------------------------------------------------
+    def predict(self, payload: dict) -> dict:
+        rows = payload.get("rows")
+        if rows is None:
+            raise BadRequest("missing 'rows'")
+        t0 = time.monotonic()
+        out, version = self.batcher.submit(
+            rows,
+            version=payload.get("version"),
+            raw_score=bool(payload.get("raw_score", False)),
+            timeout_ms=payload.get("timeout_ms"))
+        self.stats.observe("serve_request", time.monotonic() - t0)
+        preds = (out[:, 0] if out.ndim == 2 and out.shape[1] == 1 else out)
+        return {"predictions": preds.tolist(), "version": version,
+                "num_rows": int(out.shape[0])}
+
+    def load_model(self, payload: dict) -> dict:
+        if "model_file" in payload:
+            source = payload["model_file"]
+        elif "model_str" in payload:
+            source = payload["model_str"]
+        else:
+            raise BadRequest("need 'model_file' or 'model_str'")
+        version = self.registry.load(source, version=payload.get("version"))
+        self.stats.incr("serve_model_loads")
+        return {"version": version, "latest": True}
+
+    def models(self) -> dict:
+        return {"models": self.registry.versions(),
+                "latest": self.registry.latest}
+
+    def stats_snapshot(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["predictor_cache"] = self.registry.predictor.cache_info()
+        snap["models"] = self.registry.versions()
+        return snap
+
+    def health(self) -> dict:
+        return {"status": "ok", "model_loaded": self.registry.latest
+                is not None}
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lightgbm-tpu-serve/1.0"
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def app(self) -> ServingApp:
+        return self.server.app
+
+    def log_message(self, fmt, *args):   # route to our logger, not stderr
+        log.debug("http: " + fmt, *args)
+
+    def _reply(self, code: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _payload(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length == 0:
+            return {}
+        try:
+            return json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError as exc:
+            raise BadRequest(f"invalid JSON body: {exc}") from exc
+
+    def _dispatch(self, fn) -> None:
+        try:
+            self._reply(200, fn())
+        except BadRequest as exc:
+            self._reply(400, {"error": str(exc)})
+        except ModelNotFound as exc:
+            self._reply(404, {"error": str(exc)})
+        except OverloadedError as exc:
+            self._reply(429, {"error": str(exc)})
+        except RequestTimeout as exc:
+            self._reply(504, {"error": str(exc)})
+        except ValueError as exc:
+            self._reply(400, {"error": str(exc)})
+        except Exception as exc:   # noqa: BLE001 — JSON 500, keep serving
+            log.warning("serving: internal error: %s", exc)
+            self._reply(500, {"error": str(exc)})
+
+    def do_GET(self):
+        if self.path == "/stats":
+            self._dispatch(self.app.stats_snapshot)
+        elif self.path == "/models":
+            self._dispatch(self.app.models)
+        elif self.path in ("/healthz", "/health"):
+            self._dispatch(self.app.health)
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        if self.path == "/predict":
+            self._dispatch(lambda: self.app.predict(self._payload()))
+        elif self.path == "/models":
+            self._dispatch(lambda: self.app.load_model(self._payload()))
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+
+def make_http_server(app: ServingApp, host: str = "127.0.0.1",
+                     port: int = 8080) -> ThreadingHTTPServer:
+    """Bind (port=0 for ephemeral) and return the server; caller runs
+    serve_forever(), typically via `run_http_server`."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.app = app
+    httpd.daemon_threads = True
+    return httpd
+
+
+def run_http_server(app: ServingApp, host: str = "127.0.0.1",
+                    port: int = 8080, background: bool = False):
+    httpd = make_http_server(app, host, port)
+    log.info("serving: listening on http://%s:%d (POST /predict, "
+             "GET /stats)", *httpd.server_address[:2])
+    if background:
+        t = threading.Thread(target=httpd.serve_forever,
+                             name="lgbm-tpu-http", daemon=True)
+        t.start()
+        return httpd
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:   # pragma: no cover
+        pass
+    finally:
+        httpd.server_close()
+        app.close()
+    return httpd

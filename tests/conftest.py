@@ -11,18 +11,21 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-# Persistent XLA compile cache: the suite's wall clock is dominated by
-# recompiles of the tree-growth programs (one per shape/config family);
-# warm runs cut it several-fold. Point it at a repo-local dir so CI can
-# cache the directory across runs too.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".xla_cache"))
+# Persistent XLA compile cache: DISABLED for the suite. In this image
+# (jaxlib 0.4.37, CPU backend) deserializing a cached executable written
+# by a PREVIOUS process segfaults the interpreter (reproduce: run
+# test_binning+test_bundling twice against one JAX_COMPILATION_CACHE_DIR
+# — cold run passes, warm run dies in jax array _value). The in-memory
+# jit cache still dedups within the run; cross-run caching costs
+# correctness here, so it's off. LGBM_TPU_NO_COMP_CACHE also stops the
+# package __init__ from pointing the cache at ~/.cache.
+os.environ["LGBM_TPU_NO_COMP_CACHE"] = "1"
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_compilation_cache", False)
 
 import numpy as np
 import pytest

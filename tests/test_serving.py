@@ -1,0 +1,315 @@
+"""Online-inference subsystem tests: registry, compiled-predictor cache,
+micro-batcher edge cases, and the no-recompile acceptance property."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_binary
+from lightgbm_tpu.serving import (MicroBatcher, ModelNotFound, ModelRegistry,
+                                  OverloadedError, PredictorCache,
+                                  RequestTimeout, ServingApp)
+
+# ground-truth XLA activity counter: every trace/lower/backend-compile in
+# the process records one of these duration events
+_COMPILE_EVENTS = []
+try:
+    from jax._src import monitoring as _monitoring
+
+    def _on_event(name, *a, **kw):
+        if "compile" in name:
+            _COMPILE_EVENTS.append(name)
+    _monitoring.register_event_duration_secs_listener(_on_event)
+except ImportError:   # counter unavailable: fall back to cache counters only
+    _monitoring = None
+
+
+def _train(num_boost_round=8, seed=7, n=600):
+    x, y = make_binary(n=n, f=10, seed=seed)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(x, y, free_raw_data=False),
+        num_boost_round=num_boost_round, verbose_eval=False)
+    return bst, x
+
+
+@pytest.fixture(scope="module")
+def booster():
+    return _train()
+
+
+# ---------------------------------------------------------------------------
+# predictor + registry
+
+def test_predictor_parity_and_bucketing(booster):
+    bst, x = booster
+    reg = ModelRegistry(warm_buckets=(8,))
+    reg.load(bst)
+    m = reg.get()
+    for n in (1, 3, 8, 20):
+        out = reg.predictor.predict(m, x[:n])
+        assert out.shape == (n, 1)
+        np.testing.assert_allclose(out[:, 0], bst.predict(x[:n]), atol=1e-6)
+    raw = reg.predictor.predict(m, x[:4], raw_score=True)
+    np.testing.assert_allclose(
+        raw[:, 0], bst.predict(x[:4], raw_score=True), atol=1e-6)
+
+
+def test_registry_versions_and_unload(booster):
+    bst, _ = booster
+    reg = ModelRegistry(warm_buckets=(1,))
+    v1 = reg.load(bst)
+    v2 = reg.load(bst, version="prod")
+    assert reg.latest == "prod"
+    assert [m["version"] for m in reg.versions()] == sorted([v1, v2])
+    assert reg.get("latest").version == "prod"
+    reg.unload("prod")
+    assert reg.get().version == v1
+    with pytest.raises(ModelNotFound):
+        reg.get("prod")
+    with pytest.raises(ValueError):
+        reg.load(bst, version=v1)
+
+
+def test_registry_load_from_string_and_empty(booster):
+    bst, x = booster
+    reg = ModelRegistry(warm_buckets=(1,))
+    with pytest.raises(ModelNotFound):
+        reg.get()
+    v = reg.load(bst.model_to_string())
+    out = reg.predictor.predict(reg.get(v), x[:3])
+    np.testing.assert_allclose(out[:, 0], bst.predict(x[:3]), atol=1e-6)
+
+
+def test_no_recompile_after_warmup(booster):
+    """Acceptance: after warm-up, repeated requests within the warmed
+    bucket range run with ZERO new XLA compilations, and a hot swap to a
+    same-shape model reuses the compiled predictor."""
+    bst, x = booster
+    reg = ModelRegistry(warm_buckets=(16,))
+    reg.load(bst)
+    m = reg.get()
+    compiles = reg.predictor.compile_count
+    events_before = len(_COMPILE_EVENTS)
+    for n in (1, 2, 3, 5, 7, 8, 11, 16, 16, 1):
+        reg.predictor.predict(m, x[:n])
+    assert reg.predictor.compile_count == compiles
+    assert len(_COMPILE_EVENTS) == events_before, (
+        f"unexpected XLA activity: {_COMPILE_EVENTS[events_before:]}")
+
+    # hot swap: same params/data-shape retrain -> same padded ensemble
+    # shapes -> the already-compiled executables serve it cold-start-free
+    bst2, _ = _train(seed=11)
+    reg.load(bst2, version="v2", warm=False)
+    m2 = reg.get("v2")
+    assert m2.shape_sig == m.shape_sig
+    events_before = len(_COMPILE_EVENTS)
+    out = reg.predictor.predict(m2, x[:9])
+    assert reg.predictor.compile_count == compiles
+    assert len(_COMPILE_EVENTS) == events_before
+    np.testing.assert_allclose(out[:, 0], bst2.predict(x[:9]), atol=1e-6)
+
+
+def test_ensemble_arrays_cached_between_predicts(monkeypatch):
+    """Satellite: back-to-back Booster.predict calls tensorize once;
+    model growth invalidates."""
+    from lightgbm_tpu.ops import predict as predict_ops
+    bst, x = _train(num_boost_round=4, seed=3)
+    calls = []
+    orig = predict_ops.trees_to_arrays
+
+    def counting(trees, *a, **kw):
+        calls.append(len(trees))
+        return orig(trees, *a, **kw)
+    monkeypatch.setattr(predict_ops, "trees_to_arrays", counting)
+
+    p1 = bst.predict(x[:50])
+    first = len(calls)
+    assert first >= 1
+    p2 = bst.predict(x[:50])
+    assert len(calls) == first          # cache hit: no re-tensorization
+    np.testing.assert_allclose(p1, p2)
+    bst.predict(x[:50], pred_leaf=True)  # unbucketed slice: one more
+    assert len(calls) == first + 1
+    bst.predict(x[:50], pred_leaf=True)
+    assert len(calls) == first + 1
+
+    # growth invalidates: the tree list changed, predict re-tensorizes
+    bst.update()                         # (training itself may tensorize)
+    after_update = len(calls)
+    bst.predict(x[:50])
+    assert len(calls) == after_update + 1
+
+
+def test_ensemble_cache_invalidated_by_refit():
+    bst, x = _train(num_boost_round=4, seed=5)
+    before = bst.predict(x[:20], raw_score=True)
+    _ = bst.predict(x[:20], raw_score=True)  # populate cache
+    gbdt = bst._gbdt
+    tree = gbdt.models[0]
+    for leaf in range(tree.num_leaves):      # every row's path changes
+        tree.set_leaf_output(leaf, float(tree.leaf_value[leaf]) + 5.0)
+    gbdt.invalidate_ensemble_cache()
+    after = bst.predict(x[:20], raw_score=True)
+    np.testing.assert_allclose(after, before + 5.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher edge cases (manual-flush mode: deterministic, no worker)
+
+def _manual_stack(bst, **kw):
+    reg = ModelRegistry(warm_buckets=(1,))
+    reg.load(bst)
+    kw.setdefault("max_batch", 16)
+    batcher = MicroBatcher(reg, start=False, **kw)
+    return reg, batcher
+
+
+def test_batcher_empty_flush_is_noop(booster):
+    bst, _ = booster
+    _, batcher = _manual_stack(bst)
+    assert batcher.flush() == 0
+    assert batcher.stats.get("serve_batches") == 0
+
+
+def test_batcher_coalesces_single_rows(booster):
+    bst, x = booster
+    reg, batcher = _manual_stack(bst)
+    handles = [batcher.submit_async(x[i])[0] for i in range(5)]
+    assert batcher.flush() == 5          # one batch, five requests
+    assert batcher.stats.get("serve_batches") == 1
+    for i, h in enumerate(handles):
+        out, ver = h.wait(1.0)
+        assert ver == reg.latest
+        np.testing.assert_allclose(
+            out[:, 0], bst.predict(x[i:i + 1]), atol=1e-6)
+
+
+def test_batcher_oversize_request_split_and_reassembled(booster):
+    """Request larger than the max bucket: split into max_batch chunks,
+    served across several flushes, reassembled in row order."""
+    bst, x = booster
+    reg, batcher = _manual_stack(bst, max_batch=16)
+    result = {}
+
+    def client():
+        result["out"], result["ver"] = batcher.submit(x[:50])
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    flushed = 0
+    while flushed < 50 and time.monotonic() < deadline:
+        flushed += batcher.flush() or 0
+        time.sleep(0.005)
+    t.join(timeout=10)
+    assert flushed == 50
+    assert batcher.stats.get("serve_requests_split") == 1
+    assert result["out"].shape == (50, 1)
+    np.testing.assert_allclose(
+        result["out"][:, 0], bst.predict(x[:50]), atol=1e-6)
+
+
+def test_batcher_overload_fast_fail(booster):
+    bst, x = booster
+    _, batcher = _manual_stack(bst, max_queue_rows=4)
+    batcher.submit_async(x[:3])
+    with pytest.raises(OverloadedError):
+        batcher.submit_async(x[:2])      # 3 + 2 > 4: reject immediately
+    assert batcher.stats.get("serve_rejected_overload") == 1
+    batcher.submit_async(x[:1])          # still room for 1
+    assert batcher.flush() == 4
+
+
+def test_batcher_deadline_timeout_fast_fail(booster):
+    bst, x = booster
+    _, batcher = _manual_stack(bst)
+    h = batcher.submit_async(x[:2], timeout_ms=10)[0]
+    time.sleep(0.05)                     # let the deadline lapse queued
+    batcher.flush()
+    with pytest.raises(RequestTimeout):
+        h.wait(1.0)
+    assert batcher.stats.get("serve_timeouts") == 1
+
+
+def test_batcher_waiter_timeout_without_worker(booster):
+    bst, x = booster
+    _, batcher = _manual_stack(bst)
+    h = batcher.submit_async(x[:1], timeout_ms=10)[0]
+    with pytest.raises(RequestTimeout):
+        h.wait(0.05)                     # nobody flushes: waiter gives up
+
+
+def test_batcher_hot_swap_mid_flight_versions_consistent(booster):
+    """A multi-chunk request pinned before a hot swap is served entirely
+    by the version it resolved, even though the swap lands between
+    flushes; later requests see the new version."""
+    bst, x = booster
+    reg, batcher = _manual_stack(bst, max_batch=16)
+    v1 = reg.latest
+    result = {}
+
+    def client():
+        result["out"], result["ver"] = batcher.submit(x[:40])
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    flushed = batcher.flush()            # first chunk on v1
+    bst2, _ = _train(seed=11)            # hot swap mid-flight
+    reg.load(bst2, version="v2")
+    while flushed < 40 and time.monotonic() < deadline:
+        flushed += batcher.flush() or 0
+        time.sleep(0.005)
+    t.join(timeout=10)
+    assert result["ver"] == v1
+    np.testing.assert_allclose(          # all rows from v1, no mixture
+        result["out"][:, 0], bst.predict(x[:40]), atol=1e-6)
+    out2, ver2 = batcher.submit_async(x[:3])[0], None
+    batcher.flush()
+    res2, ver2 = out2.wait(1.0)
+    assert ver2 == "v2"
+    np.testing.assert_allclose(res2[:, 0], bst2.predict(x[:3]), atol=1e-6)
+
+
+def test_batcher_background_worker_end_to_end(booster):
+    """Worker-thread mode: concurrent submits complete without manual
+    flushing and coalesce into fewer batches than requests."""
+    bst, x = booster
+    reg = ModelRegistry(warm_buckets=(16,))
+    reg.load(bst)
+    batcher = MicroBatcher(reg, max_batch=16, max_delay_ms=20.0)
+    try:
+        outs = [None] * 8
+        def client(i):
+            outs[i], _ = batcher.submit(x[i:i + 1], timeout_ms=5000)
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(
+                out[:, 0], bst.predict(x[i:i + 1]), atol=1e-6)
+        assert batcher.stats.get("serve_batches") <= 8
+    finally:
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# stats
+
+def test_latency_histogram_percentiles():
+    from lightgbm_tpu.serving.stats import LatencyHistogram
+    h = LatencyHistogram()
+    assert h.percentile(99) == 0.0
+    for ms in (1, 1, 1, 1, 1, 1, 1, 1, 1, 200):
+        h.record(ms / 1e3)
+    snap = h.snapshot()
+    assert snap["count"] == 10
+    assert snap["p50_ms"] <= 3            # ~1ms bucket upper bound
+    assert snap["p99_ms"] >= 100          # tail sees the 200ms outlier
+    assert snap["max_ms"] == pytest.approx(200.0)

@@ -1,0 +1,152 @@
+"""HTTP front end + CLI serve task + sparse-tail bucketing tests."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_binary
+from lightgbm_tpu.serving import ModelRegistry, ServingApp, make_http_server
+
+
+def _train(num_boost_round=8, seed=7):
+    x, y = make_binary(n=600, f=10, seed=seed)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(x, y, free_raw_data=False),
+        num_boost_round=num_boost_round, verbose_eval=False)
+    return bst, x
+
+
+@pytest.fixture(scope="module")
+def served():
+    bst, x = _train()
+    registry = ModelRegistry(warm_buckets=(8,))
+    registry.load(bst)
+    app = ServingApp(registry, max_batch=32, max_delay_ms=2.0,
+                     max_queue_rows=256)
+    httpd = make_http_server(app, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield base, bst, x, app
+    httpd.shutdown()
+    httpd.server_close()
+    app.close()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=15) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_http_predict_and_health(served):
+    base, bst, x, _ = served
+    status, health = _get(base + "/healthz")
+    assert status == 200 and health["model_loaded"]
+    status, out = _post(base + "/predict", {"rows": x[:4].tolist()})
+    assert status == 200
+    assert out["num_rows"] == 4
+    np.testing.assert_allclose(
+        out["predictions"], bst.predict(x[:4]), atol=1e-6)
+    status, raw = _post(base + "/predict",
+                        {"rows": x[:4].tolist(), "raw_score": True})
+    np.testing.assert_allclose(
+        raw["predictions"], bst.predict(x[:4], raw_score=True), atol=1e-6)
+
+
+def test_http_stats_and_models(served):
+    base, _, x, _ = served
+    _post(base + "/predict", {"rows": x[:2].tolist()})
+    status, stats = _get(base + "/stats")
+    assert status == 200
+    assert stats["counters"]["serve_requests"] >= 1
+    lat = stats["latency"]["serve_request"]
+    assert lat["count"] >= 1 and lat["p99_ms"] >= lat["p50_ms"]
+    assert stats["predictor_cache"]["compiles"] >= 1
+    status, models = _get(base + "/models")
+    assert status == 200 and models["latest"] in [
+        m["version"] for m in models["models"]]
+
+
+def test_http_hot_swap_roundtrip(served):
+    base, _, x, _ = served
+    bst2, _ = _train(seed=23)
+    status, out = _post(base + "/models",
+                        {"model_str": bst2.model_to_string(),
+                         "version": "swapped"})
+    assert status == 200 and out["version"] == "swapped"
+    status, pred = _post(base + "/predict",
+                         {"rows": x[:3].tolist(), "version": "swapped"})
+    np.testing.assert_allclose(
+        pred["predictions"], bst2.predict(x[:3]), atol=1e-6)
+    status, pred = _post(base + "/predict", {"rows": x[:3].tolist()})
+    assert pred["version"] == "swapped"   # latest moved
+
+
+def test_http_error_paths(served):
+    base, _, _, _ = served
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base + "/predict", {})
+    assert exc.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(base + "/predict", {"rows": [[0.0] * 10],
+                                  "version": "no-such"})
+    assert exc.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(base + "/nope")
+    assert exc.value.code == 404
+
+
+def test_cli_serve_task(tmp_path):
+    """task=serve loads + warms the model and binds the HTTP server."""
+    from lightgbm_tpu.cli import _serve
+    bst, x = _train()
+    model_file = tmp_path / "model.txt"
+    bst.save_model(str(model_file))
+    httpd = _serve({"task": "serve", "input_model": str(model_file),
+                    "serve_port": "0", "serve_warm_buckets": "4",
+                    "serve_max_batch": "32"}, block=False)
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        status, out = _post(base + "/predict", {"rows": x[:2].tolist()})
+        assert status == 200
+        np.testing.assert_allclose(
+            out["predictions"], bst.predict(x[:2]), atol=1e-6)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        httpd.app.close()
+
+
+def test_sparse_tail_batch_bucketed(monkeypatch):
+    """Satellite: the ragged CSR tail chunk is padded to a power-of-two
+    bucket, so its shape is reused instead of compiling per tail size."""
+    sp = pytest.importorskip("scipy.sparse")
+    from lightgbm_tpu import basic as basic_mod
+    bst, x = _train(num_boost_round=4)
+    monkeypatch.setattr(basic_mod, "_SPARSE_PREDICT_BATCH", 64)
+    seen = []
+    gbdt = bst._gbdt
+    orig = gbdt.predict
+
+    def spy(mat, **kw):
+        seen.append(np.asarray(mat).shape[0])
+        return orig(mat, **kw)
+    monkeypatch.setattr(gbdt, "predict", spy)
+
+    xs = sp.csr_matrix(x[:150])          # batches: 64, 64, tail 22 -> 32
+    out = bst.predict(xs)
+    assert seen == [64, 64, 32]
+    np.testing.assert_allclose(out, bst.predict(x[:150]), atol=1e-6)

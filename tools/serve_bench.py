@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Serving microbench: online-inference latency + throughput.
+
+Trains a small model, loads it into the serving stack (registry warm-up +
+micro-batcher), then drives closed-loop traffic from several client
+threads and reports tail latency and row throughput.
+
+Prints ONE JSON line in the bench.py record shape: {"metric", "value",
+"unit", "vs_baseline"} plus diagnostics ("p50_ms", "p95_ms", "p99_ms",
+"compiles_after_warm", "backend", ...). vs_baseline is null: the source
+paper benchmarks training only; this record seeds the serving baseline.
+
+Env knobs: SERVE_BENCH_SECS (default 3), SERVE_BENCH_CLIENTS (8),
+SERVE_BENCH_ROWS_PER_REQ (1), SERVE_BENCH_MAX_BATCH (256),
+SERVE_BENCH_DELAY_MS (2), SERVE_BENCH_TRAIN_ROWS (5000),
+SERVE_BENCH_LEAVES (31), SERVE_BENCH_TREES (10) — raise the last three
+on a real accelerator for a production-shaped ensemble; the defaults
+keep a cold-CPU run inside a CI budget (serving latency is dominated by
+dispatch + batch shape, not ensemble size, once compiled).
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import ModelRegistry, ServingApp
+from lightgbm_tpu.serving.stats import LatencyHistogram
+
+DUR_SECS = float(os.environ.get("SERVE_BENCH_SECS", 3))
+CLIENTS = int(os.environ.get("SERVE_BENCH_CLIENTS", 8))
+ROWS_PER_REQ = int(os.environ.get("SERVE_BENCH_ROWS_PER_REQ", 1))
+MAX_BATCH = int(os.environ.get("SERVE_BENCH_MAX_BATCH", 256))
+DELAY_MS = float(os.environ.get("SERVE_BENCH_DELAY_MS", 2.0))
+TRAIN_ROWS = int(os.environ.get("SERVE_BENCH_TRAIN_ROWS", 5000))
+N_LEAVES = int(os.environ.get("SERVE_BENCH_LEAVES", 31))
+N_TREES = int(os.environ.get("SERVE_BENCH_TREES", 10))
+N_FEATURES = 28
+
+
+def main() -> None:
+    r = np.random.RandomState(0)
+    x = r.randn(TRAIN_ROWS, N_FEATURES).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] * x[:, 2] + 0.3 * r.randn(len(x)) > 0)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": N_LEAVES, "verbosity": -1,
+         "max_bin": 63},
+        lgb.Dataset(x, y.astype(np.float64), free_raw_data=False),
+        num_boost_round=N_TREES, verbose_eval=False)
+
+    registry = ModelRegistry(
+        warm_buckets=(ROWS_PER_REQ, MAX_BATCH))
+    app = ServingApp(registry, max_batch=MAX_BATCH, max_delay_ms=DELAY_MS,
+                     max_queue_rows=MAX_BATCH * 16)
+    t0 = time.perf_counter()
+    registry.load(bst)
+    warm_secs = time.perf_counter() - t0
+    compiles_warm = registry.predictor.compile_count
+
+    hist = LatencyHistogram()
+    hist_lock = threading.Lock()
+    stop = threading.Event()
+    counts = [0] * CLIENTS
+    errors = [0] * CLIENTS
+
+    def client(ci: int) -> None:
+        rs = np.random.RandomState(ci)
+        while not stop.is_set():
+            req = x[rs.randint(0, len(x) - ROWS_PER_REQ)
+                    :][:ROWS_PER_REQ]
+            t = time.perf_counter()
+            try:
+                app.batcher.submit(req, timeout_ms=10_000)
+            except Exception:
+                errors[ci] += 1
+                continue
+            with hist_lock:
+                hist.record(time.perf_counter() - t)
+            counts[ci] += 1
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(CLIENTS)]
+    bench_t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(DUR_SECS)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    elapsed = time.perf_counter() - bench_t0
+    app.close()
+
+    total_reqs = sum(counts)
+    snap = hist.snapshot()
+    print(json.dumps({
+        "metric": "serve_throughput",
+        "value": round(total_reqs * ROWS_PER_REQ / max(elapsed, 1e-9), 1),
+        "unit": "rows/sec",
+        "vs_baseline": None,
+        "p50_ms": round(snap["p50_ms"], 3),
+        "p95_ms": round(snap["p95_ms"], 3),
+        "p99_ms": round(snap["p99_ms"], 3),
+        "mean_ms": round(snap["mean_ms"], 3),
+        "requests": total_reqs,
+        "errors": sum(errors),
+        "clients": CLIENTS,
+        "rows_per_request": ROWS_PER_REQ,
+        "max_batch": MAX_BATCH,
+        "max_delay_ms": DELAY_MS,
+        "warmup_secs": round(warm_secs, 3),
+        "compiles_after_warm":
+            registry.predictor.compile_count - compiles_warm,
+        "batches": app.stats.get("serve_batches"),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()
