@@ -199,7 +199,9 @@ def main():
               "LGBM_TPU_PACK_WORDS", "LGBM_TPU_PALLAS",
               "LGBM_TPU_DP_REDUCE", "LGBM_TPU_PARTITION",
               "LGBM_TPU_CHUNK", "LGBM_TPU_CHUNK_NO_FUSE_HIST",
-              "BENCH_CAT_FEATURES") if k in os.environ}
+              "LGBM_TPU_HIST_CHUNK",
+              "BENCH_CAT_FEATURES", "BENCH_QUANTIZED",
+              "BENCH_GRAD_BITS") if k in os.environ}
     sys.stderr.write(f"rows={N_ROWS} iters={N_ITERS} knobs={knobs}\n")
 
     # any capped run (explicit CPU or fallback) is not comparable to the
@@ -219,6 +221,13 @@ def main():
         "verbosity": -1,
         "min_data_in_leaf": 20,
     }
+    # quantized-gradient A/B lever: BENCH_QUANTIZED=1 trains with int
+    # histograms (one i8 contraction instead of the bf16 hi/lo pair)
+    quantized = os.environ.get("BENCH_QUANTIZED", "0") == "1"
+    grad_bits = int(os.environ.get("BENCH_GRAD_BITS", 8))
+    if quantized:
+        params.update(quantized_grad=True, grad_bits=grad_bits)
+    hist_dtype = f"int{grad_bits}" if quantized else "bf16x2"
     cat_cols = list(range(N_FEATURES - N_CAT, N_FEATURES)) if N_CAT else []
     ds = lgb.Dataset(x, y, categorical_feature=cat_cols or None)
     ds.construct()
@@ -325,6 +334,10 @@ def main():
         "auc_target": AUC_TARGET,
         "sec_to_auc": sec_to_auc,
         "warmup_secs": round(warmup_secs, 3),
+        # histogram-path diagnostics so the trajectory distinguishes the
+        # float (bf16 hi/lo) and quantized (integer) pipelines
+        "quantized": quantized,
+        "hist_dtype": hist_dtype,
     }))
 
 

@@ -231,6 +231,13 @@ class Config:
     def is_parallel(self) -> bool:
         return self.tree_learner not in ("serial",)
 
+    @property
+    def quant_bits(self) -> int:
+        """The ONE resolution point of the quantized-gradient knobs:
+        grad_bits when quantized_grad is on, else 0 (float histograms).
+        Learners key their jit caches on this static."""
+        return int(self.grad_bits) if self.quantized_grad else 0
+
     def to_dict(self) -> Dict[str, Any]:
         return {p["name"]: getattr(self, p["name"]) for p in PARAMS}
 

@@ -125,19 +125,32 @@ def _merge_num_cat(res: split_ops.SplitResult, cres) -> tuple:
     return merged, cm
 
 
-def _hist_t(codes_t, gh, num_bins, use_pallas):
+def _hist_t(codes_t, gh, num_bins, use_pallas, hist_chunk=0):
     if use_pallas:
         return build_histogram_pallas_t(codes_t, gh, num_bins)
     from ..ops.histogram import build_histogram
     return build_histogram(jnp.swapaxes(codes_t, 0, 1), gh, num_bins,
-                           use_pallas=False)
+                           chunk_size=hist_chunk, use_pallas=False)
+
+
+def _hist_t_q(codes_t, ghq, num_bins, use_pallas, hist_chunk=0):
+    """Quantized histogram over transposed codes: EXACT int32 sums from
+    ONE integer one-hot contraction (no bf16 hi/lo pair)."""
+    if use_pallas:
+        from ..ops.pallas.histogram_kernel import \
+            build_histogram_pallas_quantized_t
+        return build_histogram_pallas_quantized_t(codes_t, ghq, num_bins)
+    from ..ops.histogram import build_histogram_quantized
+    return build_histogram_quantized(jnp.swapaxes(codes_t, 0, 1), ghq,
+                                     num_bins, chunk_size=hist_chunk,
+                                     use_pallas=False)
 
 
 def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
                   f_penalty, f_elide, hist_idx, *, num_bins, max_depth,
                   l1, l2, max_delta_step, min_data_in_leaf, min_sum_hessian,
                   min_gain_to_split, bynode_k,
-                  f_categorical=None, cat_statics=None):
+                  f_categorical=None, cat_statics=None, dequant=None):
     """Shared pieces of both growth strategies: per-node feature sampling,
     the (expand + scan + materialize) split search, and per-leaf best-state
     stores with depth gating.
@@ -148,7 +161,12 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
     same expanded histogram and the better gain wins (the in-program analog
     of SerialTreeLearner._merge_categorical). scan then returns
     (SplitResult, left-bin mask) where the mask is all-zero for a numerical
-    winner; without cat_statics the mask is a (1,) placeholder."""
+    winner; without cat_statics the mask is a (1,) placeholder.
+
+    dequant (quantized-grad path): maps an EXACT int32 column histogram
+    to f32 with the iteration's scales right before the split scan — the
+    integer domain carries construction, pooling and sibling subtraction,
+    the gain arithmetic stays f32."""
     f = f_numbins.shape[0]
     has_cat = cat_statics is not None
     cat_b = num_bins if has_cat else 1
@@ -174,6 +192,8 @@ def _tree_helpers(base_mask, f_numbins, f_missing, f_default, f_monotone,
         return base_mask & (u <= kth)
 
     def scan(col_hist, sg, sh, cnt, mn, mx, fmask):
+        if dequant is not None:
+            col_hist = dequant(col_hist)
         hist = bundle_ops.expand_column_hist(
             col_hist, jnp.stack([sg, sh, cnt]), hist_idx, f_elide, f_default)
         rel, t, use_m1, prefix = split_ops.per_feature_best(
@@ -276,7 +296,8 @@ def split_epilogue(*, k, key, l, new_id, row, mono_f, best_cat_l,
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "col_bins", "max_depth",
-                     "bynode_k", "use_pallas", "cat_statics"))
+                     "bynode_k", "use_pallas", "cat_statics", "quant_bits",
+                     "hist_chunk"))
 def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
               grad: jax.Array, hess: jax.Array,   # (N,)
               w: jax.Array,               # (N,) bagging weight (0/1)
@@ -292,13 +313,36 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
               l1: float, l2: float, max_delta_step: float,
               min_data_in_leaf: int, min_sum_hessian: float,
               min_gain_to_split: float, bynode_k: int, use_pallas: bool,
-              cat_statics=None):
+              cat_statics=None, quant_bits: int = 0, hist_chunk: int = 0):
     c_cols, n = codes_t.shape
     f = f_numbins.shape[0]
     L = num_leaves
     has_cat = cat_statics is not None
     cat_b = num_bins if has_cat else 1
-    gh = jnp.stack([grad * w, hess * w, w], axis=1)     # (N, 3)
+    # quant_bits > 0 switches the whole histogram pipeline to the
+    # quantized-gradient formulation (ops/quantize.py): the gh operand,
+    # the pool and the sibling subtraction are EXACT int32, and the split
+    # scans dequantize with the iteration's scales. The jit cache keys on
+    # quant_bits (the hist dtype), so the float program is untouched.
+    if quant_bits:
+        from ..ops import quantize as quant_ops
+        rng_key, qkey = jax.random.split(rng_key)
+        packed, s_g, s_h = quant_ops.quantize_gh.__wrapped__(
+            grad * w, hess * w, qkey, grad_bits=quant_bits)
+        gh = quant_ops.gh_operand(packed, w > 0, quant_bits)  # (N, 3) int
+        scale3 = quant_ops.dequant_scale3(s_g, s_h)
+
+        def dequant(hq):
+            return hq.astype(jnp.float32) * scale3
+
+        def hist_fn(ghx):
+            return _hist_t_q(codes_t, ghx, col_bins, use_pallas, hist_chunk)
+    else:
+        gh = jnp.stack([grad * w, hess * w, w], axis=1)     # (N, 3)
+        dequant = None
+
+        def hist_fn(ghx):
+            return _hist_t(codes_t, ghx, col_bins, use_pallas, hist_chunk)
     node_mask, scan, store_best, scan2, best_row = _tree_helpers(
         base_mask, f_numbins, f_missing, f_default, f_monotone, f_penalty,
         f_elide, hist_idx,
@@ -306,11 +350,13 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
         max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
         min_sum_hessian=min_sum_hessian, min_gain_to_split=min_gain_to_split,
         bynode_k=bynode_k, f_categorical=f_categorical,
-        cat_statics=cat_statics)
+        cat_statics=cat_statics, dequant=dequant)
 
     # ---- root ------------------------------------------------------------
-    hist0 = _hist_t(codes_t, gh, col_bins, use_pallas)
+    hist0 = hist_fn(gh)
     totals = hist0[0].sum(axis=0)                       # (3,): sum_g, sum_h, cnt
+    if quant_bits:
+        totals = dequant(totals)
     root_key, loop_key = jax.random.split(rng_key)
     root_res, root_cm = scan(hist0, totals[0], totals[1], totals[2],
                              jnp.float32(-np.inf), jnp.float32(np.inf),
@@ -323,7 +369,9 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
     # may split iff d < max_depth, reference _splittable); root sits at 0
     best, best_cat = store_best(best, best_cat, 0, root_res, root_cm,
                                 jnp.int32(0))
-    pool = jnp.zeros((L, c_cols, col_bins, 3), jnp.float32).at[0].set(hist0)
+    # pool dtype follows the histogram dtype: int32 on the quantized path
+    # (parent - child below is then bit-exact integer subtraction)
+    pool = jnp.zeros((L, c_cols, col_bins, 3), hist0.dtype).at[0].set(hist0)
     rec = jnp.zeros((L - 1, 13), jnp.float32)
     zi = functools.partial(jnp.zeros, dtype=jnp.int32)
     carry = _Carry(
@@ -362,8 +410,8 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
         lmask = parent & go_left
         leaf_id = jnp.where(parent & ~go_left, new_id, c.leaf_id)
 
-        ghl = gh * lmask[:, None].astype(jnp.float32)
-        hist_l = _hist_t(codes_t, ghl, col_bins, use_pallas)
+        ghl = gh * lmask[:, None].astype(gh.dtype)
+        hist_l = hist_fn(ghl)
         hist_r = c.pool[l] - hist_l
         pool = c.pool.at[l].set(hist_l).at[new_id].set(hist_r)
 
@@ -1739,6 +1787,11 @@ def resolve_strategy(config: Config, dataset: Dataset,
     strat = forced or strategy_env()
     if strat == "auto":
         strat = "compact" if dataset.num_data >= 65536 else "masked"
+        # the quantized-gradient pipeline lives on the masked program
+        # (int pool + dequantized scans); the packed compact/chunk cores
+        # bitcast f32 gh into their working buffer and stay float-only
+        if config.quant_bits:
+            strat = "masked"
     if strat == "chunk":
         _, pool_slots = plan_histogram_pool(config, dataset)
         if pool_slots > 0:
@@ -1842,6 +1895,10 @@ class DeviceTreeLearner:
         # build into the matmul pipeline better than Mosaic schedules it),
         # so the fused XLA path is the default even on TPU.
         self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
+        # quantized-gradient training: >0 switches the masked grow_tree
+        # to exact int32 histograms (jit cache keys on this static)
+        self.quant_bits = config.quant_bits
+        self.hist_chunk = int(config.hist_chunk_size or 0)
         requested = strategy or strategy_env()
         self.strategy = resolve_strategy(config, dataset, strategy)
         # partition formulation: sort | scan | pallas (explicit
@@ -1979,6 +2036,12 @@ class DeviceTreeLearner:
         # check the learner they will actually build.
         slot_bytes, pool_slots = plan_histogram_pool(config, dataset)
         strat = resolve_strategy(config, dataset, strategy)
+        if config.quant_bits and strat != "masked":
+            # quantized growth is implemented on the masked strategy only;
+            # learners that force compact/chunk (the sharded device
+            # subclasses) fall back to the host-loop learners, which
+            # carry the full quantized pipeline
+            return False
         if strat == "compact" and pool_slots > 0:
             slots = pool_slots
         else:
@@ -2096,7 +2159,9 @@ class DeviceTreeLearner:
             self.f_numbins, self.f_missing, self.f_default,
             self.f_monotone, self.f_penalty, self.f_categorical,
             self.f_col, self.f_base,
-            self.f_elide, self.hist_idx, key, **self._statics())
+            self.f_elide, self.hist_idx, key,
+            quant_bits=self.quant_bits, hist_chunk=self.hist_chunk,
+            **self._statics())
 
     def replay_tree(self, rec_h, k: int, rec_cat_h=None) -> Tree:
         """Materialize a host Tree from the fetched (L-1, 13) split-record
@@ -2194,7 +2259,8 @@ class DeviceTreeLearner:
                 trivial_weights=bag_compact
                 or (goss is None and not bag_on))
         else:
-            grow, grow_kw = grow_tree, {}
+            grow, grow_kw = grow_tree, dict(quant_bits=self.quant_bits,
+                                            hist_chunk=self.hist_chunk)
 
         obj_keys = objective_buffer_names(objective)
 
@@ -2250,7 +2316,7 @@ class DeviceTreeLearner:
             else:
                 rec, rec_cat, leaf_id, k, _ = grow(
                     codes_pack, g, h, w, base_mask, *meta, tree_key,
-                    **statics)
+                    **grow_kw, **statics)
 
             # on-device leaf-value replay avoids any H2D of leaf values.
             # The k == 0 gate makes the returned score EXACTLY the input

@@ -55,9 +55,22 @@ class ScoreUpdater:
                 init = s.reshape(num_class, n)
             else:
                 init = np.tile(s.reshape(1, n), (num_class, 1))
-        self.score = jnp.asarray(init)
+        self._score = jnp.asarray(init)
+        self._host_cache: Optional[np.ndarray] = None
         (self.f_numbins, self.f_missing, self.f_default,
          _, _) = dataset.feature_meta_arrays()
+
+    # `score` is a property so that EVERY mutation — the .at updates
+    # below AND the direct assignments from the fused/pipelined paths —
+    # invalidates the cached host copy exactly once.
+    @property
+    def score(self) -> jax.Array:
+        return self._score
+
+    @score.setter
+    def score(self, value: jax.Array) -> None:
+        self._score = value
+        self._host_cache = None
 
     def add_constant(self, val: float, class_id: int) -> None:
         self.score = self.score.at[class_id].add(jnp.float32(val))
@@ -87,7 +100,14 @@ class ScoreUpdater:
         self.score = self.score.at[class_id].multiply(jnp.float32(factor))
 
     def host_scores(self) -> np.ndarray:
-        return np.asarray(jax.device_get(self.score), dtype=np.float64)
+        """Host f64 copy of the scores, cached per score version: multi-
+        metric / multi-valid eval of one iteration fetches the device
+        array ONCE instead of a fresh device_get + f64 convert per
+        metric. Callers treat the returned array as read-only."""
+        if self._host_cache is None:
+            self._host_cache = np.asarray(
+                jax.device_get(self._score), dtype=np.float64)
+        return self._host_cache
 
 
 class GBDT:

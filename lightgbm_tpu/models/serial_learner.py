@@ -80,6 +80,13 @@ class SerialTreeLearner:
         # XLA's fused one-hot contraction measured faster than the Pallas
         # kernel on v5e (tools/microbench_injit.py); opt-in only.
         self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
+        # quantized-gradient training (ops/quantize.py): per-iteration
+        # int discretization, exact integer histograms, bit-exact sibling
+        # subtraction; 0 = float path (default, unchanged)
+        self._quant_bits = config.quant_bits
+        self._hist_chunk = int(config.hist_chunk_size or 0)
+        self._gh_packed = None
+        self._gh_scales = None
         self._mono_enabled = bool(np.any(np.asarray(self.f_monotone) != 0))
         # feature_contri gain multipliers (reference FeatureMetainfo penalty)
         contri = config.feature_contri or []
@@ -154,12 +161,23 @@ class SerialTreeLearner:
         return hist_ops.gather_and_build(
             self.binned, indices_buf, grad, hess,
             jnp.int32(begin), jnp.int32(count),
-            num_bins=self.device_bins, bucket=_bucket(count, self.max_bucket))
+            num_bins=self.device_bins, bucket=_bucket(count, self.max_bucket),
+            chunk_size=self._hist_chunk)
+
+    def _hist_f32(self, hist):
+        """Leaf histogram as f32 for scan consumers: identity on the
+        float path, scale-rescaled dequantization on the quantized path
+        (the pool itself stays exact int32)."""
+        if self._quant_bits and hist is not None:
+            from ..ops.quantize import dequantize_histogram
+            return dequantize_histogram(hist, *self._gh_scales)
+        return hist
 
     def _scan_leaf(self, leaf: _LeafState, feature_mask) -> dict:
         """Run the split scan for a leaf; returns a host-side split record."""
         res = split_ops.find_best_split(
-            leaf.hist, jnp.float32(leaf.sum_grad), jnp.float32(leaf.sum_hess),
+            self._hist_f32(leaf.hist), jnp.float32(leaf.sum_grad),
+            jnp.float32(leaf.sum_hess),
             jnp.float32(leaf.count), self.f_numbins, self.f_missing,
             self.f_default, feature_mask & (self.f_categorical == 0),
             self.f_monotone, jnp.float32(leaf.min_c), jnp.float32(leaf.max_c),
@@ -167,7 +185,7 @@ class SerialTreeLearner:
         rec = self._fetch_split(res)
         if self._has_categorical:
             cres = split_ops.find_best_split_categorical(
-                leaf.hist, jnp.float32(leaf.sum_grad),
+                self._hist_f32(leaf.hist), jnp.float32(leaf.sum_grad),
                 jnp.float32(leaf.sum_hess), jnp.float32(leaf.count),
                 self.f_numbins, self.f_missing,
                 feature_mask & (self.f_categorical == 1),
@@ -236,12 +254,35 @@ class SerialTreeLearner:
 
         tree = Tree(cfg.num_leaves)
         root_cost = self._cegb_cost(bag_cnt)
-        root_hist, totals_dev, root_res = fused_ops.fused_root_step(
-            indices_buf, self.binned, grad, hess, jnp.int32(bag_cnt),
-            self._fused_meta(base_mask, rng),
-            None if root_cost is None else jnp.asarray(root_cost),
-            bucket=_bucket(bag_cnt, self.max_bucket),
-            use_pallas=self._use_pallas, **self._scan_args())
+        if self._quant_bits:
+            # per-iteration (per-class: each class's tree quantizes its
+            # own gradient vector) discretization with stochastic
+            # rounding; one packed int32 lane per row rides the whole
+            # tree, histograms are exact int32
+            from ..ops import quantize as quant_ops
+            qkey = jax.random.PRNGKey(
+                (cfg.feature_fraction_seed * 9973 + 2 * iter_seed + 1)
+                % (2**31 - 1))
+            self._gh_packed, s_g, s_h = quant_ops.quantize_gh(
+                grad, hess, qkey, grad_bits=self._quant_bits)
+            self._gh_scales = (s_g, s_h)
+            self._scales_vec = jnp.stack([s_g, s_h])
+            root_hist, totals_dev, root_res = fused_ops.fused_root_step_q(
+                indices_buf, self.binned, self._gh_packed,
+                self._scales_vec, jnp.int32(bag_cnt),
+                self._fused_meta(base_mask, rng),
+                None if root_cost is None else jnp.asarray(root_cost),
+                bucket=_bucket(bag_cnt, self.max_bucket),
+                grad_bits=self._quant_bits, hist_chunk=self._hist_chunk,
+                use_pallas=self._use_pallas, **self._scan_args())
+        else:
+            root_hist, totals_dev, root_res = fused_ops.fused_root_step(
+                indices_buf, self.binned, grad, hess, jnp.int32(bag_cnt),
+                self._fused_meta(base_mask, rng),
+                None if root_cost is None else jnp.asarray(root_cost),
+                bucket=_bucket(bag_cnt, self.max_bucket),
+                hist_chunk=self._hist_chunk,
+                use_pallas=self._use_pallas, **self._scan_args())
         totals = jax.device_get(totals_dev)
         root = _LeafState(0, bag_cnt, float(totals[0]), float(totals[1]), 0)
         root.hist = root_hist
@@ -295,7 +336,8 @@ class SerialTreeLearner:
         merges with the numerical winner on host."""
         feature_mask = jnp.asarray(base_mask) & (self.f_categorical == 1)
         cres = split_ops.find_best_split_categorical(
-            st.hist, jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
+            self._hist_f32(st.hist), jnp.float32(st.sum_grad),
+            jnp.float32(st.sum_hess),
             jnp.float32(st.count), self.f_numbins, self.f_missing,
             feature_mask, jnp.float32(st.min_c), jnp.float32(st.max_c),
             **self._cat_scan_args())
@@ -345,12 +387,23 @@ class SerialTreeLearner:
             self._cegb_feature_used[inner_f] = True
         else:
             child_costs = None
-        out = fused_ops.fused_split_step(
-            indices_buf, self.binned, grad, hess,
-            jnp.asarray(iparams), jnp.asarray(bits.view(np.int32)),
-            jnp.asarray(fparams), st.hist,
-            self._fused_meta(base_mask, rng), child_costs,
-            bucket=bucket, use_pallas=self._use_pallas, **self._scan_args())
+        if self._quant_bits:
+            out = fused_ops.fused_split_step_q(
+                indices_buf, self.binned, self._gh_packed,
+                jnp.asarray(iparams), jnp.asarray(bits.view(np.int32)),
+                jnp.asarray(fparams), st.hist, self._scales_vec,
+                self._fused_meta(base_mask, rng), child_costs,
+                bucket=bucket, grad_bits=self._quant_bits,
+                hist_chunk=self._hist_chunk,
+                use_pallas=self._use_pallas, **self._scan_args())
+        else:
+            out = fused_ops.fused_split_step(
+                indices_buf, self.binned, grad, hess,
+                jnp.asarray(iparams), jnp.asarray(bits.view(np.int32)),
+                jnp.asarray(fparams), st.hist,
+                self._fused_meta(base_mask, rng), child_costs,
+                bucket=bucket, hist_chunk=self._hist_chunk,
+                use_pallas=self._use_pallas, **self._scan_args())
 
         # ONE host fetch per split: left_count + the two winner tuples
         left_cnt, left_rec_raw, right_rec_raw = jax.device_get(
@@ -447,7 +500,9 @@ class SerialTreeLearner:
         """Split record for a FIXED (feature, bin) from the leaf histogram
         (reference: feature_histogram.hpp:281-419 GatherInfoForThreshold)."""
         cfg = self.config
-        hrow = np.asarray(jax.device_get(st.hist[inner_f]), dtype=np.float64)
+        hrow = np.asarray(
+            jax.device_get(self._hist_f32(st.hist)[inner_f]),
+            dtype=np.float64)
         nb = int(np.asarray(self.f_numbins)[inner_f])
         lg, lh, lc = hrow[: bin_thr + 1].sum(axis=0)
         rg, rh, rc = st.sum_grad - lg, st.sum_hess - lh, st.count - lc

@@ -109,3 +109,81 @@ def build_histogram_pallas_t(codes_t: jax.Array, gh: jax.Array, num_bins: int,
     if pad_f:
         out = out[:f]
     return out
+
+
+def _hist_kernel_q(codes_ref, ghq_ref, out_ref, *, num_bins: int,
+                   op_bits: int):
+    """Integer variant of _hist_kernel: ONE i8 (or i32) matmul per tile
+    accumulating EXACT int32 per-bin sums — no hi/lo split operand, no
+    recombination pass, and a (C, 4) operand instead of (C, 6)."""
+    p_idx = pl.program_id(1)
+
+    @pl.when(p_idx == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    op_dtype = jnp.int8 if op_bits <= 8 else jnp.int32
+    ghq = ghq_ref[...].astype(op_dtype)                # (C, 4)
+    codes = codes_ref[...].astype(jnp.int32)           # (Ft, C)
+    ft, c = codes.shape
+    iota = jax.lax.broadcasted_iota(jnp.int32, (ft, num_bins, c), 1)
+    onehot = (codes[:, None, :] == iota).astype(op_dtype)  # (Ft, B, C)
+    part = jax.lax.dot_general(
+        onehot.reshape(ft * num_bins, c), ghq,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )                                                  # (Ft*B, 4)
+    out_ref[...] += part.reshape(ft, num_bins, 4)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "chunk_rows", "interpret"))
+def build_histogram_pallas_quantized(binned_rows: jax.Array, ghq: jax.Array,
+                                     num_bins: int, chunk_rows: int = 2048,
+                                     interpret: bool = False) -> jax.Array:
+    """(P, F) codes + (P, 3) int [qg, qh, valid] -> (F, B, 3) int32."""
+    return build_histogram_pallas_quantized_t(
+        binned_rows.T, ghq, num_bins, chunk_rows=chunk_rows,
+        interpret=interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "chunk_rows", "interpret"))
+def build_histogram_pallas_quantized_t(codes_t: jax.Array, ghq: jax.Array,
+                                       num_bins: int, chunk_rows: int = 2048,
+                                       interpret: bool = False) -> jax.Array:
+    """(F, P) transposed codes + (P, 3) int [qg, qh, valid] ->
+    (F, B, 3) int32 exact histogram.
+
+    Same tiling contract as build_histogram_pallas_t; the operand rides
+    as int32 blocks (Mosaic's narrow-int tiling is stricter) and is cast
+    to int8 inside the kernel when the quantization fits, so the MXU
+    still sees the native i8 contraction. Pad rows must carry ghq == 0.
+    """
+    f, p = codes_t.shape
+    op_bits = 8 if ghq.dtype == jnp.int8 else 32
+    pad_p = (-p) % chunk_rows
+    pad_f = (-f) % FEAT_TILE
+    if pad_p or pad_f:
+        codes_t = jnp.pad(codes_t, ((0, pad_f), (0, pad_p)))
+    ghq4 = jnp.pad(ghq.astype(jnp.int32), ((0, pad_p), (0, 1)))  # (P, 4)
+    pp, ff = p + pad_p, f + pad_f
+
+    grid = (ff // FEAT_TILE, pp // chunk_rows)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_q, num_bins=num_bins,
+                         op_bits=op_bits),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((FEAT_TILE, chunk_rows), lambda fi, pi: (fi, pi)),
+            pl.BlockSpec((chunk_rows, 4), lambda fi, pi: (pi, 0)),
+        ],
+        out_specs=pl.BlockSpec((FEAT_TILE, num_bins, 4),
+                               lambda fi, pi: (fi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((ff, num_bins, 4), jnp.int32),
+        interpret=interpret,
+    )(codes_t, ghq4)
+    out = out[:, :, :3]
+    if pad_f:
+        out = out[:f]
+    return out

@@ -260,6 +260,37 @@ def calculate_leaf_output(sum_grad, sum_hess, l1, l2, max_delta_step):
     return _leaf_output(sum_grad, sum_hess, l1, l2, max_delta_step)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins",))
+def find_best_split_quantized(
+    hist_q: jax.Array, g_scale: jax.Array, h_scale: jax.Array,
+    sum_grad: jax.Array, sum_hess: jax.Array,
+    num_data: jax.Array, feature_num_bins: jax.Array,
+    feature_missing: jax.Array, feature_default_bins: jax.Array,
+    feature_mask: jax.Array, monotone: jax.Array,
+    min_constraint: jax.Array, max_constraint: jax.Array,
+    feature_penalty: jax.Array = None, feature_cost: jax.Array = None,
+    *, num_bins: int, l1: float, l2: float, max_delta_step: float,
+    min_data_in_leaf: int, min_sum_hessian: float, min_gain_to_split: float,
+) -> SplitResult:
+    """Quantized-histogram split scan: rescale the leaf's EXACT integer
+    (sum_qg, sum_qh, count) sums back to f32 with the iteration's scales
+    BEFORE gain computation, then run the identical vectorized sweep.
+    The integer domain carries construction and sibling subtraction; the
+    gain arithmetic stays in f32 where the reference's formulas live.
+    """
+    from .quantize import dequantize_histogram
+    hist = dequantize_histogram(hist_q, g_scale, h_scale)
+    return find_best_split.__wrapped__(
+        hist, sum_grad, sum_hess, num_data, feature_num_bins,
+        feature_missing, feature_default_bins, feature_mask, monotone,
+        min_constraint, max_constraint, feature_penalty, feature_cost,
+        num_bins=num_bins, l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split)
+
+
 class CatSplitResult(NamedTuple):
     gain: jax.Array
     feature: jax.Array

@@ -177,8 +177,54 @@ class DataParallelTreeLearner(SerialTreeLearner):
                                                    (begin_l[0],))
             return new_idx[None], left_cnt[None]
 
+        def hist_fn_q(binned_l, idx_l, packed_l, begin_l, count_l, leaf_n,
+                      *, bucket):
+            """Quantized-gradient local histogram + COMPACT int32
+            allreduce (reference ReduceScatter role, quantized rendering):
+            each shard builds its exact int32 (F, B, 3) histogram from
+            the packed (qg|qh) rows, but the collective moves only TWO
+            int32 lanes [sum_qg, sum_qh] — the count lane is dropped from
+            the wire (2/3 the bytes of the float path's f32 triple, with
+            exact integer summation instead of f32 rounding) and
+            reconstructed from the hessian lane via the leaf's exact
+            global count: cnt_bin = round(qh_bin * leaf_n / qh_total).
+            Exact for constant-hessian objectives (every row quantizes to
+            the same qh); for varying hessians the min_data gate becomes
+            approximate, the same class of deviation as the reference's
+            hessian-derived counts."""
+            from ..ops import quantize as quant_ops
+            binned_l = binned_l[0]
+            idx_l = idx_l[0]
+            packed_row = packed_l[0]
+            window = jax.lax.dynamic_slice(idx_l, (begin_l[0],), (bucket,))
+            valid = jnp.arange(bucket, dtype=jnp.int32) < count_l[0]
+            rows = jnp.take(binned_l, window, axis=0)
+            ghq = quant_ops.gh_operand(jnp.take(packed_row, window), valid,
+                                       self._quant_bits)
+            local = hist_ops.build_histogram_quantized(rows, ghq, num_bins)
+            payload = local[:, :, :2]                 # (F, B, 2) int32
+            glob = jax.lax.psum(payload, "data")
+            qh_tot = glob[0, :, 1].sum().astype(jnp.float32)
+            cnt = jnp.round(
+                glob[:, :, 1].astype(jnp.float32)
+                * (leaf_n / jnp.maximum(qh_tot, 1.0))).astype(jnp.int32)
+            return jnp.concatenate([glob, cnt[:, :, None]], axis=2)
+
         self._hist_fns: Dict[int, object] = {}
+        self._hist_fns_q: Dict[int, object] = {}
         self._part_fns: Dict[int, object] = {}
+
+        def get_hist_fn_q(bucket):
+            if bucket not in self._hist_fns_q:
+                f = shard_map(
+                    functools.partial(hist_fn_q, bucket=bucket), mesh=mesh,
+                    in_specs=(P("data", None, None), P("data", None),
+                              P("data", None), P("data"), P("data"), P()),
+                    out_specs=P())
+                self._hist_fns_q[bucket] = jax.jit(f)
+            return self._hist_fns_q[bucket]
+
+        self._get_hist_fn_q = get_hist_fn_q
 
         def get_hist_fn(bucket):
             if bucket not in self._hist_fns:
@@ -217,6 +263,17 @@ class DataParallelTreeLearner(SerialTreeLearner):
             grad.reshape(self.shards, self.local_n), rsh)
         self._hess2 = jax.device_put(
             hess.reshape(self.shards, self.local_n), rsh)
+        if self._quant_bits:
+            # per-iteration discretization (ops/quantize.py): every shard
+            # holds one packed int32 (qg|qh) lane per row, histograms and
+            # their allreduce ride exact integers
+            from ..ops import quantize as quant_ops
+            qkey = jax.random.PRNGKey((2 * iter_seed + 1) % (2**31 - 1))
+            packed, s_g, s_h = quant_ops.quantize_gh(
+                grad, hess, qkey, grad_bits=self._quant_bits)
+            self._packed2 = jax.device_put(
+                packed.reshape(self.shards, self.local_n), rsh)
+            self._qscales = (s_g, s_h)
         # local index buffers per shard
         bufs = np.zeros((self.shards, self.local_n + self.max_local_bucket),
                         dtype=np.int32)
@@ -266,13 +323,24 @@ class DataParallelTreeLearner(SerialTreeLearner):
             begins = self._leaf_begin[leaf_id]
             cnts = self._leaf_count[leaf_id]
             bucket = _bucket(max(int(cnts.max()), 1), self.max_local_bucket)
+            if self._quant_bits:
+                fn = self._get_hist_fn_q(bucket)
+                return fn(self.binned, self._idx_buf, self._packed2,
+                          jnp.asarray(begins, jnp.int32),
+                          jnp.asarray(cnts, jnp.int32),
+                          jnp.float32(float(cnts.sum())))
             fn = self._get_hist_fn(bucket)
             return fn(self.binned, self._idx_buf, self._grad2, self._hess2,
                       jnp.asarray(begins, jnp.int32),
                       jnp.asarray(cnts, jnp.int32))
 
         root_hist = build_hist(0)
-        totals = jax.device_get(root_hist[0].sum(axis=0))
+        totals = np.asarray(
+            jax.device_get(root_hist[0].sum(axis=0)), dtype=np.float64)
+        if self._quant_bits:
+            s_g, s_h = jax.device_get(self._qscales)
+            totals = np.array([totals[0] / float(s_g),
+                               totals[1] / float(s_h), totals[2]])
         root = mk_state(0, float(totals[0]), float(totals[1]), 0,
                         -np.inf, np.inf)
         root.hist = root_hist
@@ -293,13 +361,23 @@ class DataParallelTreeLearner(SerialTreeLearner):
         return tree
 
     def _scan_state(self, st, base_mask, rng):
-        res = split_ops.find_best_split(
-            st.hist, jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
-            jnp.float32(st.count), self.f_numbins, self.f_missing,
-            self.f_default,
-            self._node_feature_mask(base_mask, rng) & (self.f_categorical == 0),
-            self.f_monotone, jnp.float32(st.min_c), jnp.float32(st.max_c),
-            **self._scan_args())
+        mask = (self._node_feature_mask(base_mask, rng)
+                & (self.f_categorical == 0))
+        if self._quant_bits:
+            s_g, s_h = self._qscales
+            res = split_ops.find_best_split_quantized(
+                st.hist, s_g, s_h, jnp.float32(st.sum_grad),
+                jnp.float32(st.sum_hess), jnp.float32(st.count),
+                self.f_numbins, self.f_missing, self.f_default, mask,
+                self.f_monotone, jnp.float32(st.min_c),
+                jnp.float32(st.max_c), **self._scan_args())
+        else:
+            res = split_ops.find_best_split(
+                st.hist, jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
+                jnp.float32(st.count), self.f_numbins, self.f_missing,
+                self.f_default, mask,
+                self.f_monotone, jnp.float32(st.min_c),
+                jnp.float32(st.max_c), **self._scan_args())
         return self._fetch_split(res)
 
     def _apply_split_dp(self, tree, leaves, leaf_id, base_mask, rng,
@@ -438,7 +516,55 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             elected_mask = jnp.zeros(f, bool).at[elected].set(True)
             return full, elected_mask
 
+        def vote_hist_fn_q(binned_l, idx_l, packed_l, begin_l, count_l,
+                           scale3, nbins, missing, defaults, mask, mono,
+                           *, bucket):
+            """Quantized PV-Tree election: the local histogram is EXACT
+            int32 (one integer contraction), local voting scans its
+            dequantized rendering (local counts stay exact), and the
+            reduced collective — the only cross-shard histogram traffic —
+            moves the elected 2k features' int32 histograms."""
+            from ..ops import quantize as quant_ops
+            binned_l = binned_l[0]
+            idx_l = idx_l[0]
+            window = jax.lax.dynamic_slice(idx_l, (begin_l[0],), (bucket,))
+            valid = jnp.arange(bucket, dtype=jnp.int32) < count_l[0]
+            rows = jnp.take(binned_l, window, axis=0)
+            ghq = quant_ops.gh_operand(jnp.take(packed_l[0], window), valid,
+                                       self._quant_bits)
+            local_q = hist_ops.build_histogram_quantized(rows, ghq, num_bins)
+            local_hist = local_q.astype(jnp.float32) * scale3
+            local_n = jnp.sum(valid.astype(jnp.float32))
+            local_g = local_hist[0, :, 0].sum()
+            local_h = local_hist[0, :, 1].sum()
+            rel, _, _, _ = split_ops.per_feature_best(
+                local_hist, local_g, local_h, local_n, nbins, missing,
+                defaults, mask, mono, jnp.float32(-jnp.inf),
+                jnp.float32(jnp.inf),
+                **{**scan_kwargs,
+                   "min_data_in_leaf":
+                       scan_kwargs["min_data_in_leaf"] // self.shards,
+                   "min_sum_hessian":
+                       scan_kwargs["min_sum_hessian"] / self.shards})
+            f = rel.shape[0]
+            k = min(top_k, f)
+            _, top_idx = jax.lax.top_k(rel, k)
+            votes = jnp.zeros(f, jnp.float32).at[top_idx].add(
+                jnp.where(rel[top_idx] > split_ops.NEG_INF / 2, 1.0, 0.0))
+            votes = jax.lax.psum(votes, "data")
+            k2 = min(2 * k, f)
+            _, elected = jax.lax.top_k(votes, k2)
+            # int32 collective: exact integer reduction of the elected
+            # features' histograms (O(2k*B) int32 lanes on the wire)
+            elected_q = jax.lax.psum(local_q[elected], "data")
+            elected_hist = elected_q.astype(jnp.float32) * scale3
+            full = jnp.zeros((f, num_bins, 3), jnp.float32)
+            full = full.at[elected].set(elected_hist)
+            elected_mask = jnp.zeros(f, bool).at[elected].set(True)
+            return full, elected_mask
+
         self._vote_fns: Dict[int, object] = {}
+        self._vote_fns_q: Dict[int, object] = {}
 
         def get_vote_fn(bucket):
             if bucket not in self._vote_fns:
@@ -452,7 +578,20 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
                 self._vote_fns[bucket] = jax.jit(fn)
             return self._vote_fns[bucket]
 
+        def get_vote_fn_q(bucket):
+            if bucket not in self._vote_fns_q:
+                fn = shard_map(
+                    functools.partial(vote_hist_fn_q, bucket=bucket),
+                    mesh=mesh,
+                    in_specs=(P("data", None, None), P("data", None),
+                              P("data", None), P("data"), P("data"),
+                              P(), P(), P(), P(), P(), P()),
+                    out_specs=(P(), P()))
+                self._vote_fns_q[bucket] = jax.jit(fn)
+            return self._vote_fns_q[bucket]
+
         self._get_vote_fn = get_vote_fn
+        self._get_vote_fn_q = get_vote_fn_q
 
     def _scan_state(self, st, base_mask, rng):
         # build voting histogram instead of the dense psum one
@@ -460,13 +599,22 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         cnts = self._leaf_count[st.leaf_id]
         bucket = _bucket(max(int(cnts.max()), 1), self.max_local_bucket)
         fmask = self._node_feature_mask(base_mask, rng) & (self.f_categorical == 0)
-        fn = self._get_vote_fn(bucket)
-        full_hist, elected_mask = fn(
-            self.binned, self._idx_buf, self._grad2, self._hess2,
-            jnp.asarray(begins, jnp.int32), jnp.asarray(cnts, jnp.int32),
-            jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
-            jnp.float32(st.count), self.f_numbins, self.f_missing,
-            self.f_default, fmask, self.f_monotone)
+        if self._quant_bits:
+            from ..ops.quantize import dequant_scale3
+            fn = self._get_vote_fn_q(bucket)
+            full_hist, elected_mask = fn(
+                self.binned, self._idx_buf, self._packed2,
+                jnp.asarray(begins, jnp.int32), jnp.asarray(cnts, jnp.int32),
+                dequant_scale3(*self._qscales), self.f_numbins,
+                self.f_missing, self.f_default, fmask, self.f_monotone)
+        else:
+            fn = self._get_vote_fn(bucket)
+            full_hist, elected_mask = fn(
+                self.binned, self._idx_buf, self._grad2, self._hess2,
+                jnp.asarray(begins, jnp.int32), jnp.asarray(cnts, jnp.int32),
+                jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
+                jnp.float32(st.count), self.f_numbins, self.f_missing,
+                self.f_default, fmask, self.f_monotone)
         res = split_ops.find_best_split(
             full_hist, jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
             jnp.float32(st.count), self.f_numbins, self.f_missing,

@@ -283,13 +283,17 @@ def test_histogram_multichunk_inside_shard_map():
     gh = r.randn(8 * 4096, 3).astype(np.float32)
     mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
 
+    # chunk pinned BELOW the local window so the scanned multi-chunk
+    # path stays exercised (the derived default would single-chunk 4096
+    # local rows for this shape)
     def f(b, g):
-        return jax.lax.psum(hist_ops.build_histogram(b, g, 64), "data")
+        return jax.lax.psum(
+            hist_ops.build_histogram(b, g, 64, chunk_size=2048), "data")
 
     fn = jax.jit(shard_map(f, mesh=mesh,
                            in_specs=(P("data", None), P("data", None)),
                            out_specs=P()))
     got = np.asarray(fn(rows, gh))
     want = np.asarray(hist_ops.build_histogram(
-        jnp.asarray(rows), jnp.asarray(gh), 64))
+        jnp.asarray(rows), jnp.asarray(gh), 64, chunk_size=2048))
     np.testing.assert_allclose(got, want, atol=2e-3)

@@ -1,9 +1,13 @@
 """Histogram formulation shootout (in-jit timing): f32-HIGHEST one-hot vs
-bf16 one-hot with split-gh 2-pass, vs single bf16 pass; plus gather layout
-experiments. Decides the production histogram path constants.
+bf16 one-hot with split-gh 2-pass, vs single bf16 pass, vs the quantized
+single-integer pass (ops/quantize + build_histogram_quantized); plus gather
+layout experiments. Decides the production histogram path constants and
+emits ONE JSON line A/B'ing the bf16 hi/lo pair against the integer
+contraction (the quantized-grad tentpole's headline claim).
 
 Usage: python tools/microbench_hist2.py [rows] [reps]
 """
+import json
 import sys
 import time
 
@@ -98,6 +102,34 @@ def onehot_2pass(c, gh_):
     return out
 
 
+def onehot_int(c, ghq):
+    """Quantized path: ONE integer matmul per chunk, exact int32 sums."""
+    n_chunks = N // CH
+    cc = c.reshape(n_chunks, CH, F)
+    gg = ghq.reshape(n_chunks, CH, 3)
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    def body(acc, chunk):
+        cb, gb = chunk
+        onehot = (cb.astype(jnp.int32)[:, :, None] == iota).reshape(
+            CH, F * B).astype(gb.dtype)
+        h = jax.lax.dot_general(
+            onehot.T, gb, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return acc + h, None
+
+    init = jnp.zeros((F * B, 3), jnp.int32)
+    out, _ = jax.lax.scan(body, init, (cc, gg))
+    return out
+
+
+# quantized gh operand (stochastic rounding, 8-bit) for the integer A/B
+from lightgbm_tpu.ops import quantize as quant_ops  # noqa: E402
+
+_packed, _sg, _sh = quant_ops.quantize_gh(
+    gh[:, 0], gh[:, 1], jax.random.PRNGKey(0), grad_bits=8)
+ghq8 = quant_ops.gh_operand(_packed, jnp.ones(N, bool), 8)
+
 print(f"backend={jax.default_backend()} N={N} F={F} B={B} chunk={CH}")
 P = jax.lax.Precision
 timed("one-hot f32 HIGHEST (current)", lambda i, a: onehot_chunks(
@@ -109,8 +141,11 @@ timed("one-hot f32 DEFAULT", lambda i, a: onehot_chunks(
 timed("one-hot bf16xbf16 single pass", lambda i, a: onehot_chunks(
     a[0], jnp.roll(a[1], i, axis=0), P.DEFAULT, jnp.bfloat16, jnp.bfloat16),
     codes, gh)
-timed("one-hot bf16 2-pass (hi+lo)", lambda i, a: onehot_2pass(
+ms_2pass = timed("one-hot bf16 2-pass (hi+lo)", lambda i, a: onehot_2pass(
     a[0], jnp.roll(a[1], i, axis=0)), codes, gh)
+ms_int8 = timed("one-hot int8 single pass (quantized)",
+                lambda i, a: onehot_int(a[0], jnp.roll(a[1], i, axis=0)),
+                codes, ghq8)
 
 # accuracy check of 2-pass vs HIGHEST
 h_ref = onehot_chunks(codes, gh, P.HIGHEST, jnp.float32, jnp.float32)
@@ -120,6 +155,13 @@ den = float(jnp.max(jnp.abs(h_ref)))
 print(f"2-pass rel err {float(jnp.max(jnp.abs(h_2p-h_ref)))/den:.2e}   "
       f"1-pass rel err {float(jnp.max(jnp.abs(h_1p-h_ref)))/den:.2e}")
 
+# quantized accuracy: dequantized int hist vs HIGHEST reference
+h_int = np.asarray(onehot_int(codes, ghq8), dtype=np.float64)
+h_deq = np.stack([h_int[:, 0] / float(_sg), h_int[:, 1] / float(_sh),
+                  h_int[:, 2]], axis=1)
+print(f"int8 dequant rel err "
+      f"{np.max(np.abs(h_deq - np.asarray(h_ref, np.float64)))/den:.2e}")
+
 # gather layouts
 timed("gather rows uint8 (N,28)", lambda i, a: jnp.take(
     a[0], jnp.roll(a[1], i), axis=0).astype(jnp.float32), codes, idx)
@@ -127,3 +169,13 @@ timed("gather rows packed uint32 (N,7)", lambda i, a: jnp.take(
     a[0], jnp.roll(a[1], i), axis=0).astype(jnp.float32), codes_pack, idx)
 timed("gather gh f32 (N,3)", lambda i, a: jnp.take(
     a[0], jnp.roll(a[1], i), axis=0), gh, idx)
+
+# one-line A/B record: bf16 hi/lo split pair vs single integer pass
+print(json.dumps({
+    "bench": "hist2_ab",
+    "backend": jax.default_backend(),
+    "rows": N, "features": F, "bins": B, "chunk": CH,
+    "bf16_2pass_ms": round(ms_2pass, 3),
+    "int8_ms": round(ms_int8, 3),
+    "int8_speedup": round(ms_2pass / ms_int8, 3) if ms_int8 > 0 else None,
+}))
