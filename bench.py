@@ -192,6 +192,11 @@ def main():
             # time budget or sec_to_auc is null by construction
             AUC_TARGET = 0.73
         os.environ.setdefault("LGBM_TPU_STRATEGY", "masked")
+    # BENCH_STRATEGY: explicit growth-strategy lever for the trajectory
+    # (masked | compact | chunk); overrides the degraded-mode default so
+    # the quantized compact/chunk paths are A/B-able on any backend
+    if os.environ.get("BENCH_STRATEGY"):
+        os.environ["LGBM_TPU_STRATEGY"] = os.environ["BENCH_STRATEGY"]
     import lightgbm_tpu as lgb
     sys.stderr.write(f"backend: {backend}\n")
     knobs = {k: os.environ[k] for k in
@@ -201,7 +206,7 @@ def main():
               "LGBM_TPU_CHUNK", "LGBM_TPU_CHUNK_NO_FUSE_HIST",
               "LGBM_TPU_HIST_CHUNK",
               "BENCH_CAT_FEATURES", "BENCH_QUANTIZED",
-              "BENCH_GRAD_BITS") if k in os.environ}
+              "BENCH_GRAD_BITS", "BENCH_STRATEGY") if k in os.environ}
     sys.stderr.write(f"rows={N_ROWS} iters={N_ITERS} knobs={knobs}\n")
 
     # any capped run (explicit CPU or fallback) is not comparable to the
@@ -308,6 +313,19 @@ def main():
     iters_per_sec = done_iters / t_train if t_train > 0 else 0.0
     rowtrees_per_sec = N_ROWS * iters_per_sec
 
+    # growth-strategy + working-row diagnostics for the trajectory: the
+    # packed strategies report the physical row width (codes words + gh
+    # section + id, x4 bytes); masked has no reordered row buffer
+    learner = booster._gbdt.learner
+    strategy = getattr(learner, "strategy", type(learner).__name__)
+    bytes_per_row = None
+    if getattr(learner, "codes_pack", None) is not None:
+        gh_words = 3
+        if getattr(learner, "quant_bits", 0):
+            gh_words = 1 if quantized and strategy in ("compact", "chunk") \
+                and params.get("bagging_freq", 0) == 0 else 2
+        bytes_per_row = (int(learner.codes_pack.shape[1]) + gh_words + 1) * 4
+
     valid_auc = rank_auc(host_predict_raw(booster._gbdt.models, xv), yv)
     if sec_to_auc is None and valid_auc >= AUC_TARGET:
         sec_to_auc = round(warmup_secs + t_train, 3)
@@ -338,6 +356,8 @@ def main():
         # float (bf16 hi/lo) and quantized (integer) pipelines
         "quantized": quantized,
         "hist_dtype": hist_dtype,
+        "strategy": strategy,
+        "bytes_per_row": bytes_per_row,
     }))
 
 

@@ -51,6 +51,7 @@ from ..config import Config
 from ..io.binning import BIN_CATEGORICAL
 from ..io.dataset import Dataset
 from ..ops import bundle as bundle_ops
+from ..ops import quantize as quant_ops
 from ..ops import split as split_ops
 from ..ops.partition import decide_left
 from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
@@ -325,9 +326,8 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
     # scans dequantize with the iteration's scales. The jit cache keys on
     # quant_bits (the hist dtype), so the float program is untouched.
     if quant_bits:
-        from ..ops import quantize as quant_ops
         rng_key, qkey = jax.random.split(rng_key)
-        packed, s_g, s_h = quant_ops.quantize_gh.__wrapped__(
+        packed, s_g, s_h = quant_ops.quantize_gh_core(
             grad * w, hess * w, qkey, grad_bits=quant_bits)
         gh = quant_ops.gh_operand(packed, w > 0, quant_bits)  # (N, 3) int
         scale3 = quant_ops.dequant_scale3(s_g, s_h)
@@ -481,7 +481,7 @@ def _unpack_codes(words: jax.Array, c_cols: int, item_bits: int) -> jax.Array:
                      "num_leaves", "num_bins", "col_bins", "max_depth",
                      "bynode_k", "use_pallas", "partition",
                      "pool_slots", "window_step", "trivial_weights",
-                     "cat_statics"))
+                     "cat_statics", "quant_bits", "quant_renew"))
 def grow_tree_compact(
         codes_pack: jax.Array,       # (N, CW) u32: packed column codes
         codes_row: jax.Array,        # (N, C) u8/u16 for the root pass
@@ -496,7 +496,8 @@ def grow_tree_compact(
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         partition: str = "sort",
         pool_slots: int = 0, window_step: int = 4,
-        trivial_weights: bool = False, cat_statics=None):
+        trivial_weights: bool = False, cat_statics=None,
+        quant_bits: int = 0, quant_renew: bool = True):
     return grow_tree_compact_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -509,7 +510,8 @@ def grow_tree_compact(
         use_pallas=use_pallas, partition=partition,
         axis_name=None, pool_slots=pool_slots,
         window_step=window_step, trivial_weights=trivial_weights,
-        cat_statics=cat_statics)
+        cat_statics=cat_statics, quant_bits=quant_bits,
+        quant_renew=quant_renew)
 
 
 def make_voting_search(*, axis_name, voting_k, c_cols, col_bins,
@@ -698,6 +700,103 @@ def make_voting_search(*, axis_name, voting_k, c_cols, col_bins,
     return reduce_hist, search_row, search2_rows
 
 
+def _quant_prepare(grad, hess, w, rng_key, *, quant_bits, quant_renew,
+                   n_total, axis_name):
+    """Quantized working-row preparation shared by the compact and chunk
+    cores: split the RNG exactly like the masked strategy does (so a
+    renew-off run quantizes bit-identically to it), discretize
+    (grad*w, hess*w) at the STORAGE resolution (16-bit under leaf
+    re-quantization — the packed word's field width, free bits — else
+    grad_bits), and, when renewing, measure the root's stored-int maxes
+    for the initial requant ratio (pmax'd so every shard agrees).
+
+    Returns (rng_key, packed (N,) int32, s_g, s_h, root_max (2,) f32 or
+    None)."""
+    rng_key, qkey = jax.random.split(rng_key)
+    sbits = quant_ops.storage_bits(quant_bits, quant_renew)
+    if axis_name is not None:
+        packed, s_g, s_h = quant_ops.quantize_gh_pmax(
+            grad * w, hess * w, qkey, grad_bits=sbits, n_total=n_total,
+            axis_name=axis_name)
+    else:
+        packed, s_g, s_h = quant_ops.quantize_gh_core(
+            grad * w, hess * w, qkey, grad_bits=sbits)
+    if not quant_renew:
+        return rng_key, packed, s_g, s_h, None
+    qg, qh = quant_ops.unpack_gh(packed)
+    m = jnp.stack([jnp.max(jnp.abs(qg)), jnp.max(jnp.abs(qh))]) \
+        .astype(jnp.float32)
+    if axis_name is not None:
+        m = jax.lax.pmax(m, axis_name)
+    return rng_key, packed, s_g, s_h, m
+
+
+def _quant_gh_words(packed: jax.Array, w: jax.Array,
+                    gw: int) -> jax.Array:
+    """The working row's gh section: ONE u32 word (the packed (qg|qh)
+    lane) when weights are trivial, or two words (packed | 0/1 weight)
+    when pad/out-of-bag rows must be fenced out of the count lane —
+    either way 1-2 words where the float layout bitcasts three."""
+    pk = jax.lax.bitcast_convert_type(packed, jnp.uint32)[:, None]
+    if gw == 1:
+        return pk
+    return jnp.concatenate([pk, (w > 0).astype(jnp.uint32)[:, None]],
+                           axis=1)
+
+
+def _quant_win_operand(win, vmask, *, cw, gw, quant_bits, qcap_op,
+                       r_g, r_h):
+    """(W, 3) integer histogram operand from a packed row window: the
+    stored (qg|qh) word re-quantized to the leaf's ratio (1.0 = fixed
+    root scale). The weighted layout folds the 0/1 weight word into the
+    validity mask so w=0 rows stay off the count lane."""
+    pk = jax.lax.bitcast_convert_type(win[:, cw], jnp.int32)
+    if gw == 2:
+        vmask = vmask & (win[:, cw + 1] != 0)
+    return quant_ops.gh_operand_scaled(pk, vmask, quant_bits, qcap_op,
+                                       r_g, r_h)
+
+
+def _quant_side_maxes(win, go_left, vmask, *, cw, gw):
+    """(2, 2) f32 [[max|qg|, max|qh|] left, [..] right] over a window's
+    valid rows — measured during the partition pass (which reads every
+    parent row anyway) to seed each child's leaf-local requant ratio."""
+    pk = jax.lax.bitcast_convert_type(win[:, cw], jnp.int32)
+    qg, qh = quant_ops.unpack_gh(pk)
+    if gw == 2:
+        vmask = vmask & (win[:, cw + 1] != 0)
+    a = jnp.stack([jnp.abs(qg), jnp.abs(qh)], axis=1).astype(jnp.float32)
+    left = jnp.max(jnp.where((go_left & vmask)[:, None], a, 0.0), axis=0)
+    right = jnp.max(jnp.where((~go_left & vmask)[:, None], a, 0.0), axis=0)
+    return jnp.stack([left, right])
+
+
+def make_scatter_reduce_q(axis_name, D, c_cols, wire):
+    """Quantized rendering of the DP scatter mode's histogram collective
+    (the reference's ReduceScatter, data_parallel_tree_learner.cpp:149-
+    164): psum_scatter TWO integer lanes [sum_qg, sum_qh] — int16 wire
+    when the shard-sum bound quant_max * N fits (1/3 the f32 triple's
+    bytes), int32 otherwise (2/3) — and reconstruct the count lane from
+    the hessian lane via the leaf's replicated global count:
+    cnt_bin = round(qh_bin * leaf_n / qh_tot). Exact for constant-
+    hessian objectives; for varying hessians the min_data gate becomes
+    approximate — the same class of deviation the host DP learner's
+    compact allreduce documents."""
+    cs = -(-c_cols // D)
+    c_pad = cs * D
+
+    def reduce_q(h_int, leaf_n, qh_tot_q):
+        payload = h_int[:, :, :2].astype(wire)
+        payload = jnp.pad(payload, ((0, c_pad - c_cols), (0, 0), (0, 0)))
+        sl = jax.lax.psum_scatter(payload, axis_name, scatter_dimension=0,
+                                  tiled=True).astype(jnp.int32)
+        cnt = jnp.round(sl[:, :, 1].astype(jnp.float32)
+                        * (leaf_n / jnp.maximum(qh_tot_q, 1.0))) \
+            .astype(jnp.int32)
+        return jnp.concatenate([sl, cnt[:, :, None]], axis=2)
+    return reduce_q
+
+
 def grow_tree_compact_core(
         codes_pack: jax.Array, codes_row: jax.Array,
         grad: jax.Array, hess: jax.Array, w: jax.Array,
@@ -712,7 +811,9 @@ def grow_tree_compact_core(
         partition: str = "sort",
         axis_name=None, pool_slots: int = 0, scatter_cols: int = 0,
         feature_shards: int = 0, voting_k: int = 0, window_step: int = 4,
-        trivial_weights: bool = False, cat_statics=None):
+        trivial_weights: bool = False, cat_statics=None,
+        quant_bits: int = 0, quant_renew: bool = True,
+        quant_total_rows: int = 0):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
@@ -747,6 +848,19 @@ def grow_tree_compact_core(
     tiny (D, 12) all_gather of per-shard candidates — the analog of
     SyncUpGlobalBestSplit. Requires identity column mapping (no EFB
     bundles) and no by-node feature sampling; callers gate on that.
+
+    quant_bits > 0 switches the working row to the quantized layout:
+    the gh section is ONE u32 (qg<<16|qh) word (trivial weights) or two
+    (packed | 0/1 weight) — 2 words/row less transport than the f32
+    triple on every partition move and histogram read — the pool is
+    EXACT int32, sibling subtraction is integer, and the scans read
+    leaf-dequantized f32 copies. quant_renew turns on leaf-wise
+    re-quantization (rows stored at 16-bit, operands re-discretized to
+    grad_bits per leaf range; see ops/quantize.py); off = fixed root
+    scale, bit-identical to the masked strategy's quantization. In
+    scatter mode the histogram collective becomes the two-integer-lane
+    reduce-scatter of make_scatter_reduce_q. The float path's program
+    is untouched (all layout switches are jit statics).
     """
     n = grad.shape[0]
     cw = codes_pack.shape[1]
@@ -757,7 +871,9 @@ def grow_tree_compact_core(
     # would evict the first and corrupt the sibling subtraction)
     K = max(2, pool_slots) if 0 < pool_slots < L else L
     pooled = K < L
-    gh = jnp.stack([grad * w, hess * w, w], axis=1)
+    quant = quant_bits > 0
+    if not quant:
+        gh = jnp.stack([grad * w, hess * w, w], axis=1)
     helper_kwargs = dict(
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
         max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
@@ -774,6 +890,43 @@ def grow_tree_compact_core(
     voting = voting_k > 0 and axis_name is not None and not (scatter or fp)
     sliced = scatter or fp
     per_w = 32 // item_bits
+
+    # quantized packed rows (quant_bits > 0): the gh section of the
+    # working row is ONE u32 (qg<<16|qh) word (two under non-trivial
+    # weights) instead of the three bitcast f32 words; histograms are
+    # EXACT int32 from the integer contraction; scans dequantize at
+    # leaf-local scales (quant_renew). Supported reductions: serial,
+    # DP psum, DP scatter (int16/int32 two-lane reduce-scatter).
+    assert not (quant and (voting or fp)), \
+        "quantized packed rows: voting/feature-parallel modes fall back " \
+        "to the host learners (create_tree_learner gates)"
+    renew = quant and quant_renew
+    if quant:
+        n_total = quant_total_rows or n
+        qcap_op = quant_ops.quant_max(quant_bits, n_total)
+        rng_key, gh_packed, q_sg, q_sh, root_max = _quant_prepare(
+            grad, hess, w, rng_key, quant_bits=quant_bits,
+            quant_renew=quant_renew, n_total=n_total, axis_name=axis_name)
+        gw = 1 if trivial_weights else 2
+
+        def q_ratios(leaf_max):
+            """(r_g, r_h) leaf-local operand rescale from stored maxes;
+            fixed 1.0 when renewal is off."""
+            if not renew:
+                return jnp.float32(1.0), jnp.float32(1.0)
+            return (quant_ops.requant_ratio(leaf_max[0], qcap_op),
+                    quant_ops.requant_ratio(leaf_max[1], qcap_op))
+
+        def q_dequant(h_int, r_g, r_h):
+            return h_int.astype(jnp.float32) * quant_ops.dequant_scale3(
+                q_sg * r_g, q_sh * r_h)
+
+        if scatter:
+            reduce_q = make_scatter_reduce_q(
+                axis_name, scatter_cols, c_cols,
+                quant_ops.wire_dtype(quant_bits, n_total))
+    else:
+        gw = 3
 
     if voting:
         reduce_hist, search_row, search2_rows = make_voting_search(
@@ -830,18 +983,40 @@ def grow_tree_compact_core(
     classes = _size_classes(n, step=window_step)
     wmax = classes[-1]
     thresholds = jnp.asarray(np.array(classes[:-1], np.int32))
-    d_cols = cw + 4
+    d_cols = cw + gw + 1
 
-    # packed working buffer: codes | gh (bitcast) | row id, padded by wmax
-    gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)          # (N, 3)
+    # packed working buffer: codes | gh section | row id, padded by wmax
+    # (gh section: three bitcast f32 words on the float path, one packed
+    # int word — two with a weight word — on the quantized path)
+    if quant:
+        gh_u = _quant_gh_words(gh_packed, w, gw)
+    else:
+        gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)      # (N, 3)
     ids = jnp.arange(n, dtype=jnp.uint32)[:, None]
     data0 = jnp.concatenate([codes_pack, gh_u, ids], axis=1)
     data0 = jnp.concatenate(
         [data0, jnp.zeros((wmax, d_cols), jnp.uint32)], axis=0)
 
     # ---- root ------------------------------------------------------------
-    from ..ops.histogram import build_histogram
-    if fp:
+    from ..ops.histogram import build_histogram, build_histogram_quantized
+    if quant:
+        r0_g, r0_h = q_ratios(root_max) if renew else q_ratios(None)
+        ghq0 = quant_ops.gh_operand_scaled(
+            gh_packed, w > 0, quant_bits, qcap_op, r0_g, r0_h)
+        hist0 = build_histogram_quantized(codes_row, ghq0, col_bins,
+                                          use_pallas=use_pallas)
+        if scatter:
+            # exact global int totals first (3 scalars), then the
+            # two-lane reduce-scatter with count reconstruction
+            tot_q = jax.lax.psum(hist0[0].sum(axis=0), axis_name)
+            totals = q_dequant(tot_q, r0_g, r0_h)
+            hist0 = reduce_q(hist0, totals[2], tot_q[1].astype(jnp.float32))
+        else:
+            if axis_name is not None:
+                hist0 = jax.lax.psum(hist0, axis_name)
+            totals = q_dequant(hist0[0].sum(axis=0), r0_g, r0_h)
+        hist0_scan = q_dequant(hist0, r0_g, r0_h)
+    elif fp:
         # rows are replicated: totals come straight from gh, and the
         # root histogram is built from this shard's column slice only
         totals = gh.sum(axis=0)
@@ -862,9 +1037,11 @@ def grow_tree_compact_core(
         else:
             hist0 = reduce_hist(hist0)
             totals = hist0[0].sum(axis=0)
+    if not quant:
+        hist0_scan = hist0
     pool_c = hist0.shape[0]
     root_key, loop_key = jax.random.split(rng_key)
-    row0, cm0 = search_row(hist0, totals[0], totals[1], totals[2],
+    row0, cm0 = search_row(hist0_scan, totals[0], totals[1], totals[2],
                            jnp.float32(-np.inf), jnp.float32(np.inf),
                            root_key, jnp.int32(0))
 
@@ -872,7 +1049,9 @@ def grow_tree_compact_core(
     best = jnp.full((L, 12), NEG_INF, jnp.float32).at[:, B_FEAT:].set(0.0)
     best = best.at[0].set(row0)
     best_cat = jnp.zeros((L, cat_b), jnp.float32).at[0].set(cm0)
-    pool = jnp.zeros((K, pool_c, col_bins, 3), jnp.float32).at[0].set(hist0)
+    # pool dtype follows the histogram dtype: int32 on the quantized
+    # path (sibling subtraction below is then exact integer arithmetic)
+    pool = jnp.zeros((K, pool_c, col_bins, 3), hist0.dtype).at[0].set(hist0)
     rec = jnp.zeros((L - 1, 13), jnp.float32)
     carry = _CarryC(
         k=jnp.int32(0),
@@ -896,7 +1075,12 @@ def grow_tree_compact_core(
         half = (wsz + 1) // 2
 
         def branch(op):
-            c, l, row, new_id, need_other = op
+            if renew:
+                c, l, row, new_id, need_other, rq = op
+                rq_g, rq_h = rq
+            else:
+                c, l, row, new_id, need_other = op
+                rq_g = rq_h = jnp.float32(1.0)
             feat = row[B_FEAT].astype(jnp.int32)
             begin = c.leaf_begin[l]
             pcount = c.leaf_phys[l]
@@ -909,6 +1093,10 @@ def grow_tree_compact_core(
                 f_col, f_base, f_elide, item_bits=item_bits,
                 f_categorical=f_categorical if has_cat else None,
                 cat_mask=c.best_cat[l] if has_cat else None) & valid
+            if renew:
+                # each child's stored-int maxes seed its leaf-local
+                # requant ratio (measured here: the window is in hand)
+                qmax2 = _quant_side_maxes(win, go_left, valid, cw=cw, gw=gw)
 
             # stable partition of the window (reference DataPartition::
             # Split): overrun rows past pcount get key 2; the full 3-way
@@ -937,31 +1125,39 @@ def grow_tree_compact_core(
             left_small = row[B_LCNT] <= row[B_RCNT]
             s_begin = jnp.where(left_small, 0, lphys)
             s_count = jnp.where(left_small, lphys, rphys)
+            hist_dtype = jnp.int32 if quant else jnp.float32
+
+            def win_hist(rows2d, vbool):
+                """Histogram of a row window restricted to `vbool` rows —
+                the one layout dispatch (float triple vs packed int)."""
+                s_codes = decode_for_hist(rows2d[:, :cw])
+                if quant:
+                    ghq = _quant_win_operand(
+                        rows2d, vbool, cw=cw, gw=gw, quant_bits=quant_bits,
+                        qcap_op=qcap_op, r_g=rq_g, r_h=rq_h)
+                    return build_histogram_quantized(
+                        s_codes, ghq, col_bins, use_pallas=use_pallas)
+                s_gh = jax.lax.bitcast_convert_type(
+                    rows2d[:, cw:cw + 3], jnp.float32) \
+                    * vbool.astype(jnp.float32)[:, None]
+                return build_histogram(s_codes, s_gh, col_bins,
+                                       use_pallas=use_pallas)
 
             def hist_half(_):
                 start = jnp.clip(s_begin, 0, wsz - half)
                 off = s_begin - start
                 sw = jax.lax.dynamic_slice(win_sorted, (start, 0),
                                            (half, d_cols))
-                s_codes = decode_for_hist(sw[:, :cw])
                 j = jnp.arange(half, dtype=jnp.int32)
-                sv = ((j >= off) & (j < off + s_count)).astype(jnp.float32)
-                s_gh = jax.lax.bitcast_convert_type(
-                    sw[:, cw:cw + 3], jnp.float32) * sv[:, None]
-                return build_histogram(s_codes, s_gh, col_bins,
-                                       use_pallas=use_pallas)
+                return win_hist(sw, (j >= off) & (j < off + s_count))
 
             def hist_range(range_begin, range_count):
                 # masked full-window pass over [range_begin,
                 # range_begin + range_count)
-                s_codes = decode_for_hist(win_sorted[:, :cw])
                 j = jnp.arange(wsz, dtype=jnp.int32)
-                sv = ((j >= range_begin)
-                      & (j < range_begin + range_count)).astype(jnp.float32)
-                s_gh = jax.lax.bitcast_convert_type(
-                    win_sorted[:, cw:cw + 3], jnp.float32) * sv[:, None]
-                return build_histogram(s_codes, s_gh, col_bins,
-                                       use_pallas=use_pallas)
+                return win_hist(win_sorted,
+                                (j >= range_begin)
+                                & (j < range_begin + range_count))
 
             if trivial_weights and axis_name is None:
                 # all-ones weights single-chip: record counts equal
@@ -985,17 +1181,18 @@ def grow_tree_compact_core(
                 hist_other = jax.lax.cond(
                     need_other, lambda _: hist_range(o_begin, o_count),
                     lambda _: jnp.zeros((hist_cols, col_bins, 3),
-                                        jnp.float32),
+                                        hist_dtype),
                     operand=None)
             else:
                 hist_other = jnp.zeros((hist_cols, col_bins, 3),
-                                       jnp.float32)
-            return data, lphys, hist_small, hist_other
+                                       hist_dtype)
+            out = (data, lphys, hist_small, hist_other)
+            return out + (qmax2,) if renew else out
         return branch
 
     branches = [make_branch(wsz) for wsz in classes]
 
-    def body(c: _CarryC) -> _CarryC:
+    def body(c: _CarryC, qx=None):
         b = c.best
         l = jnp.argmax(b[:, B_GAIN]).astype(jnp.int32)
         row = b[l]
@@ -1005,8 +1202,20 @@ def grow_tree_compact_core(
         slot_l = c.slot_of[l]
         have_parent = slot_l >= 0
         j = jnp.sum((pcount > thresholds).astype(jnp.int32))
-        data, lphys, hist_small, hist_other = \
-            jax.lax.switch(j, branches, (c, l, row, new_id, ~have_parent))
+        if renew:
+            # the leaf's operand ratio comes from maxes recorded at its
+            # CREATION (replicated), so the branch needs no collective
+            scale_of, leafmax = qx
+            rq_g, rq_h = q_ratios(leafmax[l])
+            data, lphys, hist_small, hist_other, qmax2 = jax.lax.switch(
+                j, branches,
+                (c, l, row, new_id, ~have_parent, (rq_g, rq_h)))
+            if axis_name is not None:
+                qmax2 = jax.lax.pmax(qmax2, axis_name)
+        else:
+            rq_g = rq_h = jnp.float32(1.0)
+            data, lphys, hist_small, hist_other = jax.lax.switch(
+                j, branches, (c, l, row, new_id, ~have_parent))
         begin = c.leaf_begin[l]
         rphys = pcount - lphys
         leaf_begin = c.leaf_begin.at[new_id].set(begin + lphys)
@@ -1018,6 +1227,7 @@ def grow_tree_compact_core(
             (posv >= begin) & (posv < begin + lphys), l,
             jnp.where((posv >= begin + lphys) & (posv < begin + pcount),
                       new_id, c.pos_leaf))
+        left_small = row[B_LCNT] <= row[B_RCNT]
         if axis_name is not None:
             # cross-shard histogram reduction: psum replicates (dense
             # equivalent of the reference's reduce-scatter, scan runs
@@ -1025,13 +1235,30 @@ def grow_tree_compact_core(
             # pattern (each shard owns its column tile). The miss-path
             # histogram reduces alongside so no shard ever takes a
             # collective the others skip.
-            hist_small = reduce_hist(hist_small)
-            if pooled:
-                hist_other = reduce_hist(hist_other)
+            if quant and scatter:
+                # two integer lanes on the wire; counts reconstructed
+                # from the hessian lane + the replicated global count
+                s_cnt_g = jnp.where(left_small, row[B_LCNT], row[B_RCNT])
+                s_qh_g = jnp.where(left_small, row[B_LSH], row[B_RSH]) \
+                    * (q_sh * rq_h)
+                hist_small = reduce_q(hist_small, s_cnt_g, s_qh_g)
+                if pooled:
+                    o_cnt_g = row[B_LCNT] + row[B_RCNT] - s_cnt_g
+                    o_qh_g = (row[B_LSH] + row[B_RSH]) * (q_sh * rq_h) \
+                        - s_qh_g
+                    hist_other = reduce_q(hist_other, o_cnt_g, o_qh_g)
+            else:
+                hist_small = reduce_hist(hist_small)
+                if pooled:
+                    hist_other = reduce_hist(hist_other)
 
-        left_small = row[B_LCNT] <= row[B_RCNT]
         parent = (c.pool[jnp.clip(slot_l, 0, K - 1)] if pooled
                   else c.pool[l])
+        if renew:
+            # re-express the parent pool entry in the split's ratio
+            # before subtraction (counts pass through exact)
+            parent = quant_ops.rescale_histogram(
+                parent, rq_g / scale_of[l, 0], rq_h / scale_of[l, 1])
         sibling = jnp.where(have_parent, parent - hist_small, hist_other) \
             if pooled else parent - hist_small
         hist_l = jnp.where(left_small, hist_small, sibling)
@@ -1073,19 +1300,40 @@ def grow_tree_compact_core(
             slot_owner, slot_last = c.slot_owner, c.slot_last
         pool = c.pool.at[s_l].set(hist_l).at[s_r].set(hist_r)
 
+        if quant:
+            # scans read f32: dequantize the children at the split's
+            # leaf-local scale (the pool keeps the exact integers)
+            hist_l_s = q_dequant(hist_l, rq_g, rq_h)
+            hist_r_s = q_dequant(hist_r, rq_g, rq_h)
+        else:
+            hist_l_s, hist_r_s = hist_l, hist_r
         (key, leaf_min, leaf_max, depth, rec2, rec_cat2, best2,
          best_cat2) = split_epilogue(
             k=c.k, key=c.key, l=l, new_id=new_id, row=row,
             mono_f=f_monotone[feat], best_cat_l=c.best_cat[l],
             leaf_min=c.leaf_min, leaf_max=c.leaf_max, depth=c.depth,
             rec=c.rec, rec_cat=c.rec_cat, best=b, best_cat=c.best_cat,
-            hist_l=hist_l, hist_r=hist_r, search2=search2_rows)
-        return _CarryC(new_id, data, pos_leaf, leaf_begin, leaf_phys,
-                       pool, slot_of, slot_owner, slot_last,
-                       depth, leaf_min, leaf_max, best2, best_cat2,
-                       rec2, rec_cat2, key)
+            hist_l=hist_l_s, hist_r=hist_r_s, search2=search2_rows)
+        c2 = _CarryC(new_id, data, pos_leaf, leaf_begin, leaf_phys,
+                     pool, slot_of, slot_owner, slot_last,
+                     depth, leaf_min, leaf_max, best2, best_cat2,
+                     rec2, rec_cat2, key)
+        if renew:
+            scale2 = jnp.stack([rq_g, rq_h])
+            return c2, (scale_of.at[l].set(scale2).at[new_id].set(scale2),
+                        leafmax.at[l].set(qmax2[0]).at[new_id]
+                        .set(qmax2[1]))
+        return c2, None
 
-    out = jax.lax.while_loop(cond, body, carry)
+    if renew:
+        scale0 = jnp.ones((L, 2), jnp.float32) \
+            .at[0].set(jnp.stack([r0_g, r0_h]))
+        leafmax0 = jnp.zeros((L, 2), jnp.float32).at[0].set(root_max)
+        out, _ = jax.lax.while_loop(
+            lambda t: cond(t[0]), lambda t: body(t[0], t[1]),
+            (carry, (scale0, leafmax0)))
+    else:
+        out = jax.lax.while_loop(cond, lambda cc: body(cc)[0], carry)
     # final row -> leaf map: scatter physical-position leaves onto row ids
     row_ids = out.data[:n, d_cols - 1].astype(jnp.int32)
     leaf_id = jnp.zeros(n, jnp.int32).at[row_ids].set(
@@ -1118,7 +1366,8 @@ class _CarryK(NamedTuple):
                      "num_leaves", "num_bins", "col_bins", "max_depth",
                      "bynode_k", "use_pallas", "partition",
                      "chunk_rows", "fuse_hist", "feature_shards",
-                     "cat_statics"))
+                     "cat_statics", "trivial_weights", "quant_bits",
+                     "quant_renew"))
 def grow_tree_chunk(
         codes_pack: jax.Array, codes_row: jax.Array,
         grad: jax.Array, hess: jax.Array, w: jax.Array,
@@ -1132,7 +1381,8 @@ def grow_tree_chunk(
         min_gain_to_split: float, bynode_k: int, use_pallas: bool,
         partition: str = "sort", chunk_rows: int = 65536,
         fuse_hist: bool = True, feature_shards: int = 0,
-        cat_statics=None):
+        cat_statics=None, trivial_weights: bool = False,
+        quant_bits: int = 0, quant_renew: bool = True):
     return grow_tree_chunk_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -1144,7 +1394,9 @@ def grow_tree_chunk(
         min_gain_to_split=min_gain_to_split, bynode_k=bynode_k,
         use_pallas=use_pallas, partition=partition, chunk_rows=chunk_rows,
         fuse_hist=fuse_hist, feature_shards=feature_shards,
-        axis_name=None, cat_statics=cat_statics)
+        axis_name=None, cat_statics=cat_statics,
+        trivial_weights=trivial_weights, quant_bits=quant_bits,
+        quant_renew=quant_renew)
 
 
 def grow_tree_chunk_core(
@@ -1161,7 +1413,9 @@ def grow_tree_chunk_core(
         partition: str = "sort", chunk_rows: int = 65536,
         fuse_hist: bool = True, feature_shards: int = 0,
         scatter_cols: int = 0, voting_k: int = 0,
-        axis_name=None, cat_statics=None):
+        axis_name=None, cat_statics=None, trivial_weights: bool = False,
+        quant_bits: int = 0, quant_renew: bool = True,
+        quant_total_rows: int = 0):
     """Switch-free whole-tree growth over fixed-size chunks.
 
     The compact strategy resolves dynamic leaf sizes with a lax.switch
@@ -1212,7 +1466,7 @@ def grow_tree_chunk_core(
         via make_sliced_search — feature_parallel_tree_learner.cpp:33-76).
     The LRU-capped histogram pool stays on the compact strategy.
     """
-    from ..ops.histogram import build_histogram
+    from ..ops.histogram import build_histogram, build_histogram_quantized
     n = grad.shape[0]
     cw = codes_pack.shape[1]
     L = num_leaves
@@ -1220,8 +1474,9 @@ def grow_tree_chunk_core(
     maxch = -(-n // CH)
     has_cat = cat_statics is not None
     cat_b = num_bins if has_cat else 1
-    gh = jnp.stack([grad * w, hess * w, w], axis=1)
-    d_cols = cw + 4
+    quant = quant_bits > 0
+    if not quant:
+        gh = jnp.stack([grad * w, hess * w, w], axis=1)
     helper_kwargs = dict(
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
         max_delta_step=max_delta_step, min_data_in_leaf=min_data_in_leaf,
@@ -1231,6 +1486,39 @@ def grow_tree_chunk_core(
     scatter = scatter_cols > 1 and axis_name is not None and not fp
     voting = voting_k > 0 and axis_name is not None and not (scatter or fp)
     per_w = 32 // item_bits
+
+    # quantized packed rows: same layout + leaf-requant scheme as the
+    # compact core (see grow_tree_compact_core); the supported sharded
+    # reductions are serial, DP psum and DP scatter
+    assert not (quant and (voting or fp)), \
+        "quantized packed rows: voting/feature-parallel modes fall back " \
+        "to the host learners (create_tree_learner gates)"
+    renew = quant and quant_renew
+    if quant:
+        n_total = quant_total_rows or n
+        qcap_op = quant_ops.quant_max(quant_bits, n_total)
+        rng_key, gh_packed, q_sg, q_sh, root_max = _quant_prepare(
+            grad, hess, w, rng_key, quant_bits=quant_bits,
+            quant_renew=quant_renew, n_total=n_total, axis_name=axis_name)
+        gw = 1 if trivial_weights else 2
+
+        def q_ratios(leaf_max):
+            if not renew:
+                return jnp.float32(1.0), jnp.float32(1.0)
+            return (quant_ops.requant_ratio(leaf_max[0], qcap_op),
+                    quant_ops.requant_ratio(leaf_max[1], qcap_op))
+
+        def q_dequant(h_int, r_g, r_h):
+            return h_int.astype(jnp.float32) * quant_ops.dequant_scale3(
+                q_sg * r_g, q_sh * r_h)
+
+        if scatter:
+            reduce_q = make_scatter_reduce_q(
+                axis_name, scatter_cols, c_cols,
+                quant_ops.wire_dtype(quant_bits, n_total))
+    else:
+        gw = 3
+    d_cols = cw + gw + 1
     if fp:
         # feature-parallel: rows replicated, each shard builds and scans
         # only its word-aligned column slice; the winner is elected from
@@ -1308,13 +1596,31 @@ def grow_tree_chunk_core(
             def reduce_hist(h):
                 return h
 
-    gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)
+    if quant:
+        gh_u = _quant_gh_words(gh_packed, w, gw)
+    else:
+        gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)
     ids = jnp.arange(n, dtype=jnp.uint32)[:, None]
     data0 = jnp.concatenate([codes_pack, gh_u, ids], axis=1)
     data0 = jnp.concatenate(
         [data0, jnp.zeros((CH, d_cols), jnp.uint32)], axis=0)
 
-    if fp:
+    if quant:
+        r0_g, r0_h = q_ratios(root_max)
+        ghq0 = quant_ops.gh_operand_scaled(
+            gh_packed, w > 0, quant_bits, qcap_op, r0_g, r0_h)
+        hist0 = build_histogram_quantized(codes_row, ghq0, col_bins,
+                                          use_pallas=use_pallas)
+        if scatter:
+            tot_q = jax.lax.psum(hist0[0].sum(axis=0), axis_name)
+            totals = q_dequant(tot_q, r0_g, r0_h)
+            hist0 = reduce_q(hist0, totals[2], tot_q[1].astype(jnp.float32))
+        else:
+            if axis_name is not None:
+                hist0 = jax.lax.psum(hist0, axis_name)
+            totals = q_dequant(hist0[0].sum(axis=0), r0_g, r0_h)
+        hist0_scan = q_dequant(hist0, r0_g, r0_h)
+    elif fp:
         # rows replicated: totals come straight from gh; root histogram
         # from this shard's column slice only
         totals = gh.sum(axis=0)
@@ -1336,8 +1642,10 @@ def grow_tree_chunk_core(
         else:
             hist0 = reduce_hist(hist0)
             totals = hist0[0].sum(axis=0)
+    if not quant:
+        hist0_scan = hist0
     root_key, loop_key = jax.random.split(rng_key)
-    row0, cm0 = search_row(hist0, totals[0], totals[1], totals[2],
+    row0, cm0 = search_row(hist0_scan, totals[0], totals[1], totals[2],
                            jnp.float32(-np.inf), jnp.float32(np.inf),
                            root_key, jnp.int32(0))
     best = jnp.full((L, 12), NEG_INF, jnp.float32).at[:, B_FEAT:].set(0.0)
@@ -1348,7 +1656,7 @@ def grow_tree_chunk_core(
         k=jnp.int32(0), data=data0, scratch=jnp.zeros_like(data0),
         pos_leaf=jnp.zeros(n + CH, jnp.int32),
         leaf_begin=zi(L), leaf_phys=zi(L).at[0].set(n),
-        pool=jnp.zeros((L, hist_w, col_bins, 3), jnp.float32).at[0]
+        pool=jnp.zeros((L, hist_w, col_bins, 3), hist0.dtype).at[0]
             .set(hist0),
         depth=zi(L),
         leaf_min=jnp.full((L,), -np.inf, jnp.float32),
@@ -1362,7 +1670,7 @@ def grow_tree_chunk_core(
     def cond(c: _CarryK):
         return (c.k < L - 1) & (jnp.max(c.best[:, B_GAIN]) > 1e-10)
 
-    def body(c: _CarryK) -> _CarryK:
+    def body(c: _CarryK, qx=None):
         b = c.best
         l = jnp.argmax(b[:, B_GAIN]).astype(jnp.int32)
         row = b[l]
@@ -1374,6 +1682,11 @@ def grow_tree_chunk_core(
         begin = c.leaf_begin[l]
         p = c.leaf_phys[l]
         nch = -(-p // CH)
+        if renew:
+            scale_of, leafmax = qx
+            rq_g, rq_h = q_ratios(leafmax[l])
+        else:
+            rq_g = rq_h = jnp.float32(1.0)
         # the GLOBALLY smaller child (replicated record counts) decides
         # which side's rows accumulate the fused histogram
         left_small = row[B_LCNT] <= row[B_RCNT]
@@ -1381,10 +1694,18 @@ def grow_tree_chunk_core(
         # psum_scatter afterwards maps it to this shard's hist_w slice);
         # every other mode accumulates at pool width directly
         acc_w = c_cols if scatter else hist_w
-        hist_zero = jnp.zeros((acc_w, col_bins, 3), jnp.float32)
+        hist_zero = jnp.zeros((acc_w, col_bins, 3),
+                              jnp.int32 if quant else jnp.float32)
 
         def chunk_hist(rows_win, count):
             codes = decode_hist_cols(rows_win[:, :cw])
+            if quant:
+                ghq = _quant_win_operand(
+                    rows_win, iota_ch < count, cw=cw, gw=gw,
+                    quant_bits=quant_bits, qcap_op=qcap_op,
+                    r_g=rq_g, r_h=rq_h)
+                return build_histogram_quantized(codes, ghq, col_bins,
+                                                 use_pallas=use_pallas)
             v = (iota_ch < count).astype(jnp.float32)
             ghw = jax.lax.bitcast_convert_type(
                 rows_win[:, cw:cw + 3], jnp.float32) * v[:, None]
@@ -1399,7 +1720,10 @@ def grow_tree_chunk_core(
         fuse = fuse_hist
 
         def pass_b(i, acc):
-            data, scratch, lrun, rcnt, hist = acc
+            if renew:
+                data, scratch, lrun, rcnt, hist, qmx = acc
+            else:
+                data, scratch, lrun, rcnt, hist = acc
             start = begin + i * CH
             win = jax.lax.dynamic_slice(data, (start, 0), (CH, d_cols))
             valid = iota_ch < (p - i * CH)
@@ -1408,6 +1732,9 @@ def grow_tree_chunk_core(
                 f_col, f_base, f_elide, item_bits=item_bits,
                 f_categorical=f_categorical if has_cat else None,
                 cat_mask=cmask) & valid
+            if renew:
+                qmx = jnp.maximum(
+                    qmx, _quant_side_maxes(win, gl, valid, cw=cw, gw=gw))
             key3 = jnp.where(gl, 0, jnp.where(valid, 1, 2))
             win_s = partition_window(win, key3, partition)
             lc = jnp.sum(gl.astype(jnp.int32))
@@ -1427,11 +1754,19 @@ def grow_tree_chunk_core(
                 hist = hist + jax.lax.cond(
                     left_small, lambda _: chunk_hist(win_s, lc),
                     lambda _: hist_zero, operand=None)
-            return data, scratch, lrun + lc, rcnt.at[i].set(vc - lc), hist
+            out = (data, scratch, lrun + lc, rcnt.at[i].set(vc - lc), hist)
+            return out + (qmx,) if renew else out
 
-        data, scratch, lphys, rcnt, hist_small = jax.lax.fori_loop(
-            0, nch, pass_b,
-            (c.data, c.scratch, jnp.int32(0), zi(maxch), hist_zero))
+        acc0 = (c.data, c.scratch, jnp.int32(0), zi(maxch), hist_zero)
+        if renew:
+            acc0 = acc0 + (jnp.zeros((2, 2), jnp.float32),)
+            data, scratch, lphys, rcnt, hist_small, qmax2 = \
+                jax.lax.fori_loop(0, nch, pass_b, acc0)
+            if axis_name is not None:
+                qmax2 = jax.lax.pmax(qmax2, axis_name)
+        else:
+            data, scratch, lphys, rcnt, hist_small = jax.lax.fori_loop(
+                0, nch, pass_b, acc0)
         rphys = p - lphys
         roff = jnp.cumsum(rcnt) - rcnt
 
@@ -1469,9 +1804,22 @@ def grow_tree_chunk_core(
             hist_small = jax.lax.fori_loop(0, -(-sc // CH), pass_h,
                                            hist_zero)
         # psum / psum_scatter-to-slice / identity (fp, voting, serial)
-        hist_small = reduce_hist(hist_small)
+        if quant and scatter:
+            s_cnt_g = jnp.where(left_small, row[B_LCNT], row[B_RCNT])
+            s_qh_g = jnp.where(left_small, row[B_LSH], row[B_RSH]) \
+                * (q_sh * rq_h)
+            hist_small = reduce_q(hist_small, s_cnt_g, s_qh_g)
+        elif quant:
+            if axis_name is not None:
+                hist_small = jax.lax.psum(hist_small, axis_name)
+        else:
+            hist_small = reduce_hist(hist_small)
 
-        sibling = c.pool[l] - hist_small
+        parent = c.pool[l]
+        if renew:
+            parent = quant_ops.rescale_histogram(
+                parent, rq_g / scale_of[l, 0], rq_h / scale_of[l, 1])
+        sibling = parent - hist_small
         hist_l = jnp.where(left_small, hist_small, sibling)
         hist_r = jnp.where(left_small, sibling, hist_small)
         pool = c.pool.at[l].set(hist_l).at[new_id].set(hist_r)
@@ -1484,18 +1832,37 @@ def grow_tree_chunk_core(
             jnp.where((posv >= begin + lphys) & (posv < begin + p),
                       new_id, c.pos_leaf))
 
+        if quant:
+            hist_l_s = q_dequant(hist_l, rq_g, rq_h)
+            hist_r_s = q_dequant(hist_r, rq_g, rq_h)
+        else:
+            hist_l_s, hist_r_s = hist_l, hist_r
         (key, leaf_min, leaf_max, depth, rec2, rec_cat2, best2,
          best_cat2) = split_epilogue(
             k=c.k, key=c.key, l=l, new_id=new_id, row=row,
             mono_f=f_monotone[feat], best_cat_l=c.best_cat[l],
             leaf_min=c.leaf_min, leaf_max=c.leaf_max, depth=c.depth,
             rec=c.rec, rec_cat=c.rec_cat, best=b, best_cat=c.best_cat,
-            hist_l=hist_l, hist_r=hist_r, search2=search2)
-        return _CarryK(new_id, data, scratch, pos_leaf, leaf_begin,
-                       leaf_phys, pool, depth, leaf_min, leaf_max,
-                       best2, best_cat2, rec2, rec_cat2, key)
+            hist_l=hist_l_s, hist_r=hist_r_s, search2=search2)
+        c2 = _CarryK(new_id, data, scratch, pos_leaf, leaf_begin,
+                     leaf_phys, pool, depth, leaf_min, leaf_max,
+                     best2, best_cat2, rec2, rec_cat2, key)
+        if renew:
+            scale2 = jnp.stack([rq_g, rq_h])
+            return c2, (scale_of.at[l].set(scale2).at[new_id].set(scale2),
+                        leafmax.at[l].set(qmax2[0]).at[new_id]
+                        .set(qmax2[1]))
+        return c2, None
 
-    out = jax.lax.while_loop(cond, body, carry)
+    if renew:
+        scale0 = jnp.ones((L, 2), jnp.float32) \
+            .at[0].set(jnp.stack([r0_g, r0_h]))
+        leafmax0 = jnp.zeros((L, 2), jnp.float32).at[0].set(root_max)
+        out, _ = jax.lax.while_loop(
+            lambda t: cond(t[0]), lambda t: body(t[0], t[1]),
+            (carry, (scale0, leafmax0)))
+    else:
+        out = jax.lax.while_loop(cond, lambda cc: body(cc)[0], carry)
     row_ids = out.data[:n, d_cols - 1].astype(jnp.int32)
     leaf_id = jnp.zeros(n, jnp.int32).at[row_ids].set(
         out.pos_leaf[:n], unique_indices=True)
@@ -1786,12 +2153,10 @@ def resolve_strategy(config: Config, dataset: Dataset,
     back to compact."""
     strat = forced or strategy_env()
     if strat == "auto":
+        # the quantized pipeline rides every strategy: masked (int pool
+        # + dequant-hook scans) below the compaction threshold, packed
+        # compact/chunk (one-word (qg|qh) rows) above it
         strat = "compact" if dataset.num_data >= 65536 else "masked"
-        # the quantized-gradient pipeline lives on the masked program
-        # (int pool + dequantized scans); the packed compact/chunk cores
-        # bitcast f32 gh into their working buffer and stay float-only
-        if config.quant_bits:
-            strat = "masked"
     if strat == "chunk":
         _, pool_slots = plan_histogram_pool(config, dataset)
         if pool_slots > 0:
@@ -1895,9 +2260,11 @@ class DeviceTreeLearner:
         # build into the matmul pipeline better than Mosaic schedules it),
         # so the fused XLA path is the default even on TPU.
         self._use_pallas = use_pallas_env() and jax.default_backend() == "tpu"
-        # quantized-gradient training: >0 switches the masked grow_tree
-        # to exact int32 histograms (jit cache keys on this static)
+        # quantized-gradient training: >0 switches every growth strategy
+        # to exact int32 histograms (jit caches key on this static);
+        # quant_renew enables the packed cores' leaf-wise re-quantization
         self.quant_bits = config.quant_bits
+        self.quant_renew = bool(getattr(config, "quant_renew", True))
         self.hist_chunk = int(config.hist_chunk_size or 0)
         requested = strategy or strategy_env()
         self.strategy = resolve_strategy(config, dataset, strategy)
@@ -2036,12 +2403,6 @@ class DeviceTreeLearner:
         # check the learner they will actually build.
         slot_bytes, pool_slots = plan_histogram_pool(config, dataset)
         strat = resolve_strategy(config, dataset, strategy)
-        if config.quant_bits and strat != "masked":
-            # quantized growth is implemented on the masked strategy only;
-            # learners that force compact/chunk (the sharded device
-            # subclasses) fall back to the host-loop learners, which
-            # carry the full quantized pipeline
-            return False
         if strat == "compact" and pool_slots > 0:
             slots = pool_slots
         else:
@@ -2129,18 +2490,21 @@ class DeviceTreeLearner:
         masked full-window histogram fallback), and only below 2**24
         rows where the float32 record counts that pick the smaller side
         are exact integers."""
+        trivial = trivial_weights and self.dataset.num_data < (1 << 24)
         if self.strategy == "chunk":
             return grow_tree_chunk, dict(
                 c_cols=self.c_cols, item_bits=self.item_bits,
                 chunk_rows=self.chunk_rows,
                 fuse_hist=not flag("LGBM_TPU_CHUNK_NO_FUSE_HIST"),
-                partition=self._partition_mode)
+                partition=self._partition_mode,
+                trivial_weights=trivial,
+                quant_bits=self.quant_bits, quant_renew=self.quant_renew)
         return grow_tree_compact, dict(
             c_cols=self.c_cols, item_bits=self.item_bits,
             pool_slots=self.pool_slots, window_step=self.window_step,
-            trivial_weights=(trivial_weights
-                             and self.dataset.num_data < (1 << 24)),
-            partition=self._partition_mode)
+            trivial_weights=trivial,
+            partition=self._partition_mode,
+            quant_bits=self.quant_bits, quant_renew=self.quant_renew)
 
     def _run_grow(self, grad, hess, w, base_mask, key):
         """The grow-program invocation; sharded subclasses override this

@@ -73,11 +73,12 @@ def _round(x: jax.Array, key, stochastic: bool) -> jax.Array:
     return jnp.rint(x)
 
 
-@functools.partial(jax.jit, static_argnames=("grad_bits", "stochastic"))
-def quantize_gh(grad: jax.Array, hess: jax.Array, key: jax.Array,
-                *, grad_bits: int, stochastic: bool = True):
+def quantize_gh_core(grad: jax.Array, hess: jax.Array, key: jax.Array,
+                     *, grad_bits: int, stochastic: bool = True):
     """Discretize one iteration's (grad, hess) to signed integers packed
-    into ONE int32 lane per row.
+    into ONE int32 lane per row — the canonical UNJITTED core, callable
+    from inside other jitted programs (the whole-tree growers) without
+    nesting jit. Top-level callers use the jitted `quantize_gh` wrapper.
 
     Returns (packed (N,) int32, s_g, s_h): qg in the high 16 bits, qh in
     the low 16 (both within int16 by construction: quant_max <= 32767).
@@ -85,6 +86,37 @@ def quantize_gh(grad: jax.Array, hess: jax.Array, key: jax.Array,
     n = grad.shape[0]
     qcap = quant_max(grad_bits, n)
     s_g, s_h = gh_scales(grad, hess, grad_bits, n)
+    kg, kh = jax.random.split(key)
+    qg = jnp.clip(_round(grad * s_g, kg, stochastic), -qcap, qcap) \
+        .astype(jnp.int32)
+    qh = jnp.clip(_round(hess * s_h, kh, stochastic), -qcap, qcap) \
+        .astype(jnp.int32)
+    return pack_gh(qg, qh), s_g, s_h
+
+
+quantize_gh = functools.partial(jax.jit,
+                                static_argnames=("grad_bits", "stochastic"))(
+    quantize_gh_core)
+
+
+def quantize_gh_pmax(grad: jax.Array, hess: jax.Array, key: jax.Array,
+                     *, grad_bits: int, n_total: int, axis_name=None,
+                     stochastic: bool = True):
+    """Sharded in-program discretization (unjitted, for use inside
+    shard_map tree programs): the max-abs scales are pmax'd over
+    `axis_name` so every shard quantizes against the same GLOBAL range,
+    and the overflow cap uses the global row count `n_total` (per-bin
+    int32 sums — and their psum across shards — stay exact). The
+    stochastic-rounding key is decorrelated per shard via fold_in."""
+    qcap = quant_max(grad_bits, max(int(n_total), grad.shape[0]))
+    mg = jnp.max(jnp.abs(grad))
+    mh = jnp.max(jnp.abs(hess))
+    if axis_name is not None:
+        mg = jax.lax.pmax(mg, axis_name)
+        mh = jax.lax.pmax(mh, axis_name)
+        key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+    s_g = jnp.float32(qcap) / (mg + _EPS)
+    s_h = jnp.float32(qcap) / (mh + _EPS)
     kg, kh = jax.random.split(key)
     qg = jnp.clip(_round(grad * s_g, kg, stochastic), -qcap, qcap) \
         .astype(jnp.int32)
@@ -131,3 +163,86 @@ def dequantize_histogram(hist_q: jax.Array, s_g: jax.Array,
     """(..., 3) int32 integer histogram -> f32 with the iteration's
     scales. Counts pass through unscaled."""
     return hist_q.astype(jnp.float32) * dequant_scale3(s_g, s_h)
+
+
+# ---------------------------------------------------------------------------
+# Leaf-wise re-quantization (the packed compact/chunk growth cores).
+#
+# Quantizing once against the ROOT's max-abs scale starves deep leaves:
+# a leaf whose gradients span 1% of the root range uses ~log2(100) fewer
+# effective bits than its budget. The renewal scheme (LightGBM's per-leaf
+# renormalization, rendered for integer row transport):
+#
+#   * rows are STORED at 16-bit resolution (the packed (qg|qh) word has a
+#     16-bit field per component regardless of grad_bits, so the extra
+#     storage bits are free);
+#   * per leaf, the histogram OPERAND is re-quantized from the stored
+#     int16 values down to grad_bits at a LEAF-LOCAL scale: the ratio
+#     r = qcap_op / max|q16 over the leaf's rows| maps the leaf's actual
+#     range onto the full operand budget (the row maxes are measured
+#     during the partition pass, which reads every parent row anyway);
+#   * the leaf's histogram pool entry is rescaled to the new ratio before
+#     sibling subtraction (counts stay exact ints; the f32 rescale noise
+#     is ~2^-24 relative, the float path's own noise floor);
+#   * the split scan dequantizes with the leaf's effective scale
+#     s_leaf = s16 * r.
+#
+# Per-row error ~1/(s16 * r_leaf) instead of 1/s_root: a leaf spanning
+# 1% of the root range at grad_bits=8 recovers the ~6.6 bits the fixed
+# scale wasted.
+# ---------------------------------------------------------------------------
+
+
+def storage_bits(grad_bits: int, renew: bool) -> int:
+    """Row-storage resolution for the packed working buffer: 16-bit when
+    leaf re-quantization is on (the packed word's field width — free),
+    grad_bits when off (bit-exact match with the masked strategy)."""
+    return 16 if renew else grad_bits
+
+
+def requant_ratio(leaf_max_q: jax.Array, qcap_op: int) -> jax.Array:
+    """Leaf-local operand rescale ratio from the leaf's max |stored int|
+    (f32). All-zero leaves get ratio 1 (nothing to rescale)."""
+    return jnp.where(leaf_max_q > 0.0,
+                     jnp.float32(qcap_op) / jnp.maximum(leaf_max_q, 1.0),
+                     jnp.float32(1.0))
+
+
+def gh_operand_scaled(packed: jax.Array, valid: jax.Array, grad_bits: int,
+                      qcap_op: int, r_g: jax.Array,
+                      r_h: jax.Array) -> jax.Array:
+    """(N, 3) [qg, qh, valid] matmul operand re-quantized to the leaf's
+    scale: q_op = clip(rint(q16 * r), -qcap_op, qcap_op). With r == 1.0
+    this reduces exactly to gh_operand (f32 holds ints <= 32767
+    exactly), so the fixed-scale path shares this one code path."""
+    qg, qh = unpack_gh(packed)
+    qg2 = jnp.clip(jnp.rint(qg.astype(jnp.float32) * r_g),
+                   -qcap_op, qcap_op).astype(jnp.int32)
+    qh2 = jnp.clip(jnp.rint(qh.astype(jnp.float32) * r_h),
+                   -qcap_op, qcap_op).astype(jnp.int32)
+    v = valid.astype(jnp.int32)
+    return jnp.stack([qg2 * v, qh2 * v, v], axis=1) \
+        .astype(operand_dtype(grad_bits))
+
+
+def rescale_histogram(hist_q: jax.Array, r_g: jax.Array,
+                      r_h: jax.Array) -> jax.Array:
+    """Re-express an int32 (..., 3) histogram built at ratio r_old into
+    ratio r_new units (pass r = r_new / r_old per lane). The count lane
+    is NOT touched (exact integers); the (g, h) lanes round-trip through
+    f32, bounded-safe because per-bin |sum| <= qcap_op * count <= 2^30
+    in the TARGET units too (every row's rescaled magnitude is clipped
+    to qcap_op)."""
+    gh2 = jnp.rint(hist_q[..., :2].astype(jnp.float32)
+                   * jnp.stack([r_g, r_h])).astype(jnp.int32)
+    return jnp.concatenate([gh2, hist_q[..., 2:]], axis=-1)
+
+
+def wire_dtype(grad_bits: int, n: int):
+    """Reduce-scatter payload dtype for the DP scatter mode's quantized
+    histogram lanes: int16 when the SHARD-SUM bound fits — the collective
+    accumulates global per-bin sums, bounded by quant_max * n, so the
+    narrow wire is exact iff that product fits int16 — else int32 (still
+    2 lanes, 2/3 the f32 triple's bytes)."""
+    return (jnp.int16 if quant_max(grad_bits, n) * max(int(n), 1) <= 32767
+            else jnp.int32)

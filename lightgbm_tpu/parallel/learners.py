@@ -702,6 +702,12 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
 
     # ------------------------------------------------------------------
     def _grow_statics(self):
+        # quantized statics: rows carry w=0 pads (and per-shard bag
+        # masks), so the packed layout keeps the weight word; the
+        # overflow cap and the scatter wire dtype bound on GLOBAL rows
+        quant_kw = dict(quant_bits=self.quant_bits,
+                        quant_renew=self.quant_renew,
+                        quant_total_rows=self.n_pad)
         if self.strategy == "chunk":
             from ..utils.envs import flag
             return dict(c_cols=self.c_cols, item_bits=self.item_bits,
@@ -709,13 +715,13 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
                         fuse_hist=not flag("LGBM_TPU_CHUNK_NO_FUSE_HIST"),
                         scatter_cols=self.scatter_cols,
                         partition=self._partition_mode,
-                        **self._statics())
+                        **quant_kw, **self._statics())
         return dict(c_cols=self.c_cols, item_bits=self.item_bits,
                     pool_slots=self.pool_slots,
                     scatter_cols=self.scatter_cols,
                     window_step=self.window_step,
                     partition=self._partition_mode,
-                    **self._statics())
+                    **quant_kw, **self._statics())
 
     def _sharded_tree_fn(self, with_bag_key: bool, allow_bagging=True,
                          goss=None):
@@ -1099,9 +1105,12 @@ def create_tree_learner(config: Config, dataset: Dataset,
         return SerialTreeLearner(config, dataset)
     if name in ("feature", "feature_parallel"):
         # whole-tree device FP needs the identity feature->column mapping
-        # (no EFB bundles) and no by-node sampling
+        # (no EFB bundles), no by-node sampling and the float row layout
+        # (quantized packed rows gate to serial/DP; the host FP learner
+        # below carries the quantized pipeline via GSPMD shardings)
         if (not host_only
                 and dataset.bundle_arrays() is None
+                and not config.quant_bits
                 and not (0.0 < config.feature_fraction_bynode < 1.0)
                 and DeviceTreeLearner.supports(config, dataset,
                                                strategy="compact")):
@@ -1121,6 +1130,7 @@ def create_tree_learner(config: Config, dataset: Dataset,
                     else len(jax.devices()))
         if (not host_only
                 and dataset.bundle_arrays() is None
+                and not config.quant_bits
                 and not (0.0 < config.feature_fraction_bynode < 1.0)
                 and dataset.num_features > 2 * max(1, int(config.top_k))
                 and n_shards > 1

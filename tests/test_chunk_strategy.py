@@ -108,6 +108,8 @@ def test_chunk_larger_than_data(monkeypatch):
     assert a == b
 
 
+# slow: sharded-mode chunk A/B (25s compile); the compact GOSS and chunk e2e tests keep both seams covered
+@pytest.mark.slow
 def test_chunk_goss_fused_training(monkeypatch):
     # GOSS sampling + chunk growth through the fused production path
     import lightgbm_tpu as lgb
@@ -191,6 +193,8 @@ def test_chunk_data_parallel_categorical(monkeypatch):
     assert chunk_tree == grow("compact")
 
 
+# slow: sharded-mode chunk A/B (25s compile)
+@pytest.mark.slow
 def test_chunk_feature_parallel_matches_compact(monkeypatch):
     # the chunk core's feature-parallel mode (sliced hists + election)
     # must grow the identical tree as the compact FP learner
@@ -272,6 +276,8 @@ def test_chunk_scatter_matches_chunk_psum(monkeypatch):
     assert grow("scatter") == grow("psum")
 
 
+# slow: sharded-mode chunk A/B (18s compile)
+@pytest.mark.slow
 def test_chunk_scatter_categorical_matches_psum(monkeypatch):
     # categorical winners' left-bin masks must transport through the
     # chunk core's scatter election exactly as through its psum scan
@@ -306,6 +312,8 @@ def test_chunk_scatter_categorical_matches_psum(monkeypatch):
     assert scatter_tree == grow("psum")
 
 
+# slow: sharded-mode chunk A/B (26s compile)
+@pytest.mark.slow
 def test_chunk_voting_matches_compact_voting(monkeypatch):
     # round 4: the chunk core's PV-Tree seam (make_voting_search) must
     # elect and split exactly like the compact core's voting mode

@@ -44,6 +44,8 @@ def test_regression():
     assert mse < 0.4
 
 
+# slow: l1/huber objective variants of test_regression (91s compile on the 1-core tier-1 host; full CI runs them)
+@pytest.mark.slow
 def test_regression_l1_and_huber():
     x, y = make_regression()
     for obj in ("regression_l1", "huber", "fair", "quantile"):
@@ -55,6 +57,8 @@ def test_regression_l1_and_huber():
         assert mae < 1.3, (obj, mae)
 
 
+# slow: three objective variants in one compile-bound sweep (26s)
+@pytest.mark.slow
 def test_poisson_gamma_tweedie():
     r = np.random.RandomState(5)
     n, f = 1500, 6
@@ -88,6 +92,8 @@ def test_multiclass():
     assert acc > 0.85
 
 
+# slow: ova variant of test_multiclass (40s compile)
+@pytest.mark.slow
 def test_multiclassova():
     x, y = make_multiclass()
     params = {"objective": "multiclassova", "num_class": 4, "verbosity": -1}
@@ -173,6 +179,8 @@ def test_categorical_feature():
     assert float(np.mean((y - pred) ** 2)) < 0.2
 
 
+# slow: multi-valid multi-metric callback sweep (87s compile); test_early_stopping_first_metric_only keeps the path covered
+@pytest.mark.slow
 def test_early_stopping():
     x, y = make_binary(3000)
     xt, yt = x[:2000], y[:2000]
@@ -821,6 +829,8 @@ def _constant_metric(preds, train_data):
     return ("error", 0.0, False)
 
 
+# slow: metric-alias matrix compiles one eval program per alias (64s); individual metrics are covered by their own tests
+@pytest.mark.slow
 def test_metric_aliasing_matrix():
     """reference: test_engine.py:1072 test_metrics — the params/args/fobj/
     feval metric-resolution matrix for lgb.cv."""

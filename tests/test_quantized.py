@@ -292,9 +292,13 @@ def _train_parallel(x, y, tree_learner, quantized):
 
 @pytest.mark.skipif(len(jax.devices()) < 2, reason="needs multi-device")
 def test_data_parallel_quantized_int32_payload(monkeypatch):
-    """The quantized DP learner's histogram allreduce must move int32
-    lanes — and only TWO of them (the count lane stays off the wire:
-    2/3 the bytes of the float path's f32 triple)."""
+    """The host DP learner's quantized histogram allreduce must move
+    int32 lanes — and only TWO of them (the count lane stays off the
+    wire: 2/3 the bytes of the float path's f32 triple). Forced to the
+    host learner: since the packed-row tentpole the DEVICE DP learner
+    takes quantized configs too (covered by the scatter payload test in
+    test_quantized_rows.py)."""
+    monkeypatch.setenv("LGBM_TPU_HOST_LEARNER", "1")
     x, y = make_binary(n=4000)
     records = _record_psums(monkeypatch)
     b = _train_parallel(x, y, "data", quantized=True)

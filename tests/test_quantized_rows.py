@@ -1,0 +1,275 @@
+"""Quantized packed working rows (ISSUE 3): compact/chunk cores with the
+one-word (qg|qh) gh section, leaf-wise re-quantization, and the DP
+scatter mode's integer-lane reduce-scatter.
+
+Covers the acceptance surface: compact/chunk-vs-masked quantized parity
+(renew-off quantization is bit-identical to the masked strategy, so the
+whole grown ensemble must match EXACTLY; renew-on keeps AUC parity),
+the leaf-requantization error bound shrinking vs the fixed root scale
+at grad_bits=8, and the scatter collective's int16 payload dtype
+(mirroring test_quantized.py's DP lane assertions).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset as InnerDataset
+from lightgbm_tpu.models.gbdt import create_boosting
+from lightgbm_tpu.ops import histogram as hist_ops
+from lightgbm_tpu.ops import quantize as quant_ops
+
+from conftest import make_binary
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0
+    return float((ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
+                 / (pos.sum() * (~pos).sum()))
+
+
+def _train(x, y, strategy, extra, rounds=5, monkeypatch=None):
+    monkeypatch.setenv("LGBM_TPU_STRATEGY", strategy)
+    monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    params.update(extra)
+    cfg = Config(params)
+    ds = InnerDataset(x, config=cfg, label=y)
+    b = create_boosting(cfg, ds)
+    assert b.learner.strategy == strategy, b.learner.strategy
+    for _ in range(rounds):
+        b.train_one_iter()
+    return b.predict_raw(x)[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# quantize_gh_core canonical export (the double-jit satellite)
+# ---------------------------------------------------------------------------
+
+def test_quantize_gh_core_is_canonical():
+    """quantize_gh_core is the unjitted core: callable from inside jit
+    (no __wrapped__ reach) and identical to the jitted wrapper."""
+    r = np.random.RandomState(0)
+    g = jnp.asarray(r.randn(512).astype(np.float32))
+    h = jnp.asarray(r.rand(512).astype(np.float32))
+    key = jax.random.PRNGKey(3)
+    p1, sg1, sh1 = quant_ops.quantize_gh(g, h, key, grad_bits=8)
+
+    @jax.jit
+    def inner(g, h, key):
+        return quant_ops.quantize_gh_core(g, h, key, grad_bits=8)
+
+    p2, sg2, sh2 = inner(g, h, key)
+    assert bool(jnp.all(p1 == p2))
+    assert float(sg1) == float(sg2) and float(sh1) == float(sh2)
+    # no caller in the tree reaches into __wrapped__ of quantize_gh
+    import subprocess, pathlib  # noqa: E401
+    root = pathlib.Path(__file__).resolve().parents[1] / "lightgbm_tpu"
+    hits = subprocess.run(
+        ["grep", "-rn", "quantize_gh.__wrapped__", str(root)],
+        capture_output=True, text=True).stdout
+    assert hits == "", hits
+
+
+# ---------------------------------------------------------------------------
+# packed-core parity with the masked strategy
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("strategy", ["compact", "chunk"])
+def test_packed_quantized_bitexact_vs_masked(strategy, monkeypatch):
+    """With quant_renew=false the packed cores quantize with the SAME
+    key/bits as the masked strategy and integer sums are order-free, so
+    every histogram — root included — is bit-exact across strategies
+    and the grown ensembles are IDENTICAL."""
+    x, y = make_binary(n=3000)
+    q = {"quantized_grad": True, "grad_bits": 8, "quant_renew": False}
+    p_masked = _train(x, y, "masked", q, monkeypatch=monkeypatch)
+    p_packed = _train(x, y, strategy, q, monkeypatch=monkeypatch)
+    assert np.array_equal(p_masked, p_packed)
+
+
+@pytest.mark.parametrize("strategy", ["compact", "chunk"])
+def test_packed_quantized_auc_parity(strategy, monkeypatch):
+    """Default (renew-on) quantized packed training keeps AUC parity
+    with the float path on the same strategy: |dAUC| <= 0.005."""
+    x, y = make_binary(n=6000)
+    p_float = _train(x, y, strategy, {}, rounds=8, monkeypatch=monkeypatch)
+    p_quant = _train(x, y, strategy,
+                     {"quantized_grad": True, "grad_bits": 8},
+                     rounds=8, monkeypatch=monkeypatch)
+    auc_f, auc_q = _auc(y, p_float), _auc(y, p_quant)
+    assert abs(auc_f - auc_q) <= 0.005, (auc_f, auc_q)
+    assert auc_f > 0.9 and auc_q > 0.9
+
+
+def test_pooled_quantized_compact(monkeypatch):
+    """LRU-capped histogram pool + quantized rows: the parent-miss
+    rebuild path (hist_other) must produce int32 histograms consistent
+    with the subtraction path — the model still learns."""
+    x, y = make_binary(n=3000)
+    p = _train(x, y, "compact",
+               {"quantized_grad": True, "grad_bits": 8,
+                "num_leaves": 31, "histogram_pool_size": 0.04},
+               rounds=5, monkeypatch=monkeypatch)
+    assert _auc(y, p) > 0.9
+
+
+def test_weighted_layout_bagging_uncompacted(monkeypatch):
+    """Bagging with bag compaction disabled drives the TWO-word
+    (packed | weight) quantized layout: out-of-bag rows must stay off
+    the count lane, and the model must still learn."""
+    monkeypatch.setenv("LGBM_TPU_NO_BAG_COMPACT", "1")
+    x, y = make_binary(n=3000)
+    p = _train(x, y, "compact",
+               {"quantized_grad": True, "grad_bits": 8,
+                "bagging_freq": 1, "bagging_fraction": 0.7},
+               rounds=6, monkeypatch=monkeypatch)
+    assert _auc(y, p) > 0.9
+
+
+# ---------------------------------------------------------------------------
+# leaf-wise re-quantization: the error bound must SHRINK vs fixed scale
+# ---------------------------------------------------------------------------
+
+def test_leaf_requant_error_shrinks_at_8_bits():
+    """A leaf spanning ~0.1% of the root gradient range: re-quantizing
+    its histogram operand at the leaf-local scale (16-bit storage ->
+    8-bit operand, ops/quantize requant scheme) must beat the fixed
+    root-scale 8-bit histogram by a wide margin."""
+    n, b = 4096, 32
+    r = np.random.RandomState(5)
+    grad = (r.randn(n) * 0.01).astype(np.float32)
+    grad[:64] = (r.randn(64) * 10.0).astype(np.float32)  # root-range rows
+    hess = np.abs(grad) * 0.5 + 0.01
+    codes = jnp.asarray(r.randint(0, b, (n, 4), dtype=np.uint8))
+    leaf = np.ones(n, bool)
+    leaf[:64] = False                                    # the small leaf
+    leaf_j = jnp.asarray(leaf)
+    key = jax.random.PRNGKey(11)
+    gj, hj = jnp.asarray(grad), jnp.asarray(hess)
+
+    # fixed root scale at 8 bits
+    p8, sg8, sh8 = quant_ops.quantize_gh(gj, hj, key, grad_bits=8)
+    hq_fixed = hist_ops.build_histogram_quantized(
+        codes, quant_ops.gh_operand(p8, leaf_j, 8), b)
+    deq_fixed = np.asarray(quant_ops.dequantize_histogram(
+        hq_fixed, sg8, sh8), np.float64)
+
+    # renew: 16-bit storage, leaf-local 8-bit operand
+    p16, sg16, sh16 = quant_ops.quantize_gh(gj, hj, key, grad_bits=16)
+    qg16, qh16 = quant_ops.unpack_gh(p16)
+    qcap8 = quant_ops.quant_max(8, n)
+    r_g = quant_ops.requant_ratio(
+        jnp.max(jnp.abs(qg16) * leaf_j).astype(jnp.float32), qcap8)
+    r_h = quant_ops.requant_ratio(
+        jnp.max(jnp.abs(qh16) * leaf_j).astype(jnp.float32), qcap8)
+    hq_renew = hist_ops.build_histogram_quantized(
+        codes, quant_ops.gh_operand_scaled(p16, leaf_j, 8, qcap8, r_g, r_h),
+        b)
+    deq_renew = np.asarray(quant_ops.dequantize_histogram(
+        hq_renew, sg16 * r_g, sh16 * r_h), np.float64)
+
+    cn = np.asarray(codes)
+    errs = {}
+    for name, deq in (("fixed", deq_fixed), ("renew", deq_renew)):
+        e = 0.0
+        for lane, vec in ((0, grad), (1, hess)):
+            for fi in range(cn.shape[1]):
+                ref = np.zeros(b, np.float64)
+                np.add.at(ref, cn[leaf, fi], vec[leaf].astype(np.float64))
+                e = max(e, np.max(np.abs(deq[fi, :, lane] - ref)))
+        errs[name] = e
+    # counts stay exact either way
+    assert np.array_equal(np.asarray(hq_fixed)[..., 2],
+                          np.asarray(hq_renew)[..., 2])
+    assert errs["renew"] < errs["fixed"] / 10, errs
+
+
+def test_rescale_histogram_counts_exact():
+    """rescale_histogram re-expresses the (g, h) lanes and must pass the
+    count lane through untouched (exact integers)."""
+    r = np.random.RandomState(2)
+    h = jnp.asarray(r.randint(-1000, 1000, (3, 8, 3), dtype=np.int32))
+    out = quant_ops.rescale_histogram(h, jnp.float32(2.0), jnp.float32(0.5))
+    assert out.dtype == jnp.int32
+    assert bool(jnp.all(out[..., 2] == h[..., 2]))
+    assert bool(jnp.all(out[..., 0] == h[..., 0] * 2))
+
+
+def test_storage_and_wire_dtype_helpers():
+    assert quant_ops.storage_bits(8, True) == 16
+    assert quant_ops.storage_bits(8, False) == 8
+    assert quant_ops.storage_bits(16, True) == 16
+    # qmax(4, 4000) = 7 -> 28000 fits int16; qmax(8, 4000) = 127 -> no
+    assert quant_ops.wire_dtype(4, 4000) == jnp.int16
+    assert quant_ops.wire_dtype(8, 4000) == jnp.int32
+
+
+# ---------------------------------------------------------------------------
+# DP scatter mode: integer-lane reduce-scatter payload
+# ---------------------------------------------------------------------------
+
+def _record_psum_scatters(monkeypatch):
+    records = []
+    real = jax.lax.psum_scatter
+
+    def rec(x, axis_name, **kw):
+        for leaf in jax.tree_util.tree_leaves(x):
+            records.append((tuple(getattr(leaf, "shape", ())),
+                            getattr(leaf, "dtype", None)))
+        return real(x, axis_name, **kw)
+
+    monkeypatch.setattr(jax.lax, "psum_scatter", rec)
+    return records
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs multi-device")
+@pytest.mark.parametrize("bits,wire", [(4, jnp.int16), (8, jnp.int32)])
+def test_device_dp_scatter_integer_payload(bits, wire, monkeypatch):
+    """The device DP learner's scatter-mode histogram collective must
+    reduce-scatter TWO integer lanes — int16 wire when quant_max * N
+    fits the shard-sum bound (1/3 the f32 triple's bytes), int32
+    otherwise (2/3) — never the f32 triple."""
+    monkeypatch.setenv("LGBM_TPU_DP_REDUCE", "scatter")
+    x, y = make_binary(n=4000)
+    records = _record_psum_scatters(monkeypatch)
+    cfg = Config({"objective": "binary", "tree_learner": "data",
+                  "num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1,
+                  "quantized_grad": True, "grad_bits": bits})
+    ds = InnerDataset(x, config=cfg, label=y)
+    b = create_boosting(cfg, ds)
+    from lightgbm_tpu.parallel.learners import DeviceDataParallelTreeLearner
+    assert type(b.learner) is DeviceDataParallelTreeLearner
+    assert b.learner.scatter_cols > 1
+    for _ in range(2):
+        b.train_one_iter()
+    hist_payloads = [(s, d) for s, d in records if len(s) == 3]
+    assert hist_payloads, "no scatter collective traced"
+    for shape, dtype in hist_payloads:
+        assert dtype == wire, (shape, dtype)
+        assert shape[2] == 2, shape      # [sum_qg, sum_qh], no count lane
+    assert _auc(y, b.predict_raw(x)[:, 0]) > 0.8
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs multi-device")
+def test_device_dp_float_scatter_payload_unchanged(monkeypatch):
+    """Float device-DP scatter still moves the f32 (.., 3) triple — the
+    default path's collective is byte-for-byte untouched."""
+    monkeypatch.setenv("LGBM_TPU_DP_REDUCE", "scatter")
+    x, y = make_binary(n=4000)
+    records = _record_psum_scatters(monkeypatch)
+    cfg = Config({"objective": "binary", "tree_learner": "data",
+                  "num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1})
+    ds = InnerDataset(x, config=cfg, label=y)
+    b = create_boosting(cfg, ds)
+    b.train_one_iter()
+    hist_payloads = [(s, d) for s, d in records if len(s) == 3]
+    assert hist_payloads
+    assert all(d == jnp.float32 and s[2] == 3 for s, d in hist_payloads), \
+        hist_payloads

@@ -31,6 +31,25 @@ TPU-specific runtime knobs (environment variables, not params): see
 |---|---|---|---|---|
 """
 
+FOOTER = """
+## Growth strategy × `quantized_grad`
+
+How the quantized-gradient pipeline maps onto each growth strategy
+(`LGBM_TPU_STRATEGY`; `auto` = masked below 64k rows, compact above):
+
+| Strategy / learner | Working-row gh section | Leaf re-quantization (`quant_renew`) | Histogram collective |
+|---|---|---|---|
+| `masked` | — (no row buffer; int32 pool, dequantized scans) | no (fixed root scale) | — |
+| `compact` / `chunk`, serial | ONE packed `(qg<<16\\|qh)` u32 word (vs three bitcast f32 words) | yes | — |
+| device data-parallel (psum) | packed word + 0/1 weight word (pads fenced off the count lane) | yes | exact int32 psum |
+| device data-parallel (scatter) | as psum | yes | two-lane `[sum_qg, sum_qh]` reduce-scatter: int16 wire when `quant_max * N <= 32767`, else int32; counts hessian-reconstructed |
+| feature-/voting-parallel | host-loop learners carry the quantized pipeline (device variants decline quantized configs) | no | int32 elected histograms (voting) |
+
+Weighted datasets / uncompacted bagging keep the two-word (packed +
+weight) layout; `quant_renew=false` pins the root scale and makes the
+packed cores quantize bit-identically to the masked strategy.
+"""
+
 
 def esc(s):
     return str(s).replace("|", "\\|").replace("\n", " ")
@@ -47,6 +66,7 @@ def main():
             ", ".join("`%s`" % a for a in p.get("aliases", [])) or "—",
             ", ".join("`%s`" % c for c in p.get("check", [])) or "—",
             doc))
+    out.append(FOOTER)
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "Parameters.md")
     with open(path, "w") as fh:

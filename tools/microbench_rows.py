@@ -1,0 +1,136 @@
+"""Working-row layout shootout: 3-word bitcast-f32 (grad, hess, weight)
+vs ONE packed int32 (qg<<16|qh) word per row through the compact/chunk
+cores' hot loop — a partition reorder of the packed buffer followed by a
+histogram pass over the reordered window. Row-transport bytes are the
+dominant cost once the contraction is integer (ISSUE 3 / the GPU GBDT
+literature), so the A/B isolates exactly the bytes the narrow layout
+removes: 2 u32 per row on every window move and every histogram read.
+
+Emits ONE JSON line (`rows_ab`) with bytes/row and wall ms per layout,
+like tools/microbench_hist2.py's `hist2_ab`.
+
+Usage: python tools/microbench_rows.py [rows] [reps]
+"""
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+from lightgbm_tpu.ops import quantize as quant_ops  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 262_144
+N = (N // 2048) * 2048
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+F = 28
+B = 64
+CW = F // 4                      # u32 words of packed u8 codes per row
+
+r = np.random.RandomState(0)
+codes = r.randint(0, B, (N, F), dtype=np.uint8)
+codes_pack = jnp.asarray(
+    np.ascontiguousarray(codes).view(np.uint32))        # (N, CW)
+grad = jnp.asarray(r.randn(N).astype(np.float32))
+hess = jnp.asarray(r.rand(N).astype(np.float32))
+ones = jnp.ones(N, jnp.float32)
+ids = jnp.arange(N, dtype=jnp.uint32)[:, None]
+
+# float layout: codes | bitcast (g, h, w) | id  -> CW + 4 words
+gh3 = jax.lax.bitcast_convert_type(
+    jnp.stack([grad, hess, ones], axis=1), jnp.uint32)
+data_f32 = jnp.concatenate([codes_pack, gh3, ids], axis=1)
+
+# quantized layout: codes | packed (qg|qh) | id  -> CW + 2 words
+packed, s_g, s_h = quant_ops.quantize_gh(grad, hess, jax.random.PRNGKey(0),
+                                         grad_bits=8)
+data_q = jnp.concatenate(
+    [codes_pack,
+     jax.lax.bitcast_convert_type(packed, jnp.uint32)[:, None], ids],
+    axis=1)
+
+iota = jnp.arange(B, dtype=jnp.int32)
+shifts = (jnp.arange(4, dtype=jnp.uint32) * 8)[None, None, :]
+
+
+def unpack_codes(words):
+    u = (words[:, :, None] >> shifts) & jnp.uint32(0xFF)
+    return u.reshape(words.shape[0], F).astype(jnp.int32)
+
+
+def hist_int(rows):
+    ghq = quant_ops.gh_operand(
+        jax.lax.bitcast_convert_type(rows[:, CW], jnp.int32),
+        jnp.ones(rows.shape[0], bool), 8)
+    onehot = (unpack_codes(rows[:, :CW])[:, :, None] == iota) \
+        .reshape(rows.shape[0], F * B).astype(jnp.int8)
+    return jax.lax.dot_general(
+        onehot, ghq, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def hist_f32(rows):
+    gh = jax.lax.bitcast_convert_type(rows[:, CW:CW + 3], jnp.float32)
+    onehot = (unpack_codes(rows[:, :CW])[:, :, None] == iota) \
+        .reshape(rows.shape[0], F * B)
+    hi = gh.astype(jnp.bfloat16)
+    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    oh = onehot.astype(jnp.bfloat16)
+    dn = (((0,), (0,)), ((), ()))
+    return (jax.lax.dot_general(oh, hi, dimension_numbers=dn,
+                                preferred_element_type=jnp.float32)
+            + jax.lax.dot_general(oh, lo, dimension_numbers=dn,
+                                  preferred_element_type=jnp.float32))
+
+
+def reorder_and_hist(data, key3, hist_fn):
+    """One compact-core split step: stable 3-way partition reorder of the
+    WHOLE packed buffer + histogram over the reordered front half."""
+    order = jnp.argsort(key3, stable=True)
+    moved = jnp.take(data, order, axis=0)
+    return hist_fn(moved[: N // 2])
+
+
+def timed(name, data, hist_fn, reps=REPS):
+    keybits = jnp.asarray(r.randint(0, 3, N, dtype=np.int8))
+
+    @jax.jit
+    def run(d, kb):
+        def body(i, acc):
+            h = reorder_and_hist(d, jnp.roll(kb, i), hist_fn)
+            return acc + h.ravel()[0].astype(jnp.float32)
+        return jax.lax.fori_loop(0, reps, body, jnp.float32(0.0))
+
+    np.asarray(jax.device_get(run(data, keybits)))      # compile + warm
+    t0 = time.time()
+    np.asarray(jax.device_get(run(data, keybits)))
+    dt = (time.time() - t0) / reps * 1e3
+    print(f"{name:44s} {dt:8.3f} ms  ({data.shape[1] * 4} B/row)")
+    return dt
+
+
+print(f"backend={jax.default_backend()} N={N} F={F} B={B}")
+ms_f32 = timed("reorder+hist 3-word f32 row", data_f32, hist_f32)
+ms_q = timed("reorder+hist 1-word packed row", data_q, hist_int)
+
+# accuracy cross-check: dequantized int histogram vs the f32 reference
+h_ref = np.asarray(hist_f32(data_f32[: N // 2]), np.float64)
+h_q = np.asarray(hist_int(data_q[: N // 2]), np.float64)
+h_dq = np.stack([h_q[:, 0] / float(s_g), h_q[:, 1] / float(s_h),
+                 h_q[:, 2]], axis=1)
+rel = np.max(np.abs(h_dq - h_ref)) / max(np.max(np.abs(h_ref)), 1e-9)
+print(f"dequant rel err vs f32 2-pass: {rel:.2e}")
+
+print(json.dumps({
+    "bench": "rows_ab",
+    "backend": jax.default_backend(),
+    "rows": N, "features": F, "bins": B,
+    "bytes_per_row_f32": int(data_f32.shape[1] * 4),
+    "bytes_per_row_q": int(data_q.shape[1] * 4),
+    "f32_3word_ms": round(ms_f32, 3),
+    "q_1word_ms": round(ms_q, 3),
+    "q_speedup": round(ms_f32 / ms_q, 3) if ms_q > 0 else None,
+}))
