@@ -559,6 +559,26 @@ class Booster:
         self._gbdt.rollback_one_iter()
         return self
 
+    def save_checkpoint(self, directory: str, keep_last: int = 3,
+                        history=None) -> str:
+        """Write a full training checkpoint — model trees PLUS live
+        training state (scores, bagging RNG, iteration counter) — into
+        `directory` with keep-last-`keep_last` rotation; returns the
+        path. Unlike save_model, a checkpoint resumes training
+        bit-identically (see docs/Reliability.md)."""
+        from .resilience.checkpoint import CheckpointManager
+        return CheckpointManager(directory, keep_last).save(
+            self, history=history)
+
+    def restore_checkpoint(self, path: str) -> "Booster":
+        """Restore model + training state from a checkpoint file (or the
+        newest valid one in a directory) into this booster. The booster
+        must have been constructed with the same train/valid datasets
+        and parameters as the checkpointed run."""
+        from .resilience.checkpoint import restore_checkpoint
+        restore_checkpoint(self, path)
+        return self
+
     def current_iteration(self) -> int:
         return self._gbdt.current_iteration
 

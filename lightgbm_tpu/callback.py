@@ -93,6 +93,41 @@ def reset_parameter(**kwargs) -> Callable:
     return _callback
 
 
+def checkpoint(directory: str, checkpoint_freq: int = 1, keep_last: int = 3,
+               prefix: str = "ckpt") -> Callable:
+    """Write a full training checkpoint every `checkpoint_freq`
+    iterations (atomic file, checksum manifest, keep-last-`keep_last`
+    rotation — see resilience/checkpoint.py). The callback accumulates
+    the run's eval history so a resumed run (engine.train
+    ``resume_from=``) restores `evals_result` and early-stopping state;
+    on resume the engine re-seeds that history automatically.
+
+    Runs at order 25: after record_evaluation (20) and the loss-spike
+    guard (22), before early stopping (30), so the iteration that trips
+    early stopping is still captured.
+    """
+    if checkpoint_freq <= 0:
+        raise ValueError("checkpoint_freq must be positive")
+    history: List = []
+    state = {"mgr": None}
+
+    def _callback(env: CallbackEnv) -> None:
+        if env.evaluation_result_list:
+            history.append([env.iteration,
+                            [[r[0], r[1], float(r[2]), bool(r[3])]
+                             for r in env.evaluation_result_list]])
+        if (env.iteration + 1) % checkpoint_freq == 0:
+            if state["mgr"] is None:
+                from .resilience.checkpoint import CheckpointManager
+                state["mgr"] = CheckpointManager(directory, keep_last,
+                                                 prefix)
+            path = state["mgr"].save(env.model, history=history)
+            log.debug("checkpoint written: %s", path)
+    _callback.order = 25
+    _callback._ckpt_history = history
+    return _callback
+
+
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True) -> Callable:
     best_score: List[float] = []

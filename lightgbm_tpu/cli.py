@@ -105,6 +105,20 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
     if cfg.input_model:
         from .engine import _load_init_model
         _load_init_model(booster, cfg.input_model)
+    ckpt_dir = cfg.output_model + ".ckpt"
+    if cfg.resume:
+        # resume=auto resumes from the run's own checkpoint directory;
+        # any other value is a checkpoint file or directory path
+        from .resilience.checkpoint import find_checkpoint, restore_checkpoint
+        src = (ckpt_dir if str(cfg.resume).lower() in ("auto", "true", "1")
+               else cfg.resume)
+        restore_checkpoint(booster, find_checkpoint(src))
+        log.info("Resumed training at iteration %d",
+                 booster.current_iteration())
+    mgr = None
+    if cfg.checkpoint_freq > 0:
+        from .resilience.checkpoint import CheckpointManager
+        mgr = CheckpointManager(ckpt_dir, keep_last=cfg.snapshot_keep)
     num_iters = cfg.num_iterations
     metric_freq = max(1, cfg.metric_freq)
     snapshot_freq = cfg.snapshot_freq
@@ -118,12 +132,37 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
             for dname, mname, val, _ in booster.eval():
                 log.info("Iteration:%d, %s %s : %g", it + 1, dname, mname, val)
         if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
-            booster.save_model(f"{cfg.output_model}.snapshot_iter_{it + 1}")
+            _write_snapshot(booster, cfg, it + 1)
+        if mgr is not None and (it + 1) % cfg.checkpoint_freq == 0:
+            mgr.save(booster)
         if stop:
             break
     log.info("Finished training in %.3f seconds", time.time() - t0)
     booster.save_model(cfg.output_model)
     log.info("Model saved to %s", cfg.output_model)
+
+
+def _write_snapshot(booster: Booster, cfg: Config, iteration: int) -> None:
+    """Model-text snapshot, atomic (temp file + os.replace) and rotated
+    to the newest `snapshot_keep` files — a mid-write kill can no longer
+    leave a truncated model file, and long runs no longer accumulate
+    snapshots unboundedly."""
+    import glob
+    import re
+    from .resilience.checkpoint import atomic_write_text
+    atomic_write_text(f"{cfg.output_model}.snapshot_iter_{iteration}",
+                      booster.model_to_string(num_iteration=-1))
+    snaps = []
+    for p in glob.glob(f"{cfg.output_model}.snapshot_iter_*"):
+        m = re.search(r"\.snapshot_iter_(\d+)$", p)
+        if m:
+            snaps.append((int(m.group(1)), p))
+    snaps.sort()
+    for _, p in snaps[:max(0, len(snaps) - max(1, cfg.snapshot_keep))]:
+        try:
+            os.unlink(p)
+        except OSError:  # pragma: no cover - raced away
+            pass
 
 
 def _load_matrix(path: str):

@@ -225,6 +225,9 @@ class Config:
         if self.boosting == "goss":
             if self.top_rate + self.other_rate > 1.0:
                 log.fatal("top_rate + other_rate must be <= 1.0 for GOSS")
+        if self.on_nonfinite not in ("off", "raise", "skip_iter", "rollback"):
+            log.fatal("on_nonfinite must be one of off/raise/skip_iter/"
+                      "rollback, got %s", self.on_nonfinite)
 
     # -- helpers used by the trainer -------------------------------------
     @property

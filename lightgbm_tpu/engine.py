@@ -23,7 +23,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
           fobj=None, feval=None, init_model=None, feature_name="auto",
           categorical_feature="auto", early_stopping_rounds=None,
           evals_result=None, verbose_eval=True, learning_rates=None,
-          keep_training_booster=False, callbacks=None):
+          keep_training_booster=False, callbacks=None, resume_from=None):
+    """`resume_from` continues an interrupted run from a full checkpoint
+    (a file written by callback.checkpoint / Booster.save_checkpoint, or
+    a directory holding rotated ones — the newest valid file is used).
+    When resuming, `num_boost_round` is the TOTAL iteration count of the
+    run (the same value the interrupted run was started with), and the
+    checkpointed eval history re-seeds `evals_result` and the
+    early-stopping state so `best_iteration` matches an uninterrupted
+    run. See docs/Reliability.md."""
     params = copy.deepcopy(params or {})
     if fobj is not None:
         params["objective"] = "none"
@@ -67,10 +75,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
         cbs.add(callback_mod.print_evaluation())
     elif isinstance(verbose_eval, int) and verbose_eval:
         cbs.add(callback_mod.print_evaluation(verbose_eval))
+    es_cb = None
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
-        cbs.add(callback_mod.early_stopping(
+        es_cb = callback_mod.early_stopping(
             early_stopping_rounds, first_metric_only,
-            verbose=bool(verbose_eval)))
+            verbose=bool(verbose_eval))
+        cbs.add(es_cb)
     if learning_rates is not None:
         cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
@@ -80,13 +90,30 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 
-    init_iteration = booster.current_iteration()
-    for i in range(init_iteration, init_iteration + num_boost_round):
+    begin_iteration = init_iteration = booster.current_iteration()
+    end_iteration = init_iteration + num_boost_round
+    if resume_from is not None:
+        from .resilience import checkpoint as ckpt_mod
+        data = (resume_from if isinstance(resume_from,
+                                          ckpt_mod.CheckpointData)
+                else ckpt_mod.find_checkpoint(resume_from))
+        ckpt_mod.restore_checkpoint(booster, data)
+        init_iteration = booster.current_iteration()
+        # resume finishes the ORIGINAL run: num_boost_round is the total
+        begin_iteration, end_iteration = 0, num_boost_round
+        replayed = _replay_history(
+            booster, params, data.history or [], evals_result, es_cb,
+            end_iteration, cbs)
+        if replayed is not None:      # stopping point predates checkpoint
+            return replayed
+
+    evaluation_result_list = []
+    for i in range(init_iteration, end_iteration):
         for cb in cbs_before:
             cb(callback_mod.CallbackEnv(
                 model=booster, params=params, iteration=i,
-                begin_iteration=init_iteration,
-                end_iteration=init_iteration + num_boost_round,
+                begin_iteration=begin_iteration,
+                end_iteration=end_iteration,
                 evaluation_result_list=None))
         stop = booster.update(fobj=fobj)
         evaluation_result_list = []
@@ -96,8 +123,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             for cb in cbs_after:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
-                    begin_iteration=init_iteration,
-                    end_iteration=init_iteration + num_boost_round,
+                    begin_iteration=begin_iteration,
+                    end_iteration=end_iteration,
                     evaluation_result_list=evaluation_result_list))
         except callback_mod.EarlyStopException as e:
             booster.best_iteration = e.best_iteration + 1
@@ -109,6 +136,45 @@ def train(params: Dict[str, Any], train_set: Dataset,
     for item in evaluation_result_list:
         booster.best_score[item[0]][item[1]] = item[2]
     return booster
+
+
+def _replay_history(booster, params, history, evals_result, es_cb,
+                    end_iteration, cbs):
+    """Re-seed engine-level state from a checkpoint's eval history:
+    prefill `evals_result`, re-seed any checkpoint() callbacks' rolling
+    history, and replay past evaluations through the early-stopping
+    callback so its best-score/best-iteration counters match the
+    uninterrupted run exactly. Returns the finished booster when replay
+    shows the stopping condition was already met at the checkpoint,
+    else None."""
+    records = [(int(it), [(r[0], r[1], float(r[2]), bool(r[3]))
+                          for r in results]) for it, results in history]
+    if evals_result is not None and records:
+        evals_result.clear()
+        for _, results in records:
+            for dname, mname, val, _hb in results:
+                evals_result.setdefault(dname, collections.OrderedDict())
+                evals_result[dname].setdefault(mname, []).append(val)
+    for cb in cbs:
+        seed = getattr(cb, "_ckpt_history", None)
+        if seed is not None:
+            seed[:] = [[it, [list(r) for r in results]]
+                       for it, results in records]
+    if es_cb is not None:
+        for it, results in records:
+            try:
+                es_cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=it,
+                    begin_iteration=0, end_iteration=end_iteration,
+                    evaluation_result_list=results))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                booster.best_score = collections.defaultdict(
+                    collections.OrderedDict)
+                for item in e.best_score:
+                    booster.best_score[item[0]][item[1]] = item[2]
+                return booster
+    return None
 
 
 def _load_init_model(booster: Booster, init_model) -> None:

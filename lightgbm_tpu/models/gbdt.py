@@ -28,6 +28,7 @@ from ..metrics import create_metrics
 from ..objectives import create_objective
 from ..objectives.objective import MAPE
 from ..ops import predict as predict_ops
+from ..resilience import faults
 from ..utils import log
 from ..utils.envs import pipeline_env
 from .serial_learner import SerialTreeLearner
@@ -142,6 +143,7 @@ class GBDT:
         self.best_iteration = 0
         self.label_idx = 0
         self.loaded_parameter = ""
+        self._sentry_retrying = False
         # tensorized-ensemble cache: trees_to_arrays is O(T*M) host work
         # plus a device upload, and back-to-back predicts on a static
         # model were re-paying it every call. Keyed on a model
@@ -291,12 +293,84 @@ class GBDT:
         return 0.0
 
     def _compute_gradients(self):
-        """objective->GetGradients over the whole score tensor."""
+        """objective->GetGradients over the whole score tensor. This is
+        the gradient fault-injection boundary (resilience/faults.py):
+        an active plan may poison the returned pair, which the sentries
+        below must then catch."""
         score = self.score_updater.score
         if self.num_class == 1:
             g, h = self.objective.get_gradients(score[0])
-            return g[None, :], h[None, :]
-        return self.objective.get_gradients(score)
+            g, h = g[None, :], h[None, :]
+        else:
+            g, h = self.objective.get_gradients(score)
+        plan = faults.active_plan()
+        if plan is not None:
+            g, h = plan.inject_gradients(g, h, self.iter)
+        return g, h
+
+    # -- non-finite sentries (resilience/sentries.py) -------------------
+    def _sentry_enabled(self) -> bool:
+        return getattr(self.config, "on_nonfinite", "off") \
+            not in ("off", "", "none")
+
+    def _apply_nonfinite_policy(self, what: str) -> str:
+        """Host-side policy dispatch once a guard trips. Returns 'skip'
+        (drop the iteration) or 'retry' (previous iteration rolled back,
+        recompute and go again); policy 'raise' raises."""
+        from ..resilience.sentries import NonFiniteError
+        pol = self.config.on_nonfinite
+        if pol == "raise":
+            raise NonFiniteError(
+                f"non-finite {what} detected at iteration {self.iter}; "
+                "set on_nonfinite=skip_iter/rollback to continue instead")
+        # only roll back when a previous iteration remains afterwards:
+        # rolling back to an EMPTY model would replay boost-from-average
+        # with shifted bias bookkeeping
+        if pol == "rollback" and self.iter > 0 \
+                and len(self.models) > self.num_tree_per_iteration:
+            log.warning("non-finite %s at iteration %d: rolling back one "
+                        "iteration", what, self.iter)
+            self.rollback_one_iter()
+            return "retry"
+        log.warning("non-finite %s at iteration %d: skipping iteration",
+                    what, self.iter)
+        return "skip"
+
+    def _guard_gradients(self, grad, hess, recompute=None):
+        """One fused isfinite reduction over (grad, hess); returns the
+        (possibly recomputed) pair, or None when the iteration should be
+        skipped. `recompute` re-derives the pair after a rollback (None
+        for custom-fobj gradients, which cannot be recomputed here)."""
+        if not self._sentry_enabled():
+            return grad, hess
+        from ..resilience import sentries
+        for _ in range(2):
+            if sentries.all_finite(grad, hess):
+                return grad, hess
+            act = self._apply_nonfinite_policy("gradients/hessians")
+            if act != "retry" or recompute is None:
+                return None
+            grad, hess = recompute()
+        raise sentries.NonFiniteError(
+            f"non-finite gradients persist at iteration {self.iter} "
+            "after rollback")
+
+    def _guard_tree(self, tree) -> bool:
+        """Host check over the new tree's leaf outputs. True = usable;
+        False = drop the tree (policy skip/rollback); raises on 'raise'."""
+        if not self._sentry_enabled() or tree.num_leaves <= 1:
+            return True
+        vals = np.asarray(tree.leaf_value[:tree.num_leaves],
+                          dtype=np.float64)
+        if np.isfinite(vals).all():
+            return True
+        from ..resilience.sentries import NonFiniteError
+        if self.config.on_nonfinite == "raise":
+            raise NonFiniteError(
+                f"non-finite leaf outputs at iteration {self.iter}")
+        log.warning("non-finite leaf outputs at iteration %d: dropping "
+                    "tree", self.iter)
+        return False
 
     def _bagging(self, iteration: int):
         """Row sampling per iteration (reference gbdt.cpp:210-276)."""
@@ -335,6 +409,12 @@ class GBDT:
                 self.learner, "supports_fused_goss", False):
             # every current device learner carries in-program GOSS; the
             # guard protects future device learners that opt out
+            return False
+        plan = faults.active_plan()
+        if plan is not None and plan.has_gradient_faults:
+            # gradient faults inject at the host boundary
+            # (_compute_gradients); the fused step computes gradients
+            # in-program, so route through the generic path
             return False
         return (self.__class__ in (GBDT, GOSS)
                 and isinstance(self.learner, DeviceTreeLearner)
@@ -383,6 +463,22 @@ class GBDT:
         new_score, rec, rec_cat, leaf_id, k_dev = fused_step(
             score_before[0], base_mask, tree_key, bag_key,
             jnp.float32(self.shrinkage_rate))
+
+        if self._sentry_enabled():
+            # one reduction lane over the updated score row: any
+            # non-finite gradient or leaf output propagates into it, so
+            # this single flag covers the whole fused iteration
+            from ..resilience import sentries
+            if not sentries.all_finite(new_score):
+                act = self._apply_nonfinite_policy("fused iteration outputs")
+                if act == "retry" and not self._sentry_retrying:
+                    self._sentry_retrying = True
+                    try:
+                        return self._train_one_iter_fused()
+                    finally:
+                        self._sentry_retrying = False
+                self.iter += 1   # skip: nothing committed, nothing stashed
+                return False
 
         pend = (rec, rec_cat, leaf_id, k_dev, score_before, init_score,
                 self.iter, self.shrinkage_rate)
@@ -454,14 +550,26 @@ class GBDT:
             hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
                 self.num_tree_per_iteration, self.num_data)
 
+        guarded = self._guard_gradients(
+            grad, hess,
+            self._compute_gradients if gradients is None else None)
+        if guarded is None:
+            self.iter += 1   # skipped: seeds keep moving, no tree/score
+            return False
+        grad, hess = guarded
+
         bag_indices = self._bagging(self.iter)
         should_continue = False
+        sentry_dropped = False
         for k in range(self.num_tree_per_iteration):
             new_tree = Tree(2)
             if self._class_need_train[k] and self.train_set.num_features > 0:
                 new_tree = self.learner.train(
                     grad[k], hess[k], bag_indices,
                     iter_seed=self.iter * self.num_tree_per_iteration + k)
+                if not self._guard_tree(new_tree):
+                    new_tree = Tree(2)
+                    sentry_dropped = True
             if new_tree.num_leaves > 1:
                 should_continue = True
                 if (self.objective is not None
@@ -484,6 +592,13 @@ class GBDT:
             self.models.append(new_tree)
 
         if not should_continue:
+            if sentry_dropped and \
+                    len(self.models) > self.num_tree_per_iteration:
+                # every tree of this iteration was dropped by the sentry:
+                # treat as a skipped iteration, not end of training
+                del self.models[-self.num_tree_per_iteration:]
+                self.iter += 1
+                return False
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
             if len(self.models) > self.num_tree_per_iteration:
@@ -755,6 +870,84 @@ class GBDT:
                     leaf, decay_rate * old
                     + (1.0 - decay_rate) * out * self.shrinkage_rate)
 
+    # -- training-state capture/restore (resilience/checkpoint.py) -----
+    def capture_state(self) -> Dict[str, Any]:
+        """Live training state beyond the model text: everything a
+        resumed run needs to continue bit-identically. Reading `models`
+        first materializes any in-flight fused iteration, so the capture
+        is a consistent iteration boundary."""
+        if getattr(self, "_bag_rng", None) is None:
+            log.fatal("checkpointing requires a booster constructed with "
+                      "a train_set (model-only boosters have no training "
+                      "state; use save_model instead)")
+        _ = self.models
+        st: Dict[str, Any] = {
+            "iter": int(self.iter),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "best_iteration": int(self.best_iteration),
+            "num_init_iteration": int(self.num_init_iteration),
+            "bag_rng": self._bag_rng.get_state(),
+            "bag_indices": (None if self._bag_indices is None
+                            else np.asarray(self._bag_indices)),
+            "train_score": (np.asarray(
+                jax.device_get(self.score_updater.score))
+                if getattr(self, "score_updater", None) is not None
+                else None),
+            "valid_scores": [np.asarray(jax.device_get(vu.score))
+                             for vu in self.valid_updaters],
+        }
+        if isinstance(self, DART):
+            st["dart"] = {"tree_weights": list(self._tree_weights),
+                          "sum_weight": float(self._sum_weight),
+                          "drop_rng": self._drop_rng.get_state()}
+        return st
+
+    def restore_state(self, st: Dict[str, Any]) -> None:
+        """Inverse of capture_state, applied after the model trees have
+        been restored. Scores come back bit-exact from the stored f32
+        arrays (NOT replayed through the trees: replay re-associates the
+        float adds and the boost-from-average constant, which breaks
+        kill-and-resume parity)."""
+        if getattr(self, "_bag_rng", None) is None:
+            log.fatal("restoring a checkpoint requires a booster "
+                      "constructed with a train_set")
+        self.iter = int(st["iter"])
+        self.shrinkage_rate = float(st["shrinkage_rate"])
+        self.best_iteration = int(st["best_iteration"])
+        self.num_init_iteration = int(st["num_init_iteration"])
+        self._bag_rng.set_state(st["bag_rng"])
+        self._bag_indices = (None if st.get("bag_indices") is None
+                             else np.asarray(st["bag_indices"],
+                                             dtype=np.int32))
+        if st.get("train_score") is not None \
+                and getattr(self, "score_updater", None) is not None:
+            self.score_updater.score = jnp.asarray(
+                np.asarray(st["train_score"], dtype=np.float32))
+        vs = st.get("valid_scores") or []
+        if vs and len(vs) == len(self.valid_updaters):
+            for vu, arr in zip(self.valid_updaters, vs):
+                vu.score = jnp.asarray(np.asarray(arr, dtype=np.float32))
+        elif self.valid_updaters:
+            log.warning(
+                "checkpoint carries %d valid-set scores, booster has %d "
+                "valid sets: rebuilding scores by tree replay", len(vs),
+                len(self.valid_updaters))
+            per = max(self.num_tree_per_iteration, 1)
+            for i, vset in enumerate(self.valid_sets):
+                vu = ScoreUpdater(vset, self.num_class)
+                for it in range(len(self._models) // per):
+                    for k in range(per):
+                        vu.add_tree(self._models[it * per + k], k)
+                self.valid_updaters[i] = vu
+        if "dart" in st and isinstance(self, DART):
+            d = st["dart"]
+            self._tree_weights = list(d["tree_weights"])
+            self._sum_weight = float(d["sum_weight"])
+            self._drop_rng.set_state(d["drop_rng"])
+        self._last_leaf_ids.clear()
+        self._last_leaf_ids_iter = -1
+        self.invalidate_ensemble_cache()
+
     # -- model serialization -------------------------------------------
     def save_model_to_string(self, start_iteration: int = 0,
                              num_iteration: int = -1) -> str:
@@ -1010,6 +1203,13 @@ class GOSS(GBDT):
                 self.num_tree_per_iteration, self.num_data)
             hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
                 self.num_tree_per_iteration, self.num_data)
+        guarded = self._guard_gradients(
+            grad, hess,
+            self._compute_gradients if gradients is None else None)
+        if guarded is None:
+            self.iter += 1
+            return False
+        grad, hess = guarded
         self._last_grad_hess = (grad, hess)
         if self._fused_goss() is None:
             # reference warmup: no subsampling for the first
@@ -1024,12 +1224,16 @@ class GOSS(GBDT):
             hess = hess * amp[None, :]
 
         should_continue = False
+        sentry_dropped = False
         for k in range(self.num_tree_per_iteration):
             new_tree = Tree(2)
             if self._class_need_train[k] and self.train_set.num_features > 0:
                 new_tree = self.learner.train(
                     grad[k], hess[k], bag_indices,
                     iter_seed=self.iter * self.num_tree_per_iteration + k)
+                if not self._guard_tree(new_tree):
+                    new_tree = Tree(2)
+                    sentry_dropped = True
             if new_tree.num_leaves > 1:
                 should_continue = True
                 if (self.objective is not None
@@ -1048,6 +1252,11 @@ class GOSS(GBDT):
                         vu.add_constant(output, k)
             self.models.append(new_tree)
         if not should_continue:
+            if sentry_dropped and \
+                    len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+                self.iter += 1
+                return False
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
             if len(self.models) > self.num_tree_per_iteration:

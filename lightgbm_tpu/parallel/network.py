@@ -50,9 +50,15 @@ def init_from_params(machines: str, local_listen_port: int = 12400,
     if rank is None:
         log.fatal("Could not find local machine in machine list: %s", machines)
     import jax
-    jax.distributed.initialize(
-        coordinator_address=entries[0],
-        num_processes=len(entries), process_id=rank)
+    from ..resilience import faults
+    # bootstrap is the other host-collective boundary: joining the
+    # process group retries transient failures with the same bounded
+    # backoff as the in-training collectives (resilience/faults.py)
+    faults.run_collective(
+        lambda: jax.distributed.initialize(
+            coordinator_address=entries[0],
+            num_processes=len(entries), process_id=rank),
+        site="bootstrap")
     _initialized = True
     _num_machines = len(entries)
     _rank = rank

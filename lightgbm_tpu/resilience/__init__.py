@@ -1,0 +1,30 @@
+"""Fault-tolerant training: checkpoints, non-finite sentries, fault
+injection.
+
+Three coupled pieces (see docs/Reliability.md):
+
+* ``checkpoint`` — atomic, checksummed, rotated full-state checkpoints
+  and ``engine.train(resume_from=...)`` restore.
+* ``sentries``  — fused non-finite guards over each boosting iteration
+  (``on_nonfinite = raise | skip_iter | rollback``) and a loss-spike
+  rollback callback.
+* ``faults``    — deterministic, seedable fault injection
+  (``LGBM_TPU_FAULT_SPEC``) at the gradient and collective boundaries,
+  with bounded exponential-backoff retry for transient collectives.
+"""
+from . import faults                               # noqa: F401
+from .checkpoint import (CheckpointData, CheckpointError,       # noqa: F401
+                         CheckpointManager, atomic_write_text,
+                         find_checkpoint, load_checkpoint,
+                         restore_checkpoint, save_checkpoint)
+from .faults import (FaultPlan, TransientCollectiveError,       # noqa: F401
+                     run_collective)
+from .sentries import NonFiniteError, all_finite, loss_spike_guard  # noqa: F401
+
+__all__ = [
+    "faults", "FaultPlan", "TransientCollectiveError", "run_collective",
+    "CheckpointData", "CheckpointError", "CheckpointManager",
+    "atomic_write_text", "find_checkpoint", "load_checkpoint",
+    "restore_checkpoint", "save_checkpoint",
+    "NonFiniteError", "all_finite", "loss_spike_guard",
+]

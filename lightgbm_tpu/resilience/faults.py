@@ -1,0 +1,269 @@
+"""Deterministic fault injection + transient-collective retry.
+
+Production training has failure modes the happy path never exercises:
+corrupted gradients out of a flaky objective/transport, collectives that
+time out mid-allreduce, a predictor that stalls long enough to blow
+request deadlines. This layer makes every one of them *reproducible* so
+the guards (resilience/sentries.py), checkpoints (resilience/
+checkpoint.py) and the serving batcher's timeout path can be tested
+deterministically — the same role chaos harnesses play around the
+reference's distributed learners (the socket linkers' retry loops,
+linkers_socket.cpp), but seedable and in-process.
+
+Fault spec grammar (env ``LGBM_TPU_FAULT_SPEC`` or ``faults.install``):
+
+    clause[;clause...]
+
+    nan_grad@iter=7[,frac=0.01]     poison `frac` of the gradient lanes
+                                    with NaN at boosting iteration 7
+                                    (one-shot: fires at most once)
+    inf_grad@iter=7[,frac=0.01]     same with +inf
+    nan_grad@p=0.05                 poison with probability p each
+                                    iteration (seeded)
+    fail_collective@n=2             fail the first 2 collective calls
+                                    with TransientCollectiveError, then
+                                    heal (exercises the retry path)
+    fail_collective@p=0.1           fail each collective call with
+                                    probability p (seeded)
+    delay_ms=50                     sleep 50 ms at every fault site
+                                    (collectives + serving flush)
+    seed=123                        RNG seed for probabilistic clauses
+
+Hook sites: ``GBDT._compute_gradients`` (gradient boundary), the host
+parallel learners' sharded histogram/partition dispatches and
+``network.init_from_params`` (collective boundary, wrapped in
+``run_collective`` with bounded exponential backoff), and the serving
+batcher's flush (``sleep_point``). All hooks are no-ops costing one
+attribute read when no plan is installed.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils import log
+
+__all__ = ["TransientCollectiveError", "FaultPlan", "install", "clear",
+           "active_plan", "run_collective", "sleep_point"]
+
+_GLOBAL_KNOBS = ("seed", "delay_ms")
+_KNOWN = ("nan_grad", "inf_grad", "fail_collective")
+
+
+class TransientCollectiveError(RuntimeError):
+    """A collective failed in a way worth retrying (injected here; the
+    real-world analogs are preempted hosts and dropped DCN links)."""
+
+
+class _Clause:
+    __slots__ = ("name", "args", "fired")
+
+    def __init__(self, name: str, args: Dict[str, str]):
+        self.name = name
+        self.args = args
+        self.fired = False
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"_Clause({self.name}, {self.args}, fired={self.fired})"
+
+
+def parse_spec(spec: str):
+    """-> (clauses, seed, delay_ms). Raises ValueError on bad grammar."""
+    clauses: List[_Clause] = []
+    seed, delay_ms = 0, 0.0
+    for part in str(spec).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "@" in part:
+            name, _, argstr = part.partition("@")
+            name = name.strip()
+            args = {}
+            for kv in argstr.split(","):
+                if not kv.strip():
+                    continue
+                if "=" not in kv:
+                    raise ValueError(f"bad fault arg {kv!r} in {part!r}")
+                k, _, v = kv.partition("=")
+                args[k.strip()] = v.strip()
+            if name not in _KNOWN:
+                raise ValueError(f"unknown fault {name!r}")
+            clauses.append(_Clause(name, args))
+        elif "=" in part:
+            k, _, v = part.partition("=")
+            k = k.strip()
+            if k == "seed":
+                seed = int(v)
+            elif k == "delay_ms":
+                delay_ms = float(v)
+            else:
+                raise ValueError(f"unknown fault knob {k!r}")
+        else:
+            raise ValueError(f"bad fault clause {part!r}")
+    return clauses, seed, delay_ms
+
+
+class FaultPlan:
+    """A parsed spec plus the seeded RNG and per-site call counters.
+
+    One plan instance persists across the run so one-shot clauses fire
+    exactly once and `n=`-bounded clauses count globally.
+    """
+
+    def __init__(self, spec: str, seed: Optional[int] = None):
+        self.spec = spec
+        self.clauses, spec_seed, self.delay_ms = parse_spec(spec)
+        self.seed = spec_seed if seed is None else int(seed)
+        self.rng = np.random.RandomState(self.seed % (2 ** 31 - 1))
+        self.collective_calls = 0
+        self.events: List[str] = []     # fired faults, for tests/forensics
+
+    @property
+    def has_gradient_faults(self) -> bool:
+        """True when the plan poisons gradients. The fused device step
+        computes gradients in-program where the host cannot reach them,
+        so GBDT drops to the generic path while such a plan is active —
+        the harness tests the guards, not the fused fast path."""
+        return any(c.name in ("nan_grad", "inf_grad") for c in self.clauses)
+
+    # -- gradient boundary ---------------------------------------------
+    def inject_gradients(self, grad, hess, iteration: int):
+        """Possibly poison (grad, hess) for this boosting iteration.
+        Arrays are device (K, N) jax arrays; the poison path round-trips
+        through host — it only runs when a fault actually fires."""
+        for c in self.clauses:
+            if c.name not in ("nan_grad", "inf_grad"):
+                continue
+            if "iter" in c.args:
+                if c.fired or iteration != int(c.args["iter"]):
+                    continue
+            elif "p" in c.args:
+                if self.rng.rand() >= float(c.args["p"]):
+                    continue
+            else:
+                continue
+            c.fired = True
+            frac = float(c.args.get("frac", 0.01))
+            val = np.inf if c.name == "inf_grad" else np.nan
+            grad = self._poison(grad, frac, val)
+            self.events.append(f"{c.name}@iter={iteration}")
+            log.warning("fault injection: %s at iteration %d (frac=%g)",
+                        c.name, iteration, frac)
+        return grad, hess
+
+    def _poison(self, grad, frac: float, val: float):
+        import jax
+        import jax.numpy as jnp
+        g = np.array(jax.device_get(grad))
+        n = g.shape[-1]
+        k = max(1, int(n * frac))
+        rows = self.rng.choice(n, k, replace=False)
+        g[..., rows] = val
+        return jnp.asarray(g)
+
+    # -- collective / serving boundaries --------------------------------
+    def before_collective(self, site: str) -> None:
+        """Called before each collective dispatch: may sleep, may raise
+        TransientCollectiveError."""
+        self.maybe_delay(site)
+        call_n = self.collective_calls
+        self.collective_calls += 1
+        for c in self.clauses:
+            if c.name != "fail_collective":
+                continue
+            if "n" in c.args:
+                if call_n >= int(c.args["n"]):
+                    continue
+            elif "p" in c.args:
+                if self.rng.rand() >= float(c.args["p"]):
+                    continue
+            else:
+                continue
+            self.events.append(f"fail_collective@{site}#{call_n}")
+            raise TransientCollectiveError(
+                f"injected collective failure at {site} (call {call_n})")
+
+    def maybe_delay(self, site: str) -> None:
+        if self.delay_ms > 0:
+            self.events.append(f"delay@{site}")
+            time.sleep(self.delay_ms / 1e3)
+
+
+# -- global plan -------------------------------------------------------
+_plan: Optional[FaultPlan] = None
+_env_plan: Optional[FaultPlan] = None
+_env_spec: Optional[str] = None
+
+
+def install(spec: Optional[str], seed: Optional[int] = None
+            ) -> Optional[FaultPlan]:
+    """Install a process-wide fault plan (None/'' clears). Returns it."""
+    global _plan
+    _plan = FaultPlan(spec, seed) if spec else None
+    return _plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan, else one parsed (once) from
+    LGBM_TPU_FAULT_SPEC, else None."""
+    global _env_plan, _env_spec
+    if _plan is not None:
+        return _plan
+    spec = os.environ.get("LGBM_TPU_FAULT_SPEC", "")
+    if not spec:
+        return None
+    if spec != _env_spec:
+        _env_spec = spec
+        _env_plan = FaultPlan(spec)
+    return _env_plan
+
+
+def sleep_point(site: str) -> None:
+    """Pure-delay fault site (serving flush, eval loops)."""
+    plan = active_plan()
+    if plan is not None:
+        plan.maybe_delay(site)
+
+
+def _retry_budget():
+    return (int(os.environ.get("LGBM_TPU_COLLECTIVE_RETRIES", 3)),
+            float(os.environ.get("LGBM_TPU_RETRY_BASE_MS", 10.0)) / 1e3)
+
+
+def run_collective(fn, site: str = "collective",
+                   retries: Optional[int] = None,
+                   base_delay_s: Optional[float] = None):
+    """Dispatch a host-side collective call with bounded exponential-
+    backoff retry on TransientCollectiveError. With no active plan this
+    is a plain call — zero overhead on the clean path. Retrying re-runs
+    the same jitted program, which is side-effect-free, so a retry is
+    always consistent."""
+    plan = active_plan()
+    if plan is None:
+        return fn()
+    env_retries, env_base = _retry_budget()
+    budget = env_retries if retries is None else int(retries)
+    delay = env_base if base_delay_s is None else float(base_delay_s)
+    attempt = 0
+    while True:
+        try:
+            plan.before_collective(site)
+            return fn()
+        except TransientCollectiveError as exc:
+            attempt += 1
+            if attempt > budget:
+                log.warning("collective %s failed after %d retries", site,
+                            budget)
+                raise
+            log.warning("transient failure at %s (attempt %d/%d): %s; "
+                        "retrying in %.0f ms", site, attempt, budget, exc,
+                        delay * 1e3)
+            time.sleep(delay)
+            delay = min(delay * 2.0, 1.0)

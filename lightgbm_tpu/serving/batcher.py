@@ -28,6 +28,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..resilience import faults
 from ..utils import log
 from .stats import ServingStats
 
@@ -186,6 +187,10 @@ class MicroBatcher:
             return batch
 
     def _execute(self, batch: List[_Pending]) -> int:
+        # fault site: an injected delay here models a stalled device /
+        # slow predictor, driving requests past their deadlines so the
+        # timeout path below is deterministically testable
+        faults.sleep_point("serve_flush")
         now = time.monotonic()
         live: List[_Pending] = []
         for item in batch:

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Chaos bench: guard overhead, kill-and-resume parity, faulted recovery.
+
+Emits ONE JSON line (`chaos_bench`) like the other tools/ benches:
+
+* ``guard_overhead_pct`` — per-iteration cost of ``on_nonfinite``
+  guarding on a CLEAN run (the sentry is one fused isfinite lane +
+  a scalar fetch; the acceptance budget is < 2%).
+* ``resume_parity`` — training checkpointed at the midpoint and
+  resumed produces bit-identical model text to the uninterrupted run.
+* ``faulted_completed`` / ``auc_delta`` — a run with NaN gradients
+  injected mid-training under ``on_nonfinite=rollback`` completes
+  within ``auc_delta <= 0.005`` of the clean run.
+
+Usage: python tools/chaos_bench.py
+Env:   CHAOS_ROWS (6000), CHAOS_FEATURES (20), CHAOS_ITERS (24),
+       CHAOS_WARMUP (4), CHAOS_LEAVES (15) — defaults sized for a
+       1-core CPU CI host; raise them on real hardware.
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import lightgbm_tpu as lgb                      # noqa: E402
+from lightgbm_tpu import engine                 # noqa: E402
+from lightgbm_tpu.callback import checkpoint    # noqa: E402
+from lightgbm_tpu.resilience import faults      # noqa: E402
+
+N = int(os.environ.get("CHAOS_ROWS", 6000))
+F = int(os.environ.get("CHAOS_FEATURES", 20))
+ITERS = int(os.environ.get("CHAOS_ITERS", 24))
+WARMUP = int(os.environ.get("CHAOS_WARMUP", 4))
+LEAVES = int(os.environ.get("CHAOS_LEAVES", 15))
+
+
+def make_data(seed=7):
+    r = np.random.RandomState(seed)
+    x = r.randn(N, F)
+    logit = x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+    y = (logit + r.randn(N) * 0.5 > 0).astype(np.float64)
+    return x, y
+
+
+def auc(scores, label):
+    order = np.argsort(scores)
+    lab = label[order]
+    n1 = lab.sum()
+    n0 = len(lab) - n1
+    ranks = np.arange(1, len(lab) + 1)
+    return float((ranks[lab > 0].sum() - n1 * (n1 + 1) / 2) / (n0 * n1))
+
+
+def measure_overhead(x, y, k=None):
+    """Per-iteration cost of the non-finite sentry on a clean run,
+    measured on ONE booster: warm up, time k guard-off iterations, flip
+    the sentry on (it lives OUTSIDE the compiled device step, so no jit
+    cache is invalidated), burn one iteration to compile the tiny
+    finite-reduce lane, time k guard-on iterations. A fresh booster per
+    config would recompile its fused step inside the timed window and
+    measure XLA, not the guard."""
+    k = k or max(4, (ITERS - WARMUP - 1) // 2)
+    params = {"objective": "binary", "num_leaves": LEAVES,
+              "verbosity": -1}
+    bst = lgb.Booster(params, lgb.Dataset(x, y, free_raw_data=False))
+
+    def timed(n):
+        t0 = time.monotonic()
+        for _ in range(n):
+            bst.update()
+        _ = bst._gbdt.models    # flush any pipelined fused iteration
+        return (time.monotonic() - t0) / n
+
+    for _ in range(WARMUP):
+        bst.update()
+    _ = bst._gbdt.models
+    t_base = timed(k)
+    bst._gbdt.config.on_nonfinite = "rollback"
+    bst.update()                # compile the isfinite reduction lane
+    _ = bst._gbdt.models
+    t_guard = timed(k)
+    return t_base, t_guard
+
+
+def main():
+    x, y = make_data()
+    faults.clear()
+
+    # -- guard overhead on the clean path -------------------------------
+    t_base, t_guard = measure_overhead(x, y)
+    overhead_pct = 100.0 * (t_guard - t_base) / max(t_base, 1e-12)
+
+    # -- kill-and-resume parity ----------------------------------------
+    half = max(2, ITERS // 2)
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos_ckpt_")
+    try:
+        params = {"objective": "binary", "num_leaves": LEAVES,
+                  "verbosity": -1}
+        full = engine.train(dict(params), lgb.Dataset(x, y),
+                            num_boost_round=ITERS, verbose_eval=False)
+        engine.train(dict(params), lgb.Dataset(x, y),
+                     num_boost_round=half, verbose_eval=False,
+                     callbacks=[checkpoint(ckpt_dir,
+                                           checkpoint_freq=half)])
+        resumed = engine.train(dict(params), lgb.Dataset(x, y),
+                               num_boost_round=ITERS, verbose_eval=False,
+                               resume_from=ckpt_dir)
+        parity = (full._gbdt.save_model_to_string(0, -1)
+                  == resumed._gbdt.save_model_to_string(0, -1))
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    # -- faulted recovery ----------------------------------------------
+    a_clean = auc(full.predict(x), y)
+    faults.install(f"nan_grad@iter={half},frac=0.05")
+    params_rb = {"objective": "binary", "num_leaves": LEAVES,
+                 "verbosity": -1, "on_nonfinite": "rollback"}
+    faulted = engine.train(params_rb, lgb.Dataset(x, y),
+                           num_boost_round=ITERS, verbose_eval=False)
+    faults.clear()
+    preds = faulted.predict(x)
+    a_faulted = auc(preds, y)
+    delta = abs(a_clean - a_faulted)
+
+    print(json.dumps({
+        "chaos_bench": {
+            "rows": N, "features": F, "iters": ITERS,
+            "leaves": LEAVES,
+            "base_iter_ms": round(t_base * 1e3, 3),
+            "guard_iter_ms": round(t_guard * 1e3, 3),
+            "guard_overhead_pct": round(overhead_pct, 2),
+            "resume_parity": bool(parity),
+            "auc_clean": round(a_clean, 5),
+            "auc_faulted": round(a_faulted, 5),
+            "auc_delta": round(delta, 5),
+            "faulted_completed": bool(np.isfinite(preds).all()
+                                      and delta <= 0.005),
+        }}))
+
+
+if __name__ == "__main__":
+    main()
