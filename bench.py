@@ -204,9 +204,10 @@ def main():
               "LGBM_TPU_PACK_WORDS", "LGBM_TPU_PALLAS",
               "LGBM_TPU_DP_REDUCE", "LGBM_TPU_PARTITION",
               "LGBM_TPU_CHUNK", "LGBM_TPU_CHUNK_NO_FUSE_HIST",
-              "LGBM_TPU_HIST_CHUNK",
+              "LGBM_TPU_HIST_CHUNK", "LGBM_TPU_TELEMETRY",
               "BENCH_CAT_FEATURES", "BENCH_QUANTIZED",
-              "BENCH_GRAD_BITS", "BENCH_STRATEGY") if k in os.environ}
+              "BENCH_GRAD_BITS", "BENCH_STRATEGY",
+              "BENCH_TELEMETRY") if k in os.environ}
     sys.stderr.write(f"rows={N_ROWS} iters={N_ITERS} knobs={knobs}\n")
 
     # any capped run (explicit CPU or fallback) is not comparable to the
@@ -233,6 +234,11 @@ def main():
     if quantized:
         params.update(quantized_grad=True, grad_bits=grad_bits)
     hist_dtype = f"int{grad_bits}" if quantized else "bf16x2"
+    # telemetry lever: BENCH_TELEMETRY=summary|trace (or the package-wide
+    # LGBM_TPU_TELEMETRY env) turns on the per-iteration phase recorder;
+    # the breakdown is emitted as the `phase_breakdown` JSON field
+    if os.environ.get("BENCH_TELEMETRY"):
+        params.update(telemetry=os.environ["BENCH_TELEMETRY"])
     cat_cols = list(range(N_FEATURES - N_CAT, N_FEATURES)) if N_CAT else []
     ds = lgb.Dataset(x, y, categorical_feature=cat_cols or None)
     ds.construct()
@@ -249,6 +255,11 @@ def main():
     warmup_secs = time.time() - t_warm
     sys.stderr.write(
         f"warmup ({WARMUP_ITERS} iters, incl. compile) {warmup_secs:.1f}s\n")
+    from lightgbm_tpu import telemetry
+    if telemetry.enabled():
+        # breakdown should cover the TIMED loop only: drop the warmup
+        # iterations' phases (first-jit compile stalls live there)
+        telemetry.recorder.reset()
 
     def rank_auc(scores, labels):
         # tie-aware (mid-rank) AUC: few-tree models collapse many rows
@@ -358,6 +369,12 @@ def main():
         "hist_dtype": hist_dtype,
         "strategy": strategy,
         "bytes_per_row": bytes_per_row,
+        # per-iteration phase accounting over the timed loop (telemetry
+        # recorder; None with telemetry off). `coverage` is phase seconds
+        # over iteration wall — the >=90% acceptance metric.
+        "telemetry": telemetry.mode(),
+        "phase_breakdown": (telemetry.phase_breakdown()
+                            if telemetry.enabled() else None),
     }))
 
 

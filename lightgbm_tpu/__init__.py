@@ -23,9 +23,10 @@ if not _os.environ.get("LGBM_TPU_NO_COMP_CACHE"):
     except Exception:  # pragma: no cover
         pass
 
+from . import telemetry
 from .basic import Booster, Dataset
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
-                       record_evaluation, reset_parameter)
+                       record_evaluation, record_telemetry, reset_parameter)
 from .engine import CVBooster, cv, train
 from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor)
 from .utils.log import LightGBMError
@@ -43,7 +44,8 @@ __all__ = [
     "train", "cv",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "early_stopping", "print_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException", "LightGBMError",
+    "record_telemetry", "reset_parameter", "EarlyStopException",
+    "LightGBMError", "telemetry",
     "plot_importance", "plot_split_value_histogram", "plot_metric",
     "plot_tree", "create_tree_digraph",
 ]

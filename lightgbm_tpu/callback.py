@@ -93,6 +93,32 @@ def reset_parameter(**kwargs) -> Callable:
     return _callback
 
 
+def record_telemetry(period: int = 1) -> Callable:
+    """Stream each iteration's telemetry phase summary to the logger
+    (one line per `period` iterations). Needs ``telemetry=summary`` or
+    ``trace`` — with telemetry off there is nothing recorded and the
+    callback stays silent. See docs/Observability.md.
+
+    Runs at order 15: after print_evaluation (10), before
+    record_evaluation (20), so the phase line lands next to the metric
+    line for the same iteration."""
+    from .telemetry import recorder as _recorder
+
+    def _callback(env: CallbackEnv) -> None:
+        if period <= 0 or (env.iteration + 1) % period != 0:
+            return
+        info = _recorder.last_iteration()
+        if info is None:
+            return
+        phases = " ".join(
+            f"{name}={secs * 1e3:.1f}ms"
+            for name, secs in sorted(info["phases"].items()))
+        log.info("[%d]\ttelemetry wall=%.1fms %s", env.iteration + 1,
+                 info["wall_s"] * 1e3, phases)
+    _callback.order = 15
+    return _callback
+
+
 def checkpoint(directory: str, checkpoint_freq: int = 1, keep_last: int = 3,
                prefix: str = "ckpt") -> Callable:
     """Write a full training checkpoint every `checkpoint_freq`

@@ -138,6 +138,17 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
         if stop:
             break
     log.info("Finished training in %.3f seconds", time.time() - t0)
+    from . import telemetry
+    if telemetry.enabled():
+        # one-line JSON so CLI logs are grep-able the same way bench.py
+        # and tools/profile_iter.py outputs are
+        import json
+        log.info("telemetry summary: %s",
+                 json.dumps(telemetry.telemetry_summary()))
+        if telemetry.mode() == "trace":
+            trace_path = cfg.output_model + ".trace.json"
+            telemetry.dump_trace(trace_path)
+            log.info("telemetry trace written to %s", trace_path)
     booster.save_model(cfg.output_model)
     log.info("Model saved to %s", cfg.output_model)
 

@@ -228,6 +228,9 @@ class Config:
         if self.on_nonfinite not in ("off", "raise", "skip_iter", "rollback"):
             log.fatal("on_nonfinite must be one of off/raise/skip_iter/"
                       "rollback, got %s", self.on_nonfinite)
+        if self.telemetry not in ("off", "summary", "trace"):
+            log.fatal("telemetry must be one of off/summary/trace, got %s",
+                      self.telemetry)
 
     # -- helpers used by the trainer -------------------------------------
     @property

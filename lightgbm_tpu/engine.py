@@ -13,6 +13,7 @@ import numpy as np
 
 from . import callback as callback_mod
 from .basic import Booster, Dataset
+from .telemetry import recorder as telem
 from .utils import log
 
 __all__ = ["train", "cv", "CVBooster"]
@@ -118,7 +119,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         stop = booster.update(fobj=fobj)
         evaluation_result_list = []
         if reduced_valid_sets or booster._gbdt.train_metrics:
-            evaluation_result_list = booster.eval_train(feval) + booster.eval_valid(feval)
+            # recorder phase OUTSIDE the iteration bracket: eval cost
+            # lands in the run totals, not in any iteration's wall
+            with telem.phase("eval"):
+                evaluation_result_list = (booster.eval_train(feval)
+                                          + booster.eval_valid(feval))
         try:
             for cb in cbs_after:
                 cb(callback_mod.CallbackEnv(

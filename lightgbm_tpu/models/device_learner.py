@@ -55,6 +55,7 @@ from ..ops import quantize as quant_ops
 from ..ops import split as split_ops
 from ..ops.partition import decide_left
 from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
+from ..telemetry import recorder as telem
 from ..utils import log
 from ..utils.envs import (flag, partition_mode_env, strategy_env,
                           use_pallas_env)
@@ -2468,20 +2469,24 @@ class DeviceTreeLearner:
         base_mask = jnp.asarray(self._feature_mask(rng))
         key = jax.random.PRNGKey(iter_seed)
 
-        rec, rec_cat, leaf_id, n_splits, _ = self._run_grow(
-            grad, hess, w, base_mask, key)
+        with telem.phase("grow_dispatch"):
+            rec, rec_cat, leaf_id, n_splits, _ = self._run_grow(
+                grad, hess, w, base_mask, key)
 
         self.last_leaf_id = leaf_id
         self._leaf_id_host = None
-        if rec_cat is None:
-            rec_h, k = jax.device_get((rec, n_splits))
-            rec_cat_h = None
-        else:
-            rec_h, rec_cat_h, k = jax.device_get((rec, rec_cat, n_splits))
+        with telem.phase("host_sync"):
+            if rec_cat is None:
+                rec_h, k = jax.device_get((rec, n_splits))
+                rec_cat_h = None
+            else:
+                rec_h, rec_cat_h, k = jax.device_get(
+                    (rec, rec_cat, n_splits))
         k = int(k)
         if k == 0:
             log.warning("No further splits with positive gain")
-        return self.replay_tree(rec_h, k, rec_cat_h)
+        with telem.phase("tree_replay"):
+            return self.replay_tree(rec_h, k, rec_cat_h)
 
     def _grow_fn_kwargs(self, trivial_weights: bool = False):
         """(grow fn, strategy-specific kwargs) for the packed strategies.

@@ -22,6 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..config import Config
 from ..io.dataset import Dataset
 from ..metrics import create_metrics
@@ -29,6 +30,8 @@ from ..objectives import create_objective
 from ..objectives.objective import MAPE
 from ..ops import predict as predict_ops
 from ..resilience import faults
+from ..telemetry import counters as telem_counters
+from ..telemetry import recorder as telem
 from ..utils import log
 from ..utils.envs import pipeline_env
 from .serial_learner import SerialTreeLearner
@@ -108,6 +111,9 @@ class ScoreUpdater:
         if self._host_cache is None:
             self._host_cache = np.asarray(
                 jax.device_get(self._score), dtype=np.float64)
+            if telem_counters.is_active():
+                telem_counters.incr("transfer_d2h_bytes",
+                                    self._score.size * 4)
         return self._host_cache
 
 
@@ -223,6 +229,8 @@ class GBDT:
 
     def _init_train(self, train_set: Dataset) -> None:
         cfg = self.config
+        telemetry.configure(getattr(cfg, "telemetry", "off"),
+                            explicit="telemetry" in getattr(cfg, "raw", {}))
         if self.objective is None and cfg.objective != "none":
             self.objective = create_objective(cfg.objective, cfg)
         if self.objective is not None:
@@ -431,7 +439,8 @@ class GBDT:
         """One boosting iteration as one device program + one small fetch
         (see DeviceTreeLearner.make_fused_step)."""
         cfg = self.config
-        init_score = self._boost_from_average(0, True)
+        with telem.phase("boost_avg"):
+            init_score = self._boost_from_average(0, True)
         goss_params = self._fused_goss()
         # GOSS replaces bagging outright (goss.hpp overrides Bagging):
         # its warmup step must train on ALL rows even when bagging
@@ -460,16 +469,19 @@ class GBDT:
         bag_key = jax.random.PRNGKey(
             (cfg.bagging_seed + (self.iter // freq)) % (2**31 - 1))
         score_before = self.score_updater.score
-        new_score, rec, rec_cat, leaf_id, k_dev = fused_step(
-            score_before[0], base_mask, tree_key, bag_key,
-            jnp.float32(self.shrinkage_rate))
+        with telem.phase("grow_dispatch"):
+            new_score, rec, rec_cat, leaf_id, k_dev = fused_step(
+                score_before[0], base_mask, tree_key, bag_key,
+                jnp.float32(self.shrinkage_rate))
 
         if self._sentry_enabled():
             # one reduction lane over the updated score row: any
             # non-finite gradient or leaf output propagates into it, so
             # this single flag covers the whole fused iteration
             from ..resilience import sentries
-            if not sentries.all_finite(new_score):
+            with telem.phase("sentry"):
+                finite = sentries.all_finite(new_score)
+            if not finite:
                 act = self._apply_nonfinite_policy("fused iteration outputs")
                 if act == "retry" and not self._sentry_retrying:
                     self._sentry_retrying = True
@@ -490,12 +502,16 @@ class GBDT:
             # the PREVIOUS iteration's tree while this program runs on
             # device — hiding the ~70 ms/iter record-fetch round trip
             # and the host replay entirely (tools/profile_fused.py).
-            self.score_updater.score = score_before.at[0].set(new_score)
+            with telem.phase("score_update"):
+                self.score_updater.score = score_before.at[0].set(new_score)
             with self._pend_lock:
                 prev = self._pending_fused
                 self._pending_fused = pend
             self.iter += 1
-            if prev is not None and self._materialize_one(prev):
+            with telem.phase("host_sync"):
+                prev_stopped = (prev is not None
+                                and self._materialize_one(prev))
+            if prev_stopped:
                 # the PREVIOUS iteration found no split, so training
                 # should already have stopped there. Its score delta was
                 # 0, so the in-flight program saw identical gradients
@@ -513,12 +529,15 @@ class GBDT:
                 return self._train_one_iter_generic()
             return False
 
-        if self._materialize_one(pend):
+        with telem.phase("host_sync"):
+            stopped = self._materialize_one(pend)
+        if stopped:
             # delegate the stop bookkeeping (constant init-score tree on a
             # first-iteration stop, warning, model trimming) to the generic
             # path so both paths produce identical final models
             return self._train_one_iter_generic()
-        self.score_updater.score = score_before.at[0].set(new_score)
+        with telem.phase("score_update"):
+            self.score_updater.score = score_before.at[0].set(new_score)
         self.iter += 1
         return False
 
@@ -534,31 +553,35 @@ class GBDT:
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         """One boosting iteration; returns True when training should stop
         (no tree with >1 leaf was produced)."""
-        if gradients is None and hessians is None and self._fused_eligible():
-            return self._train_one_iter_fused()
-        return self._train_one_iter_generic(gradients, hessians)
+        with telem.iteration(self.iter):
+            if gradients is None and hessians is None \
+                    and self._fused_eligible():
+                return self._train_one_iter_fused()
+            return self._train_one_iter_generic(gradients, hessians)
 
     def _train_one_iter_generic(self, gradients=None, hessians=None) -> bool:
         init_scores = [0.0] * self.num_tree_per_iteration
-        if gradients is None or hessians is None:
-            for k in range(self.num_tree_per_iteration):
-                init_scores[k] = self._boost_from_average(k, True)
-            grad, hess = self._compute_gradients()
-        else:
-            grad = jnp.asarray(gradients, dtype=jnp.float32).reshape(
-                self.num_tree_per_iteration, self.num_data)
-            hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
-                self.num_tree_per_iteration, self.num_data)
+        with telem.phase("gradient"):
+            if gradients is None or hessians is None:
+                for k in range(self.num_tree_per_iteration):
+                    init_scores[k] = self._boost_from_average(k, True)
+                grad, hess = self._compute_gradients()
+            else:
+                grad = jnp.asarray(gradients, dtype=jnp.float32).reshape(
+                    self.num_tree_per_iteration, self.num_data)
+                hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
+                    self.num_tree_per_iteration, self.num_data)
 
-        guarded = self._guard_gradients(
-            grad, hess,
-            self._compute_gradients if gradients is None else None)
+            guarded = self._guard_gradients(
+                grad, hess,
+                self._compute_gradients if gradients is None else None)
         if guarded is None:
             self.iter += 1   # skipped: seeds keep moving, no tree/score
             return False
         grad, hess = guarded
 
-        bag_indices = self._bagging(self.iter)
+        with telem.phase("bagging"):
+            bag_indices = self._bagging(self.iter)
         should_continue = False
         sentry_dropped = False
         for k in range(self.num_tree_per_iteration):
@@ -608,6 +631,10 @@ class GBDT:
         return False
 
     def _update_score(self, tree: Tree, class_id: int) -> None:
+        with telem.phase("score_update"):
+            self._update_score_inner(tree, class_id)
+
+    def _update_score_inner(self, tree: Tree, class_id: int) -> None:
         leaf_id = getattr(self.learner, "last_leaf_id", None)
         if leaf_id is not None:
             self.score_updater.add_tree_by_leaf_id(tree, leaf_id, class_id)
@@ -1194,34 +1221,36 @@ class GOSS(GBDT):
                                 hessians=None) -> bool:
         # compute gradients first so GOSS sampling can see them
         init_scores = [0.0] * self.num_tree_per_iteration
-        if gradients is None or hessians is None:
-            for k in range(self.num_tree_per_iteration):
-                init_scores[k] = self._boost_from_average(k, True)
-            grad, hess = self._compute_gradients()
-        else:
-            grad = jnp.asarray(gradients, dtype=jnp.float32).reshape(
-                self.num_tree_per_iteration, self.num_data)
-            hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
-                self.num_tree_per_iteration, self.num_data)
-        guarded = self._guard_gradients(
-            grad, hess,
-            self._compute_gradients if gradients is None else None)
+        with telem.phase("gradient"):
+            if gradients is None or hessians is None:
+                for k in range(self.num_tree_per_iteration):
+                    init_scores[k] = self._boost_from_average(k, True)
+                grad, hess = self._compute_gradients()
+            else:
+                grad = jnp.asarray(gradients, dtype=jnp.float32).reshape(
+                    self.num_tree_per_iteration, self.num_data)
+                hess = jnp.asarray(hessians, dtype=jnp.float32).reshape(
+                    self.num_tree_per_iteration, self.num_data)
+            guarded = self._guard_gradients(
+                grad, hess,
+                self._compute_gradients if gradients is None else None)
         if guarded is None:
             self.iter += 1
             return False
         grad, hess = guarded
         self._last_grad_hess = (grad, hess)
-        if self._fused_goss() is None:
-            # reference warmup: no subsampling for the first
-            # 1/learning_rate iterations (goss.hpp:143-144)
-            bag_indices = None
-        else:
-            bag_indices = self._goss_sample()
-            other_idx, multiply = self._goss_amplify
-            amp = jnp.ones(self.num_data, dtype=jnp.float32).at[
-                jnp.asarray(other_idx)].set(float(multiply))
-            grad = grad * amp[None, :]
-            hess = hess * amp[None, :]
+        with telem.phase("bagging"):
+            if self._fused_goss() is None:
+                # reference warmup: no subsampling for the first
+                # 1/learning_rate iterations (goss.hpp:143-144)
+                bag_indices = None
+            else:
+                bag_indices = self._goss_sample()
+                other_idx, multiply = self._goss_amplify
+                amp = jnp.ones(self.num_data, dtype=jnp.float32).at[
+                    jnp.asarray(other_idx)].set(float(multiply))
+                grad = grad * amp[None, :]
+                hess = hess * amp[None, :]
 
         should_continue = False
         sentry_dropped = False

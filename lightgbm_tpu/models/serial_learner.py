@@ -29,6 +29,7 @@ from ..ops import fused as fused_ops
 from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
 from ..ops import split as split_ops
+from ..telemetry import recorder as telem
 from ..utils import log
 from ..utils.envs import use_pallas_env
 from .tree import Tree
@@ -213,7 +214,8 @@ class SerialTreeLearner:
 
     @staticmethod
     def _fetch_split(res, categorical: bool = False) -> dict:
-        vals = jax.device_get(res)
+        with telem.phase("host_sync"):
+            vals = jax.device_get(res)
         rec = {
             "gain": float(vals.gain),
             "feature": int(vals.feature),
@@ -263,27 +265,34 @@ class SerialTreeLearner:
             qkey = jax.random.PRNGKey(
                 (cfg.feature_fraction_seed * 9973 + 2 * iter_seed + 1)
                 % (2**31 - 1))
-            self._gh_packed, s_g, s_h = quant_ops.quantize_gh(
-                grad, hess, qkey, grad_bits=self._quant_bits)
+            with telem.phase("quantize"):
+                self._gh_packed, s_g, s_h = quant_ops.quantize_gh(
+                    grad, hess, qkey, grad_bits=self._quant_bits)
             self._gh_scales = (s_g, s_h)
             self._scales_vec = jnp.stack([s_g, s_h])
-            root_hist, totals_dev, root_res = fused_ops.fused_root_step_q(
-                indices_buf, self.binned, self._gh_packed,
-                self._scales_vec, jnp.int32(bag_cnt),
-                self._fused_meta(base_mask, rng),
-                None if root_cost is None else jnp.asarray(root_cost),
-                bucket=_bucket(bag_cnt, self.max_bucket),
-                grad_bits=self._quant_bits, hist_chunk=self._hist_chunk,
-                use_pallas=self._use_pallas, **self._scan_args())
+            with telem.phase("hist"):
+                root_hist, totals_dev, root_res = \
+                    fused_ops.fused_root_step_q(
+                        indices_buf, self.binned, self._gh_packed,
+                        self._scales_vec, jnp.int32(bag_cnt),
+                        self._fused_meta(base_mask, rng),
+                        None if root_cost is None
+                        else jnp.asarray(root_cost),
+                        bucket=_bucket(bag_cnt, self.max_bucket),
+                        grad_bits=self._quant_bits,
+                        hist_chunk=self._hist_chunk,
+                        use_pallas=self._use_pallas, **self._scan_args())
         else:
-            root_hist, totals_dev, root_res = fused_ops.fused_root_step(
-                indices_buf, self.binned, grad, hess, jnp.int32(bag_cnt),
-                self._fused_meta(base_mask, rng),
-                None if root_cost is None else jnp.asarray(root_cost),
-                bucket=_bucket(bag_cnt, self.max_bucket),
-                hist_chunk=self._hist_chunk,
-                use_pallas=self._use_pallas, **self._scan_args())
-        totals = jax.device_get(totals_dev)
+            with telem.phase("hist"):
+                root_hist, totals_dev, root_res = fused_ops.fused_root_step(
+                    indices_buf, self.binned, grad, hess,
+                    jnp.int32(bag_cnt), self._fused_meta(base_mask, rng),
+                    None if root_cost is None else jnp.asarray(root_cost),
+                    bucket=_bucket(bag_cnt, self.max_bucket),
+                    hist_chunk=self._hist_chunk,
+                    use_pallas=self._use_pallas, **self._scan_args())
+        with telem.phase("host_sync"):
+            totals = jax.device_get(totals_dev)
         root = _LeafState(0, bag_cnt, float(totals[0]), float(totals[1]), 0)
         root.hist = root_hist
         root.split = self._fetch_split(jax.device_get(root_res))
@@ -387,27 +396,29 @@ class SerialTreeLearner:
             self._cegb_feature_used[inner_f] = True
         else:
             child_costs = None
-        if self._quant_bits:
-            out = fused_ops.fused_split_step_q(
-                indices_buf, self.binned, self._gh_packed,
-                jnp.asarray(iparams), jnp.asarray(bits.view(np.int32)),
-                jnp.asarray(fparams), st.hist, self._scales_vec,
-                self._fused_meta(base_mask, rng), child_costs,
-                bucket=bucket, grad_bits=self._quant_bits,
-                hist_chunk=self._hist_chunk,
-                use_pallas=self._use_pallas, **self._scan_args())
-        else:
-            out = fused_ops.fused_split_step(
-                indices_buf, self.binned, grad, hess,
-                jnp.asarray(iparams), jnp.asarray(bits.view(np.int32)),
-                jnp.asarray(fparams), st.hist,
-                self._fused_meta(base_mask, rng), child_costs,
-                bucket=bucket, hist_chunk=self._hist_chunk,
-                use_pallas=self._use_pallas, **self._scan_args())
+        with telem.phase("partition"):
+            if self._quant_bits:
+                out = fused_ops.fused_split_step_q(
+                    indices_buf, self.binned, self._gh_packed,
+                    jnp.asarray(iparams), jnp.asarray(bits.view(np.int32)),
+                    jnp.asarray(fparams), st.hist, self._scales_vec,
+                    self._fused_meta(base_mask, rng), child_costs,
+                    bucket=bucket, grad_bits=self._quant_bits,
+                    hist_chunk=self._hist_chunk,
+                    use_pallas=self._use_pallas, **self._scan_args())
+            else:
+                out = fused_ops.fused_split_step(
+                    indices_buf, self.binned, grad, hess,
+                    jnp.asarray(iparams), jnp.asarray(bits.view(np.int32)),
+                    jnp.asarray(fparams), st.hist,
+                    self._fused_meta(base_mask, rng), child_costs,
+                    bucket=bucket, hist_chunk=self._hist_chunk,
+                    use_pallas=self._use_pallas, **self._scan_args())
 
         # ONE host fetch per split: left_count + the two winner tuples
-        left_cnt, left_rec_raw, right_rec_raw = jax.device_get(
-            (out.left_count, out.left_res, out.right_res))
+        with telem.phase("host_sync"):
+            left_cnt, left_rec_raw, right_rec_raw = jax.device_get(
+                (out.left_count, out.left_res, out.right_res))
         left_cnt = int(left_cnt)
         if left_cnt != sp["left_count"]:
             log.debug("partition/scan count mismatch: %d vs %d",

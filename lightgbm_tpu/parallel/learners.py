@@ -56,6 +56,7 @@ from ..models.tree import Tree
 from ..ops import histogram as hist_ops
 from ..ops import split as split_ops
 from ..resilience import faults
+from ..telemetry import spans as telem_spans
 from ..utils import log
 from ..utils.envs import dp_reduce_mode_env
 from .mesh import make_mesh
@@ -328,20 +329,23 @@ class DataParallelTreeLearner(SerialTreeLearner):
             begins = self._leaf_begin[leaf_id]
             cnts = self._leaf_count[leaf_id]
             bucket = _bucket(max(int(cnts.max()), 1), self.max_local_bucket)
-            if self._quant_bits:
-                fn = self._get_hist_fn_q(bucket)
+            with telem_spans.span("dp_hist", leaf=int(leaf_id),
+                                  bucket=bucket):
+                if self._quant_bits:
+                    fn = self._get_hist_fn_q(bucket)
+                    return faults.run_collective(
+                        lambda: fn(self.binned, self._idx_buf,
+                                   self._packed2,
+                                   jnp.asarray(begins, jnp.int32),
+                                   jnp.asarray(cnts, jnp.int32),
+                                   jnp.float32(float(cnts.sum()))),
+                        site="dp_hist")
+                fn = self._get_hist_fn(bucket)
                 return faults.run_collective(
-                    lambda: fn(self.binned, self._idx_buf, self._packed2,
-                               jnp.asarray(begins, jnp.int32),
-                               jnp.asarray(cnts, jnp.int32),
-                               jnp.float32(float(cnts.sum()))),
+                    lambda: fn(self.binned, self._idx_buf, self._grad2,
+                               self._hess2, jnp.asarray(begins, jnp.int32),
+                               jnp.asarray(cnts, jnp.int32)),
                     site="dp_hist")
-            fn = self._get_hist_fn(bucket)
-            return faults.run_collective(
-                lambda: fn(self.binned, self._idx_buf, self._grad2,
-                           self._hess2, jnp.asarray(begins, jnp.int32),
-                           jnp.asarray(cnts, jnp.int32)),
-                site="dp_hist")
 
         root_hist = build_hist(0)
         totals = np.asarray(
@@ -401,14 +405,19 @@ class DataParallelTreeLearner(SerialTreeLearner):
         cnts = self._leaf_count[leaf_id]
         bucket = _bucket(max(int(cnts.max()), 1), self.max_local_bucket)
         fn = self._get_part_fn(bucket)
-        new_buf, left_cnts = faults.run_collective(
-            lambda: fn(
-                self._idx_buf, self.binned,
-                jnp.asarray(begins, jnp.int32), jnp.asarray(cnts, jnp.int32),
-                jnp.int32(inner_f), jnp.int32(sp["threshold"]),
-                jnp.bool_(sp["default_left"]), jnp.int32(mapper.missing_type),
-                jnp.int32(mapper.default_bin), jnp.int32(mapper.num_bin)),
-            site="dp_partition")
+        with telem_spans.span("dp_partition", leaf=int(leaf_id),
+                              bucket=bucket):
+            new_buf, left_cnts = faults.run_collective(
+                lambda: fn(
+                    self._idx_buf, self.binned,
+                    jnp.asarray(begins, jnp.int32),
+                    jnp.asarray(cnts, jnp.int32),
+                    jnp.int32(inner_f), jnp.int32(sp["threshold"]),
+                    jnp.bool_(sp["default_left"]),
+                    jnp.int32(mapper.missing_type),
+                    jnp.int32(mapper.default_bin),
+                    jnp.int32(mapper.num_bin)),
+                site="dp_partition")
         self._idx_buf = new_buf
         left_cnts = np.asarray(jax.device_get(left_cnts), dtype=np.int64)
 
@@ -610,28 +619,32 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         cnts = self._leaf_count[st.leaf_id]
         bucket = _bucket(max(int(cnts.max()), 1), self.max_local_bucket)
         fmask = self._node_feature_mask(base_mask, rng) & (self.f_categorical == 0)
-        if self._quant_bits:
-            from ..ops.quantize import dequant_scale3
-            fn = self._get_vote_fn_q(bucket)
-            full_hist, elected_mask = faults.run_collective(
-                lambda: fn(
-                    self.binned, self._idx_buf, self._packed2,
-                    jnp.asarray(begins, jnp.int32),
-                    jnp.asarray(cnts, jnp.int32),
-                    dequant_scale3(*self._qscales), self.f_numbins,
-                    self.f_missing, self.f_default, fmask, self.f_monotone),
-                site="vote_hist")
-        else:
-            fn = self._get_vote_fn(bucket)
-            full_hist, elected_mask = faults.run_collective(
-                lambda: fn(
-                    self.binned, self._idx_buf, self._grad2, self._hess2,
-                    jnp.asarray(begins, jnp.int32),
-                    jnp.asarray(cnts, jnp.int32),
-                    jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
-                    jnp.float32(st.count), self.f_numbins, self.f_missing,
-                    self.f_default, fmask, self.f_monotone),
-                site="vote_hist")
+        with telem_spans.span("vote_hist", bucket=bucket):
+            if self._quant_bits:
+                from ..ops.quantize import dequant_scale3
+                fn = self._get_vote_fn_q(bucket)
+                full_hist, elected_mask = faults.run_collective(
+                    lambda: fn(
+                        self.binned, self._idx_buf, self._packed2,
+                        jnp.asarray(begins, jnp.int32),
+                        jnp.asarray(cnts, jnp.int32),
+                        dequant_scale3(*self._qscales), self.f_numbins,
+                        self.f_missing, self.f_default, fmask,
+                        self.f_monotone),
+                    site="vote_hist")
+            else:
+                fn = self._get_vote_fn(bucket)
+                full_hist, elected_mask = faults.run_collective(
+                    lambda: fn(
+                        self.binned, self._idx_buf, self._grad2,
+                        self._hess2,
+                        jnp.asarray(begins, jnp.int32),
+                        jnp.asarray(cnts, jnp.int32),
+                        jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
+                        jnp.float32(st.count), self.f_numbins,
+                        self.f_missing,
+                        self.f_default, fmask, self.f_monotone),
+                    site="vote_hist")
         res = split_ops.find_best_split(
             full_hist, jnp.float32(st.sum_grad), jnp.float32(st.sum_hess),
             jnp.float32(st.count), self.f_numbins, self.f_missing,

@@ -44,6 +44,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..telemetry import counters as telem_counters
+from ..telemetry import recorder as telem
 from ..utils import log
 
 __all__ = ["TransientCollectiveError", "FaultPlan", "install", "clear",
@@ -247,18 +249,25 @@ def run_collective(fn, site: str = "collective",
     always consistent."""
     plan = active_plan()
     if plan is None:
-        return fn()
+        # clean path: one recorder-gate read (a no-op context manager
+        # while telemetry is off) on top of the plain call
+        with telem.phase("collective"):
+            return fn()
     env_retries, env_base = _retry_budget()
     budget = env_retries if retries is None else int(retries)
     delay = env_base if base_delay_s is None else float(base_delay_s)
     attempt = 0
+    telem_counters.incr("collective_dispatches")
     while True:
         try:
             plan.before_collective(site)
-            return fn()
+            with telem.phase("collective"):
+                return fn()
         except TransientCollectiveError as exc:
             attempt += 1
+            telem_counters.incr("collective_retries")
             if attempt > budget:
+                telem_counters.incr("collective_failures")
                 log.warning("collective %s failed after %d retries", site,
                             budget)
                 raise

@@ -29,6 +29,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..resilience import faults
+from ..telemetry import spans as telem_spans
 from ..utils import log
 from .stats import ServingStats
 
@@ -187,6 +188,10 @@ class MicroBatcher:
             return batch
 
     def _execute(self, batch: List[_Pending]) -> int:
+        with telem_spans.span("serve_flush", requests=len(batch)):
+            return self._execute_inner(batch)
+
+    def _execute_inner(self, batch: List[_Pending]) -> int:
         # fault site: an injected delay here models a stalled device /
         # slow predictor, driving requests past their deadlines so the
         # timeout path below is deterministically testable
@@ -194,6 +199,10 @@ class MicroBatcher:
         now = time.monotonic()
         live: List[_Pending] = []
         for item in batch:
+            # queue wait = enqueue -> flush, expired requests included:
+            # the tail of this histogram is exactly what admission
+            # control and max_delay_ms tuning need to see
+            self.stats.observe("serve_queue_wait", now - item.t_enqueue)
             if item.deadline is not None and now > item.deadline:
                 self.stats.incr("serve_timeouts")
                 item.finish(error=RequestTimeout(

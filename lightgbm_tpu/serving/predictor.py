@@ -19,6 +19,7 @@ Two properties fall out of the key design:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -27,6 +28,8 @@ import numpy as np
 
 from ..ops import predict as predict_ops
 from ..ops.predict import _bucket_up
+from ..telemetry import counters as telem_counters
+from ..telemetry import spans as telem_spans
 from ..utils import log
 from ..utils.timer import timer
 
@@ -128,11 +131,18 @@ class PredictorCache:
             compiled = self._exec.get(key)
             if compiled is not None:
                 return compiled
-            with timer("serve_compile"):
+            t0 = time.perf_counter()
+            with timer("serve_compile"), \
+                    telem_spans.span("serve_compile", bucket=bucket):
                 fn = self._make_fn(model, raw_score)
                 compiled = jax.jit(fn).lower(
                     x_dev, model.arrays, model.tree_class,
                     model.denom).compile()
+            # compiles are rare and expensive: count unconditionally so
+            # the /metrics compile counters exist even with telemetry off
+            telem_counters.incr("serve_compiles")
+            telem_counters.add_seconds("serve_compile_seconds",
+                                       time.perf_counter() - t0)
             self._exec[key] = compiled
             self._buckets.setdefault(family, []).append(bucket)
             self._buckets[family].sort()
@@ -166,6 +176,8 @@ class PredictorCache:
             x = np.concatenate(
                 [x, np.zeros((bucket - n, x.shape[1]), dtype=x.dtype)],
                 axis=0)
+        if telem_counters.is_active():
+            telem_counters.incr("transfer_h2d_bytes", x.nbytes)
         x_dev = jnp.asarray(x)
         compiled = self._exec.get(family + (bucket,))
         if compiled is None:
@@ -173,10 +185,13 @@ class PredictorCache:
             compiled = self._compile(family, bucket, model, x_dev, raw_score)
         else:
             self.hits += 1
-        with timer("serve_execute"):
+        with timer("serve_execute"), \
+                telem_spans.span("serve_execute", rows=n, bucket=bucket):
             out = compiled(x_dev, model.arrays, model.tree_class,
                            model.denom)
             out = np.asarray(jax.device_get(out), dtype=np.float64)
+        if telem_counters.is_active():
+            telem_counters.incr("transfer_d2h_bytes", out.nbytes)
         return out[:n]
 
     def warm(self, model: PreparedModel, bucket_rows: int,

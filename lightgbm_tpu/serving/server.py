@@ -11,6 +11,9 @@ Endpoints:
   {"predictions": [...], "version": "v1", "num_rows": N}
 * ``GET  /stats``    counters + latency histograms (p50/p95/p99) +
   compiled-predictor cache info
+* ``GET  /metrics``  the same counters in Prometheus text format, plus
+  the process-wide telemetry counters (XLA compile events/seconds,
+  transfer bytes, collective retries, peak RSS) — scrape-ready
 * ``GET  /models``   loaded versions
 * ``POST /models``   {"model_file": path} | {"model_str": text}
   [, "version": tag] — load + warm + hot-swap to latest
@@ -83,6 +86,13 @@ class ServingApp:
         snap["models"] = self.registry.versions()
         return snap
 
+    def metrics_text(self) -> str:
+        """Prometheus text format: serving counters/latency + process
+        telemetry counters (served at GET /metrics, next to /stats)."""
+        from .. import telemetry
+        return telemetry.prometheus_text(
+            self.stats.snapshot(), self.registry.predictor.cache_info())
+
     def health(self) -> dict:
         return {"status": "ok", "model_loaded": self.registry.latest
                 is not None}
@@ -106,6 +116,15 @@ class _Handler(BaseHTTPRequestHandler):
         data = json.dumps(body).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _reply_text(self, code: int, text: str,
+                    content_type: str = "text/plain; version=0.0.4") -> None:
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -139,6 +158,12 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/stats":
             self._dispatch(self.app.stats_snapshot)
+        elif self.path == "/metrics":
+            try:
+                self._reply_text(200, self.app.metrics_text())
+            except Exception as exc:   # noqa: BLE001 — keep serving
+                log.warning("serving: /metrics failed: %s", exc)
+                self._reply(500, {"error": str(exc)})
         elif self.path == "/models":
             self._dispatch(self.app.models)
         elif self.path in ("/healthz", "/health"):

@@ -1,0 +1,139 @@
+"""Telemetry: structured tracing + metrics shared by training and serving.
+
+Three layers, all off by default and costing one module-global read per
+hook when off:
+
+* `spans` — nestable monotonic-clock spans in a ring buffer with
+  Chrome/Perfetto trace-event export (`telemetry.dump_trace(path)`).
+* `counters` — process-wide counters/gauges (XLA compile events +
+  seconds, device transfer bytes, collective retries, peak host RSS)
+  with Prometheus text exposition (`prometheus_text`, the serving
+  `/metrics` endpoint).
+* `recorder` — per-iteration phase breakdown (gradient, hist, split,
+  partition, score_update, host_sync, ...) consumed by bench.py's
+  `phase_breakdown` field, tools/profile_iter.py and the
+  `record_telemetry` callback.
+
+Modes (`telemetry` config param, `LGBM_TPU_TELEMETRY` env — env wins):
+
+* ``off``     every hook is a no-op; the float path is byte-for-byte
+  unchanged (compile events still accumulate once a listener exists —
+  they are process-lifetime forensics, not a hot path).
+* ``summary`` recorder + hot-path counters on: per-iteration phase
+  accounting, `telemetry_summary()` one-line JSON.
+* ``trace``   summary plus the span ring: every phase/span lands in the
+  trace buffer for `dump_trace`.
+
+See docs/Observability.md.
+"""
+from __future__ import annotations
+
+import os
+
+from ..utils import log
+from . import counters, recorder, spans
+from .spans import span
+
+__all__ = ["counters", "recorder", "spans", "span", "mode", "set_mode",
+           "enabled", "resolve_mode", "configure", "dump_trace",
+           "telemetry_summary", "phase_breakdown", "prometheus_text",
+           "reset"]
+
+MODES = ("off", "summary", "trace")
+_mode = "off"
+
+
+def mode() -> str:
+    return _mode
+
+
+def enabled() -> bool:
+    return _mode != "off"
+
+
+def set_mode(new_mode: str) -> str:
+    """Switch the process-wide telemetry mode, flipping the layer gates.
+    Lives entirely OUTSIDE compiled programs, so flipping it never
+    invalidates a jit cache (the warm-jit A/B overhead tests rely on
+    this, same as the non-finite sentry flag)."""
+    global _mode
+    new_mode = (new_mode or "off").strip().lower()
+    if new_mode not in MODES:
+        raise ValueError(
+            f"telemetry mode must be one of {'/'.join(MODES)}, "
+            f"got {new_mode!r}")
+    _mode = new_mode
+    active = new_mode != "off"
+    recorder.enable(active)
+    counters.set_active(active)
+    spans.enable(new_mode == "trace")
+    if active:
+        counters.install_compile_listener()
+    return _mode
+
+
+def resolve_mode(param: str = "") -> str:
+    """The ONE resolution point of the telemetry knobs: the
+    LGBM_TPU_TELEMETRY env var when set, else the config param."""
+    env = os.environ.get("LGBM_TPU_TELEMETRY", "").strip().lower()
+    return env if env else (str(param or "off").strip().lower())
+
+
+def configure(param: str = "", explicit: bool = False) -> str:
+    """Apply a training config's `telemetry` param (GBDT init calls
+    this). A default-off param does not stomp a mode set programmatically
+    via `set_mode` unless the user passed it explicitly or the env var
+    forces a value."""
+    resolved = resolve_mode(param)
+    if (explicit or resolved != "off"
+            or os.environ.get("LGBM_TPU_TELEMETRY")):
+        if resolved != _mode:
+            set_mode(resolved)
+    return _mode
+
+
+def dump_trace(path: str) -> str:
+    """Export the span ring as Chrome trace-event JSON; returns `path`."""
+    return spans.dump_trace(path)
+
+
+def telemetry_summary() -> dict:
+    """One JSON-able dict with everything: mode, counters/gauges (peak
+    RSS included), compile-event aggregates, and the run's phase
+    breakdown. bench.py and tools/chaos_bench.py print slices of this."""
+    out = {"telemetry": _mode}
+    out.update(counters.snapshot())
+    out["phase_breakdown"] = recorder.phase_breakdown()
+    return out
+
+
+def phase_breakdown() -> dict:
+    return recorder.phase_breakdown()
+
+
+def prometheus_text(serving_snapshot=None, cache_info=None) -> str:
+    """Prometheus text for the serving `/metrics` endpoint: process
+    counters + compile events + the serving stack's counters/latency
+    histograms + compiled-predictor cache gauges."""
+    extra_counters, latency, extra_gauges = None, None, None
+    if serving_snapshot:
+        extra_counters = serving_snapshot.get("counters")
+        latency = serving_snapshot.get("latency")
+    if cache_info:
+        extra_gauges = {f"predictor_cache_{k}": v
+                        for k, v in cache_info.items()}
+    return counters.prometheus_text(extra_counters, latency, extra_gauges)
+
+
+def reset() -> None:
+    """Clear accumulated state (mode unchanged). Benches call this after
+    warmup so breakdowns cover only the timed window."""
+    recorder.reset()
+    counters.reset()
+    spans.clear()
+
+
+try:
+    set_mode(resolve_mode())
+except ValueError as _exc:       # bad env value: warn, stay off
+    log.warning("LGBM_TPU_TELEMETRY: %s", _exc)

@@ -1,0 +1,155 @@
+"""Per-iteration phase breakdown for training.
+
+The trainer brackets each boosting iteration with `iteration(i)` and the
+hot sites inside it (gradient compute, learner dispatch, host syncs,
+score updates, collectives) with `phase(name)`. The recorder accumulates
+per-phase seconds twice: into the CURRENT iteration (reported by
+`last_iteration()`, streamed by the `record_telemetry` callback) and
+into run totals (reported by `phase_breakdown()`, consumed by bench.py
+and tools/profile_iter.py).
+
+Canonical phase names, so breakdowns from different paths diff cleanly:
+
+    boost_avg   gradient   quantize   bagging    hist      split
+    partition   grow_dispatch         host_sync  tree_replay
+    score_update            sentry    collective eval
+
+One program can fuse several (the device learners grow the whole tree in
+one dispatch — that is `grow_dispatch`, and the blocking record fetch is
+`host_sync`); free-form names are accepted. Phases must NOT nest — each
+second should be attributed exactly once, so `phase_sum / wall` is a
+meaningful coverage ratio. Phases recorded outside an open iteration
+(engine-side eval, a save-triggered materialize) count toward run totals
+but not toward iteration wall/coverage.
+
+Disabled (default) both hooks return the shared no-op context manager
+after one module-global read — cheap enough to stay in the float path
+permanently (the tier-1 overhead guard in tests/test_telemetry.py holds
+this to <2% per iteration).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .spans import NULL_SPAN, add_event
+
+__all__ = ["enable", "enabled", "iteration", "phase", "last_iteration",
+           "phase_breakdown", "reset"]
+
+_enabled = False
+_lock = threading.Lock()
+_totals: Dict[str, list] = {}       # name -> [seconds, calls]
+_iter_count = 0
+_iter_wall = 0.0
+_phase_in_iter = 0.0
+_last: Optional[dict] = None
+_cur: Optional[dict] = None         # {"index", "t0", "phases"}
+
+
+def enable(flag: bool = True) -> None:
+    global _enabled
+    _enabled = bool(flag)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class _IterCtx:
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __enter__(self):
+        global _cur
+        _cur = {"index": self.index, "t0": time.perf_counter(),
+                "phases": {}}
+        return self
+
+    def __exit__(self, *exc):
+        global _cur, _iter_count, _iter_wall, _phase_in_iter, _last
+        cur, _cur = _cur, None
+        if cur is None:            # reentrant/forced-closed: nothing open
+            return False
+        wall = time.perf_counter() - cur["t0"]
+        with _lock:
+            _iter_count += 1
+            _iter_wall += wall
+            _phase_in_iter += sum(cur["phases"].values())
+            _last = {"iteration": cur["index"], "wall_s": wall,
+                     "phases": dict(cur["phases"])}
+        add_event("iteration", wall, t0=cur["t0"], index=cur["index"])
+        return False
+
+
+class _PhaseCtx:
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self.t0
+        with _lock:
+            ent = _totals.setdefault(self.name, [0.0, 0])
+            ent[0] += dt
+            ent[1] += 1
+            if _cur is not None:
+                phases = _cur["phases"]
+                phases[self.name] = phases.get(self.name, 0.0) + dt
+        add_event(self.name, dt, t0=self.t0)
+        return False
+
+
+def iteration(index: int):
+    """Bracket one boosting iteration (GBDT.train_one_iter owns this)."""
+    if not _enabled:
+        return NULL_SPAN
+    return _IterCtx(index)
+
+
+def phase(name: str):
+    """Attribute a block to `name` within the current iteration."""
+    if not _enabled:
+        return NULL_SPAN
+    return _PhaseCtx(name)
+
+
+def last_iteration() -> Optional[dict]:
+    """The most recently closed iteration's {iteration, wall_s, phases}
+    (the `record_telemetry` callback's feed)."""
+    with _lock:
+        return None if _last is None else {
+            "iteration": _last["iteration"], "wall_s": _last["wall_s"],
+            "phases": dict(_last["phases"])}
+
+
+def phase_breakdown() -> dict:
+    """Run-total breakdown: per-phase seconds/calls, iteration count and
+    wall, and `coverage` = in-iteration phase seconds / iteration wall
+    (the >=90% acceptance metric; None before any iteration closes)."""
+    with _lock:
+        phases = {k: {"secs": round(v[0], 6), "calls": v[1]}
+                  for k, v in sorted(_totals.items())}
+        wall, psum, n = _iter_wall, _phase_in_iter, _iter_count
+    return {"phases": phases, "iterations": n,
+            "wall_s": round(wall, 6), "phase_sum_s": round(psum, 6),
+            "coverage": round(psum / wall, 4) if wall > 0 else None}
+
+
+def reset() -> None:
+    global _iter_count, _iter_wall, _phase_in_iter, _last, _cur
+    with _lock:
+        _totals.clear()
+        _iter_count = 0
+        _iter_wall = 0.0
+        _phase_in_iter = 0.0
+        _last = None
+        _cur = None

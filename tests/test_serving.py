@@ -13,17 +13,11 @@ from lightgbm_tpu.serving import (MicroBatcher, ModelNotFound, ModelRegistry,
                                   RequestTimeout, ServingApp)
 
 # ground-truth XLA activity counter: every trace/lower/backend-compile in
-# the process records one of these duration events
-_COMPILE_EVENTS = []
-try:
-    from jax._src import monitoring as _monitoring
+# the process records one of these duration events. Shared with the
+# telemetry subsystem (it grew out of this file's private counter).
+from lightgbm_tpu.telemetry.counters import compile_events
 
-    def _on_event(name, *a, **kw):
-        if "compile" in name:
-            _COMPILE_EVENTS.append(name)
-    _monitoring.register_event_duration_secs_listener(_on_event)
-except ImportError:   # counter unavailable: fall back to cache counters only
-    _monitoring = None
+_COMPILE_EVENTS = compile_events()
 
 
 def _train(num_boost_round=8, seed=7, n=600):

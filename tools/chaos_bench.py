@@ -11,6 +11,10 @@ Emits ONE JSON line (`chaos_bench`) like the other tools/ benches:
 * ``faulted_completed`` / ``auc_delta`` — a run with NaN gradients
   injected mid-training under ``on_nonfinite=rollback`` completes
   within ``auc_delta <= 0.005`` of the clean run.
+* ``collective_retries`` / ``collective_dispatches`` — telemetry
+  counters from the collective-retry path, exercised by a
+  ``fail_collective@n=2`` probe through ``faults.run_collective``
+  (transient failures must be retried, counted, and survive).
 
 Usage: python tools/chaos_bench.py
 Env:   CHAOS_ROWS (6000), CHAOS_FEATURES (20), CHAOS_ITERS (24),
@@ -34,6 +38,7 @@ import lightgbm_tpu as lgb                      # noqa: E402
 from lightgbm_tpu import engine                 # noqa: E402
 from lightgbm_tpu.callback import checkpoint    # noqa: E402
 from lightgbm_tpu.resilience import faults      # noqa: E402
+from lightgbm_tpu.telemetry import counters as telem_counters  # noqa: E402
 
 N = int(os.environ.get("CHAOS_ROWS", 6000))
 F = int(os.environ.get("CHAOS_FEATURES", 20))
@@ -130,6 +135,17 @@ def main():
     a_faulted = auc(preds, y)
     delta = abs(a_clean - a_faulted)
 
+    # -- collective retry probe ----------------------------------------
+    # single-host runs never reach a real collective site, so exercise
+    # faults.run_collective directly: two injected transient failures
+    # must retry (counted by the telemetry counters) and then succeed
+    faults.install("fail_collective@n=2")
+    collective_ok = faults.run_collective(lambda: "ok",
+                                          site="chaos_probe") == "ok"
+    faults.clear()
+    retries = int(telem_counters.get("collective_retries"))
+    dispatches = int(telem_counters.get("collective_dispatches"))
+
     print(json.dumps({
         "chaos_bench": {
             "rows": N, "features": F, "iters": ITERS,
@@ -143,6 +159,9 @@ def main():
             "auc_delta": round(delta, 5),
             "faulted_completed": bool(np.isfinite(preds).all()
                                       and delta <= 0.005),
+            "collective_probe_ok": bool(collective_ok and retries >= 2),
+            "collective_retries": retries,
+            "collective_dispatches": dispatches,
         }}))
 
 

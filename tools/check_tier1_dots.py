@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Tier-1 regression guard: DOTS_PASSED must not fall below the floor.
+
+The tier-1 verify command (README "Verify" section / ROADMAP.md) tees
+pytest's progress output to a log and reports DOTS_PASSED — the count of
+passing-test dots, the suite's throughput metric on the timeout-bound
+1-core CI host. This script recomputes that count from the log with the
+same extraction rule and fails LOUDLY when it regresses below the
+recorded floor.
+
+Usage: python tools/check_tier1_dots.py [logfile] [floor]
+       logfile defaults to /tmp/_t1.log, floor to $TIER1_FLOOR or 148
+Exit:  0 ok, 1 regression, 2 unreadable/empty log
+"""
+import os
+import re
+import sys
+
+# the recorded floor: tier-1 dots on the reference CI host (PR 3/4
+# measured 148; the seed was 79). Bump this when a PR raises it.
+DEFAULT_FLOOR = 148
+
+# same rule as the verify one-liner's grep: progress lines are runs of
+# pytest status characters, optionally ending in a percent marker
+_PROGRESS = re.compile(r"^[.FEsx]+( *\[ *[0-9]+%\])?$")
+
+
+def count_dots(text: str) -> int:
+    return sum(line.split("[")[0].count(".")
+               for line in text.splitlines() if _PROGRESS.match(line))
+
+
+def main(argv) -> int:
+    path = argv[1] if len(argv) > 1 else "/tmp/_t1.log"
+    floor = int(argv[2]) if len(argv) > 2 else int(
+        os.environ.get("TIER1_FLOOR", DEFAULT_FLOOR))
+    try:
+        with open(path, errors="replace") as fh:
+            text = fh.read()
+    except OSError as exc:
+        print(f"tier1_dots: cannot read {path}: {exc}", file=sys.stderr)
+        return 2
+    dots = count_dots(text)
+    if dots == 0:
+        print(f"tier1_dots: no pytest progress lines in {path} — "
+              "did the suite run?", file=sys.stderr)
+        return 2
+    if dots < floor:
+        print(f"tier1_dots: REGRESSION — {dots} passing dots < floor "
+              f"{floor} (log: {path})", file=sys.stderr)
+        return 1
+    print(f"tier1_dots: ok — {dots} passing dots >= floor {floor}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -28,7 +28,9 @@ TPU-specific runtime knobs (environment variables, not params): see
 `LGBM_TPU_VOTING_BATCHED`, `LGBM_TPU_HOST_LEARNER`). Fault-tolerance
 knobs (`on_nonfinite`, `resume`, `snapshot_keep`, `checkpoint_freq`,
 and the `LGBM_TPU_FAULT_SPEC` / `LGBM_TPU_COLLECTIVE_RETRIES` env
-vars): see `docs/Reliability.md`.
+vars): see `docs/Reliability.md`. Observability knobs (`telemetry` and
+the `LGBM_TPU_TELEMETRY` / `LGBM_TPU_TRACE_RING` env vars): see
+`docs/Observability.md`.
 
 | Parameter | Default | Aliases | Constraints | Description |
 |---|---|---|---|---|

@@ -1,22 +1,34 @@
-"""Decompose one GBDT boosting iteration into phases with wall timing.
+"""Decompose GBDT boosting iterations into phases via the telemetry recorder.
+
+Drives the SAME per-iteration recorder the trainer's telemetry hooks
+feed (lightgbm_tpu/telemetry/recorder.py) instead of its own ad-hoc
+timers, and emits ONE JSON line whose ``phase_breakdown`` field has the
+exact shape bench.py emits — so a profile here diffs directly against a
+bench run's breakdown.
 
 Usage: python tools/profile_iter.py [rows] [iters]
+Env:   PROFILE_TRACE=trace.json additionally dumps a Chrome trace-event
+       file of the profiled window (telemetry mode `trace`).
 """
+import json
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
 ITERS = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+TRACE_PATH = os.environ.get("PROFILE_TRACE", "")
 
 import jax  # noqa: E402
 
 from lightgbm_tpu.config import Config  # noqa: E402
 from lightgbm_tpu.io.dataset import Dataset  # noqa: E402
 from lightgbm_tpu.models.gbdt import create_boosting  # noqa: E402
+from lightgbm_tpu import telemetry  # noqa: E402
 
 r = np.random.RandomState(17)
 F = 28
@@ -25,56 +37,49 @@ w = r.randn(F) * (r.rand(F) > 0.4)
 y = ((x @ w * 0.3 + r.randn(N)) > 0).astype(np.float64)
 
 cfg = Config({"objective": "binary", "num_leaves": 255, "max_bin": 63,
-              "metric": "none", "min_data_in_leaf": 20, "verbosity": -1})
+              "metric": "none", "min_data_in_leaf": 20, "verbosity": -1,
+              "telemetry": "trace" if TRACE_PATH else "summary"})
 t0 = time.time()
 ds = Dataset(x, config=cfg, label=y)
 ds.construct() if hasattr(ds, "construct") else None
 bst = create_boosting(cfg, ds)
-print(f"setup {time.time()-t0:.1f}s  backend={jax.default_backend()} "
-      f"learner={type(bst.learner).__name__}")
+sys.stderr.write(
+    f"setup {time.time()-t0:.1f}s  backend={jax.default_backend()} "
+    f"learner={type(bst.learner).__name__}\n")
 
-# warm (compile) the SAME programs the phased loop below uses.
-# bst.train_one_iter() would warm the FUSED program instead, leaving the
-# first phased iteration to pay the standalone grow program's compile
-# (~50 s on the tunneled TPU) inside the averages — which made the r5
-# chain's generic path look like 13 s/iter when steady state is ~20x
-# less.
+# warm (compile) the SAME iteration program the profiled loop uses, then
+# reset the recorder so the breakdown covers only steady-state iterations
+# (first-jit compile stalls would otherwise dominate every phase).
 for _ in range(2):
-    g, h = bst._compute_gradients()
-    tree = bst.learner.train(g[0], h[0], bst._bagging(bst.iter),
-                             iter_seed=bst.iter)
-    tree.apply_shrinkage(bst.shrinkage_rate)
-    bst._update_score(tree, 0)
-    bst.models.append(tree)
-    bst.iter += 1
+    bst.train_one_iter()
+_ = bst.models            # flush any pipelined fused iteration
+telemetry.reset()
 
-def sync(v):
-    np.asarray(jax.device_get(v.ravel()[:1]))
+t_loop = time.time()
+for _ in range(ITERS):
+    bst.train_one_iter()
+_ = bst.models
+wall = time.time() - t_loop
 
-acc = {}
-def phase(name, fn):
-    t = time.time()
-    out = fn()
-    dt = time.time() - t
-    acc[name] = acc.get(name, 0.0) + dt
-    return out
+breakdown = telemetry.phase_breakdown()
+if TRACE_PATH:
+    telemetry.dump_trace(TRACE_PATH)
+    sys.stderr.write(f"trace written to {TRACE_PATH}\n")
 
-for it in range(ITERS):
-    init = phase("boost_avg", lambda: [bst._boost_from_average(k, True)
-                                       for k in range(1)])
-    g, h = phase("gradients", lambda: bst._compute_gradients())
-    phase("grad_sync", lambda: sync(g))
-    bag = phase("bagging", lambda: bst._bagging(bst.iter))
-    tree = phase("tree_train", lambda: bst.learner.train(
-        g[0], h[0], bag, iter_seed=bst.iter))
-    phase("tree_sync", lambda: sync(bst.learner.last_leaf_id))
-    phase("shrink", lambda: tree.apply_shrinkage(bst.shrinkage_rate))
-    phase("update_score", lambda: bst._update_score(tree, 0))
-    phase("score_sync", lambda: sync(bst.score_updater.score))
-    bst.models.append(tree)
-    bst.iter += 1
+for name, ent in sorted(breakdown["phases"].items()):
+    sys.stderr.write(
+        f"{name:14s} {ent['secs']/max(breakdown['iterations'],1)*1e3:9.1f}"
+        f" ms/iter  ({ent['calls']} calls)\n")
+sys.stderr.write(
+    f"{'TOTAL':14s} "
+    f"{breakdown['wall_s']/max(breakdown['iterations'],1)*1e3:9.1f} ms/iter"
+    f"  coverage={breakdown['coverage']}\n")
 
-total = sum(acc.values())
-for k, v in acc.items():
-    print(f"{k:14s} {v/ITERS*1e3:9.1f} ms/iter")
-print(f"{'TOTAL':14s} {total/ITERS*1e3:9.1f} ms/iter")
+print(json.dumps({
+    "profile_iter": {
+        "rows": N, "features": F, "iters": ITERS,
+        "backend": jax.default_backend(),
+        "learner": type(bst.learner).__name__,
+        "loop_wall_s": round(wall, 3),
+        "phase_breakdown": breakdown,
+    }}))
