@@ -207,7 +207,8 @@ def main():
               "LGBM_TPU_HIST_CHUNK", "LGBM_TPU_TELEMETRY",
               "BENCH_CAT_FEATURES", "BENCH_QUANTIZED",
               "BENCH_GRAD_BITS", "BENCH_STRATEGY",
-              "BENCH_TELEMETRY") if k in os.environ}
+              "BENCH_TELEMETRY", "BENCH_STREAM",
+              "BENCH_CHUNK_ROWS") if k in os.environ}
     sys.stderr.write(f"rows={N_ROWS} iters={N_ITERS} knobs={knobs}\n")
 
     # any capped run (explicit CPU or fallback) is not comparable to the
@@ -234,6 +235,16 @@ def main():
     if quantized:
         params.update(quantized_grad=True, grad_bits=grad_bits)
     hist_dtype = f"int{grad_bits}" if quantized else "bf16x2"
+    # out-of-core streaming A/B levers: BENCH_STREAM=chunked|goss turns
+    # on the host-wire H2D pipeline (io/stream.py); BENCH_CHUNK_ROWS
+    # sets stream_chunk_rows (0 derives from LGBM_TPU_CHUNK)
+    stream_mode = os.environ.get("BENCH_STREAM", "off")
+    stream_chunk_rows = int(os.environ.get("BENCH_CHUNK_ROWS", 0))
+    if stream_mode != "off":
+        params.update(stream_mode=stream_mode,
+                      stream_chunk_rows=stream_chunk_rows)
+        if stream_mode == "goss":
+            params.update(boosting="goss")
     # telemetry lever: BENCH_TELEMETRY=summary|trace (or the package-wide
     # LGBM_TPU_TELEMETRY env) turns on the per-iteration phase recorder;
     # the breakdown is emitted as the `phase_breakdown` JSON field
@@ -329,6 +340,10 @@ def main():
     # section + id, x4 bytes); masked has no reordered row buffer
     learner = booster._gbdt.learner
     strategy = getattr(learner, "strategy", type(learner).__name__)
+    # transfer-overlap fraction of the streaming pipeline (1.0 = every
+    # H2D byte hidden behind dispatch/compute; None when not streaming)
+    shard = getattr(learner, "_shard", None)
+    overlap = shard.overlap_fraction() if shard is not None else None
     bytes_per_row = None
     if getattr(learner, "codes_pack", None) is not None:
         gh_words = 3
@@ -369,6 +384,14 @@ def main():
         "hist_dtype": hist_dtype,
         "strategy": strategy,
         "bytes_per_row": bytes_per_row,
+        # out-of-core streaming diagnostics (stream_mode off => overlap
+        # null): transfer_overlap_fraction is 1 - stream_wait/stream
+        # wall from the shard's own counters
+        "stream_mode": stream_mode,
+        "chunk_rows": (int(shard.chunk_rows) if shard is not None
+                       else stream_chunk_rows),
+        "transfer_overlap_fraction": (round(overlap, 4)
+                                      if overlap is not None else None),
         # per-iteration phase accounting over the timed loop (telemetry
         # recorder; None with telemetry off). `coverage` is phase seconds
         # over iteration wall — the >=90% acceptance metric.

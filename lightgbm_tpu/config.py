@@ -231,6 +231,14 @@ class Config:
         if self.telemetry not in ("off", "summary", "trace"):
             log.fatal("telemetry must be one of off/summary/trace, got %s",
                       self.telemetry)
+        if self.stream_mode not in ("off", "chunked", "goss"):
+            log.fatal("stream_mode must be one of off/chunked/goss, got %s",
+                      self.stream_mode)
+        if self.stream_mode == "goss" and self.boosting != "goss":
+            log.fatal("stream_mode=goss reuses GOSS sampling as the "
+                      "working-set policy and needs boosting=goss "
+                      "(got boosting=%s); use stream_mode=chunked for "
+                      "plain streaming", self.boosting)
 
     # -- helpers used by the trainer -------------------------------------
     @property

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.binning import BIN_CATEGORICAL
 from ..io.dataset import Dataset
+from ..io.stream import DeviceDataShard
 from ..ops import bundle as bundle_ops
 from ..ops import quantize as quant_ops
 from ..ops import split as split_ops
@@ -57,6 +58,7 @@ from ..ops.partition import decide_left
 from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
 from ..telemetry import recorder as telem
 from ..utils import log
+from ..utils.log import LightGBMError
 from ..utils.envs import (flag, partition_mode_env, strategy_env,
                           use_pallas_env)
 from .tree import Tree
@@ -1368,7 +1370,7 @@ class _CarryK(NamedTuple):
                      "bynode_k", "use_pallas", "partition",
                      "chunk_rows", "fuse_hist", "feature_shards",
                      "cat_statics", "trivial_weights", "quant_bits",
-                     "quant_renew"))
+                     "quant_renew", "data_prebuilt"))
 def grow_tree_chunk(
         codes_pack: jax.Array, codes_row: jax.Array,
         grad: jax.Array, hess: jax.Array, w: jax.Array,
@@ -1383,7 +1385,8 @@ def grow_tree_chunk(
         partition: str = "sort", chunk_rows: int = 65536,
         fuse_hist: bool = True, feature_shards: int = 0,
         cat_statics=None, trivial_weights: bool = False,
-        quant_bits: int = 0, quant_renew: bool = True):
+        quant_bits: int = 0, quant_renew: bool = True,
+        data_prebuilt: bool = False):
     return grow_tree_chunk_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -1397,7 +1400,7 @@ def grow_tree_chunk(
         fuse_hist=fuse_hist, feature_shards=feature_shards,
         axis_name=None, cat_statics=cat_statics,
         trivial_weights=trivial_weights, quant_bits=quant_bits,
-        quant_renew=quant_renew)
+        quant_renew=quant_renew, data_prebuilt=data_prebuilt)
 
 
 def grow_tree_chunk_core(
@@ -1416,7 +1419,7 @@ def grow_tree_chunk_core(
         scatter_cols: int = 0, voting_k: int = 0,
         axis_name=None, cat_statics=None, trivial_weights: bool = False,
         quant_bits: int = 0, quant_renew: bool = True,
-        quant_total_rows: int = 0):
+        quant_total_rows: int = 0, data_prebuilt: bool = False):
     """Switch-free whole-tree growth over fixed-size chunks.
 
     The compact strategy resolves dynamic leaf sizes with a lax.switch
@@ -1466,17 +1469,38 @@ def grow_tree_chunk_core(
         histograms built and scanned per column slice, winners elected
         via make_sliced_search — feature_parallel_tree_learner.cpp:33-76).
     The LRU-capped histogram pool stays on the compact strategy.
+
+    data_prebuilt=True is the streaming entry (io/stream.py +
+    DeviceTreeLearner's stream assembly): `codes_pack` is then the
+    ALREADY-ASSEMBLED (n + CH, cw + gw + 1) working buffer data0
+    (`[packed codes | gh words | row id]`, CH zero-pad rows) and
+    `codes_row` a dummy — the core skips its in-program data0 build and
+    accumulates the root histogram chunk-wise over the buffer with the
+    same contraction the split loop uses, so no full-N `codes_pack` /
+    `codes_row` device copies ever exist. Everything downstream of the
+    root (carry, split loop, epilogue) is the identical program, which
+    is what makes streamed output bit-identical to resident growth
+    (serial only; the sharded modes keep their resident inputs).
     """
     from ..ops.histogram import build_histogram, build_histogram_quantized
     n = grad.shape[0]
-    cw = codes_pack.shape[1]
     L = num_leaves
     CH = int(chunk_rows)
     maxch = -(-n // CH)
     has_cat = cat_statics is not None
     cat_b = num_bins if has_cat else 1
     quant = quant_bits > 0
-    if not quant:
+    if data_prebuilt:
+        assert axis_name is None and feature_shards <= 1 \
+            and scatter_cols <= 1 and voting_k <= 0, \
+            "data_prebuilt streaming runs the serial chunk core only"
+        cw = codes_pack.shape[1] - ((1 if trivial_weights else 2)
+                                    if quant else 3) - 1
+        assert codes_pack.shape[0] == n + CH, \
+            "prebuilt data0 must carry CH zero-pad rows"
+    else:
+        cw = codes_pack.shape[1]
+    if not quant and not data_prebuilt:
         gh = jnp.stack([grad * w, hess * w, w], axis=1)
     helper_kwargs = dict(
         num_bins=num_bins, max_depth=max_depth, l1=l1, l2=l2,
@@ -1597,16 +1621,63 @@ def grow_tree_chunk_core(
             def reduce_hist(h):
                 return h
 
-    if quant:
-        gh_u = _quant_gh_words(gh_packed, w, gw)
+    if data_prebuilt:
+        # the streaming layer assembled data0 on device (gh words from
+        # the SAME _quant_prepare key in the quantized case, so the
+        # in-program scale/key derivation above stays the one source)
+        data0 = codes_pack
     else:
-        gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)
-    ids = jnp.arange(n, dtype=jnp.uint32)[:, None]
-    data0 = jnp.concatenate([codes_pack, gh_u, ids], axis=1)
-    data0 = jnp.concatenate(
-        [data0, jnp.zeros((CH, d_cols), jnp.uint32)], axis=0)
+        if quant:
+            gh_u = _quant_gh_words(gh_packed, w, gw)
+        else:
+            gh_u = jax.lax.bitcast_convert_type(gh, jnp.uint32)
+        ids = jnp.arange(n, dtype=jnp.uint32)[:, None]
+        data0 = jnp.concatenate([codes_pack, gh_u, ids], axis=1)
+        data0 = jnp.concatenate(
+            [data0, jnp.zeros((CH, d_cols), jnp.uint32)], axis=0)
 
-    if quant:
+    if data_prebuilt and quant:
+        # chunk-wise root accumulation over the prebuilt buffer: same
+        # per-chunk contraction as the split loop's chunk_hist. The
+        # int32 partial sums make the grouping change exactly
+        # associative, so this equals the resident full-N build
+        # bit-for-bit.
+        r0_g, r0_h = q_ratios(root_max)
+        iota_root = jnp.arange(CH, dtype=jnp.int32)
+
+        from ..ops.histogram import accumulate_histogram
+
+        def root_chunk(i, acc):
+            win = jax.lax.dynamic_slice(
+                data0, (i * CH, jnp.int32(0)), (CH, data0.shape[1]))
+            count = jnp.clip(n - i * CH, 0, CH)
+            codes = decode_hist_cols(win[:, :cw])
+            operand = _quant_win_operand(
+                win, iota_root < count, cw=cw, gw=gw,
+                quant_bits=quant_bits, qcap_op=qcap_op,
+                r_g=r0_g, r_h=r0_h)
+            return accumulate_histogram(acc, codes, operand, col_bins,
+                                        use_pallas=use_pallas)
+
+        hist0 = jax.lax.fori_loop(
+            0, maxch, root_chunk,
+            jnp.zeros((hist_w, col_bins, 3), jnp.int32))
+        totals = q_dequant(hist0[0].sum(axis=0), r0_g, r0_h)
+        hist0_scan = q_dequant(hist0, r0_g, r0_h)
+    elif data_prebuilt:
+        # float path: f32 adds are NOT associative, so chunk-wise
+        # accumulation would regroup the resident full-N contraction and
+        # break bit-identity for arbitrary gradients. data0 already
+        # holds every row, so run the identical full-N build on a
+        # transient decode (same shapes/values as the resident
+        # codes_row + gh operands; freed after the root build).
+        hist0 = build_histogram(
+            decode_hist_cols(data0[:n]),
+            jax.lax.bitcast_convert_type(data0[:n, cw:cw + 3],
+                                         jnp.float32),
+            col_bins, use_pallas=use_pallas)
+        totals = hist0[0].sum(axis=0)
+    elif quant:
         r0_g, r0_h = q_ratios(root_max)
         ghq0 = quant_ops.gh_operand_scaled(
             gh_packed, w > 0, quant_bits, qcap_op, r0_g, r0_h)
@@ -2153,6 +2224,27 @@ def resolve_strategy(config: Config, dataset: Dataset,
     it requires the dense histogram pool, so LRU-capped configs fall
     back to compact."""
     strat = forced or strategy_env()
+    stream = str(getattr(config, "stream_mode", "off") or "off")
+    if stream in ("chunked", "goss"):
+        # streaming assembles the chunk core's working buffer from host
+        # chunks; masked has no chunk seam to hook, and an LRU-capped
+        # pool cannot take the per-chunk accumulation. Loud errors
+        # beat a silent fallback to a non-streaming core.
+        if strat == "masked":
+            raise LightGBMError(
+                "stream_mode=%s requires the chunk growth core; the "
+                "masked strategy has no chunk seam (unset "
+                "LGBM_TPU_STRATEGY=masked or turn streaming off)"
+                % stream)
+        _, pool_slots = plan_histogram_pool(config, dataset)
+        if pool_slots > 0:
+            raise LightGBMError(
+                "stream_mode=%s needs the dense histogram pool but "
+                "num_leaves=%d exceeds the histogram_pool_size budget "
+                "(LRU pool has no chunk seam); raise "
+                "histogram_pool_size or reduce num_leaves"
+                % (stream, int(config.num_leaves)))
+        return "chunk"
     if strat == "auto":
         # the quantized pipeline rides every strategy: masked (int pool
         # + dequant-hook scans) below the compaction threshold, packed
@@ -2214,10 +2306,18 @@ class DeviceTreeLearner:
         self.num_features = dataset.num_features
         self.num_bins = int(dataset.max_num_bins)
         self.device_bins = padded_device_bins(self.num_bins)
+        # out-of-core streaming: the binned matrix stays host-side in
+        # the packed wire format and chunks onto the device per
+        # iteration (io/stream.py); no device-resident codes_t /
+        # codes_pack / codes_row copies exist in this mode
+        self.stream_mode = str(getattr(config, "stream_mode", "off")
+                               or "off")
+        stream_on = self.stream_mode != "off"
         bundle = dataset.bundle_arrays()
         if bundle is not None:
             codes, f_col, f_base, f_elide, hist_idx, col_bins = bundle
-            self.codes_t = jnp.asarray(jnp.swapaxes(codes, 0, 1))  # (C, N)
+            self.codes_t = (None if stream_on else
+                            jnp.asarray(jnp.swapaxes(codes, 0, 1)))  # (C, N)
             self.f_col, self.f_base, self.f_elide = f_col, f_base, f_elide
             self.col_device_bins = padded_device_bins(int(col_bins))
             # pad hist_idx bin axis to device_bins; pad slots hit the
@@ -2238,8 +2338,12 @@ class DeviceTreeLearner:
                     axis=1)
             self.hist_idx = jnp.asarray(hi2.astype(np.int32))
         else:
-            binned = dataset.device_binned()
-            self.codes_t = jnp.asarray(jnp.swapaxes(binned, 0, 1))  # (F, N)
+            if stream_on:
+                self.codes_t = None
+            else:
+                binned = dataset.device_binned()
+                self.codes_t = jnp.asarray(
+                    jnp.swapaxes(binned, 0, 1))  # (F, N)
             nf = self.num_features
             self.f_col = jnp.arange(nf, dtype=jnp.int32)
             self.f_base = jnp.zeros(nf, jnp.int32)
@@ -2302,6 +2406,7 @@ class DeviceTreeLearner:
         # exceed the budget, the compact strategy runs with K LRU slots
         # and rebuilds sibling histograms on miss
         _, self.pool_slots = plan_histogram_pool(config, dataset)
+        self._shard: Optional[DeviceDataShard] = None
         if self.strategy in ("compact", "chunk"):
             host_codes = (dataset.bundled if dataset.bundled is not None
                           else dataset.binned)
@@ -2339,7 +2444,18 @@ class DeviceTreeLearner:
                     "width (%d cols); padding lever inactive",
                     pack_words, host_codes.shape[1])
             packed = self.pack_codes(host_codes, col_target=col_target)
-            if device_place:
+            if stream_on:
+                # host wire store + double-buffered H2D chunk pipeline;
+                # the device never holds a full copy of the binned rows
+                self.codes_row = None
+                self.codes_pack = None
+                self._shard = DeviceDataShard(
+                    packed, item_bits=self.item_bits,
+                    c_cols=self.c_cols,
+                    chunk_rows=int(getattr(
+                        config, "stream_chunk_rows", 0) or 0),
+                    core_chunk_rows=self.chunk_rows)
+            elif device_place:
                 self.codes_row = jnp.asarray(host_codes)      # (N, C)
                 self.codes_pack = jnp.asarray(packed)
             else:
@@ -2354,6 +2470,11 @@ class DeviceTreeLearner:
         self.last_leaf_id: Optional[jax.Array] = None
         self._leaf_id_host: Optional[np.ndarray] = None
         self._bag_mask_host: Optional[np.ndarray] = None
+        # streaming per-iteration context (assembled data0 + subset ids)
+        # and the GOSS working-set hint handed down by the booster
+        self._stream_ctx: Optional[dict] = None
+        self._stream_top_hint: Optional[np.ndarray] = None
+        self._stream_jits: dict = {}
 
     def pack_codes(self, host_codes: np.ndarray,
                    col_target: Optional[int] = None) -> np.ndarray:
@@ -2469,6 +2590,13 @@ class DeviceTreeLearner:
         base_mask = jnp.asarray(self._feature_mask(rng))
         key = jax.random.PRNGKey(iter_seed)
 
+        if self._shard is not None:
+            # assemble the streamed working buffer BEFORE grow_dispatch:
+            # the shard attributes its blocking residue to the
+            # stream_wait recorder phase, and phases must not nest
+            self._stream_ctx = self._stream_assemble(
+                grad, hess, w, key, bag_indices)
+
         with telem.phase("grow_dispatch"):
             rec, rec_cat, leaf_id, n_splits, _ = self._run_grow(
                 grad, hess, w, base_mask, key)
@@ -2514,6 +2642,8 @@ class DeviceTreeLearner:
     def _run_grow(self, grad, hess, w, base_mask, key):
         """The grow-program invocation; sharded subclasses override this
         single hook and inherit the rest of train()."""
+        if self._stream_ctx is not None:
+            return self._run_grow_streamed(base_mask, key)
         if self.strategy in ("compact", "chunk"):
             grow, kw = self._grow_fn_kwargs(
                 trivial_weights=w is self._ones_w)
@@ -2531,6 +2661,265 @@ class DeviceTreeLearner:
             self.f_elide, self.hist_idx, key,
             quant_bits=self.quant_bits, hist_chunk=self.hist_chunk,
             **self._statics())
+
+    # -- out-of-core streaming (io/stream.py) --------------------------
+    def _stream_init_fn(self, rows_n: int, trivial: bool):
+        """jit that builds the (rows_n + CH, d_cols) u32 working buffer
+        with the gh words + row-id columns filled and the code section
+        zeroed (chunk writes fill it). The quantized path runs
+        _quant_prepare with the SAME rng_key the growth core re-derives
+        its scales from, so the core stays the one source of key/scale
+        derivation and the assembled gh words match it bit-for-bit."""
+        jkey = ("init", rows_n, trivial)
+        fn = self._stream_jits.get(jkey)
+        if fn is None:
+            quant = self.quant_bits > 0
+            gw = (1 if trivial else 2) if quant else 3
+            cw = int(self._shard.code_words)
+            CH = int(self.chunk_rows)
+            d_cols = cw + gw + 1
+            qb, qr = self.quant_bits, self.quant_renew
+
+            def init(grad, hess, w, rng_key):
+                if quant:
+                    _, gh_packed, _, _, _ = _quant_prepare(
+                        grad, hess, w, rng_key, quant_bits=qb,
+                        quant_renew=qr, n_total=rows_n, axis_name=None)
+                    gh_u = _quant_gh_words(gh_packed, w, gw)
+                else:
+                    gh_u = jax.lax.bitcast_convert_type(
+                        jnp.stack([grad * w, hess * w, w], axis=1),
+                        jnp.uint32)
+                ids = jnp.arange(rows_n, dtype=jnp.uint32)[:, None]
+                tail = jnp.concatenate([gh_u, ids], axis=1)
+                buf = jnp.zeros((rows_n + CH, d_cols), jnp.uint32)
+                return jax.lax.dynamic_update_slice(
+                    buf, tail, (jnp.int32(0), jnp.int32(cw)))
+
+            fn = jax.jit(init)
+            self._stream_jits[jkey] = fn
+        return fn
+
+    def _stream_write(self, data0, chunk, start: int):
+        """Donated contiguous chunk write: data0[start:start+rows, :CW]
+        = chunk. Chunks are exact-sized (the tail chunk keeps its
+        natural shape), so the write never clamps."""
+        jkey = ("write", int(chunk.shape[0]),
+                tuple(int(d) for d in data0.shape))
+        fn = self._stream_jits.get(jkey)
+        if fn is None:
+            fn = jax.jit(
+                lambda buf, ck, s: jax.lax.dynamic_update_slice(
+                    buf, ck, (s, jnp.int32(0))),
+                donate_argnums=(0,))
+            self._stream_jits[jkey] = fn
+        return fn(data0, chunk, jnp.int32(start))
+
+    def _stream_scatter(self, data0, rows, pos):
+        """Donated scatter write of packed code rows into subset-local
+        positions (GOSS working-set hits and streamed misses)."""
+        jkey = ("scatter", int(rows.shape[0]),
+                tuple(int(d) for d in data0.shape))
+        fn = self._stream_jits.get(jkey)
+        if fn is None:
+            fn = jax.jit(
+                lambda buf, r, p: buf.at[p, :r.shape[1]].set(
+                    r, unique_indices=True),
+                donate_argnums=(0,))
+            self._stream_jits[jkey] = fn
+        return fn(data0, rows, pos)
+
+    def _stream_assemble(self, grad, hess, w, key, bag_indices):
+        """Build the chunk core's pre-assembled data0 on device.
+
+        stream_mode=chunked (or a GOSS warmup iteration): every wire row
+        streams through the double buffer into its own slot — pure data
+        movement, so the grown tree is bit-identical to resident
+        training for any stream_chunk_rows. stream_mode=goss with a
+        sampled bag: the bag compacts to a subset buffer; pinned
+        working-set rows are gathered on device (no H2D), the rest
+        stream, and the next iteration's top-gradient rows are re-pinned
+        from the assembled buffer before it is consumed."""
+        shard = self._shard
+        n = self.dataset.num_data
+        if self.stream_mode == "goss" and bag_indices is not None:
+            idx = np.sort(np.asarray(
+                jax.device_get(bag_indices)).astype(np.int64))
+            jidx = jnp.asarray(idx)
+            g = jnp.take(grad, jidx)
+            h = jnp.take(hess, jidx)
+            wv = jnp.ones(idx.size, jnp.float32)
+            # the compacted bag is all-ones by construction; mirror the
+            # _grow_fn_kwargs exactness bound so assembly and core agree
+            # on the static gh-word layout
+            trivial = n < (1 << 24)
+        else:
+            idx = None
+            g, h, wv = grad, hess, w
+            trivial = (w is self._ones_w) and n < (1 << 24)
+        rows_n = n if idx is None else int(idx.size)
+        data0 = self._stream_init_fn(rows_n, trivial)(g, h, wv, key)
+        shard.track_buffer("data0", int(data0.nbytes))
+        if idx is None:
+            for s, cnt, dev in shard.iter_chunks():
+                data0 = self._stream_write(data0, dev, s)
+        else:
+            ws_ids, ws_rows = shard.working_set()
+            if ws_ids.size:
+                hit = np.isin(idx, ws_ids.astype(np.int64),
+                              assume_unique=True)
+                hit_pos = np.nonzero(hit)[0].astype(np.int32)
+                miss_pos = np.nonzero(~hit)[0].astype(np.int32)
+                if hit_pos.size:
+                    cache_pos = np.searchsorted(
+                        ws_ids, idx[hit_pos]).astype(np.int32)
+                    rows = jnp.take(ws_rows, jnp.asarray(cache_pos),
+                                    axis=0)
+                    data0 = self._stream_scatter(
+                        data0, rows, jnp.asarray(hit_pos))
+            else:
+                miss_pos = np.arange(idx.size, dtype=np.int32)
+            if miss_pos.size:
+                for s, cnt, dev in shard.iter_chunks(
+                        row_ids=idx[miss_pos]):
+                    data0 = self._stream_scatter(
+                        data0, dev, jnp.asarray(miss_pos[s:s + cnt]))
+            self._stream_refresh_ws(data0, idx)
+        return {"data0": data0, "idx": idx, "g": g, "h": h, "w": wv,
+                "trivial": trivial}
+
+    def _stream_refresh_ws(self, data0, idx) -> None:
+        """Re-pin the booster's top-gradient hint as the next working
+        set, gathering packed code rows straight out of the assembled
+        buffer (zero extra H2D — the rows are already on device)."""
+        top = self._stream_top_hint
+        self._stream_top_hint = None
+        if top is None or not top.size:
+            return
+        top = np.sort(np.asarray(top).astype(np.int64))
+        top = top[np.isin(top, idx, assume_unique=True)]
+        if not top.size:
+            return
+        pos = np.searchsorted(idx, top).astype(np.int32)
+        cw = int(self._shard.code_words)
+        jkey = ("wsgather", int(pos.size),
+                tuple(int(d) for d in data0.shape))
+        fn = self._stream_jits.get(jkey)
+        if fn is None:
+            fn = jax.jit(lambda buf, p: buf[p, :cw])
+            self._stream_jits[jkey] = fn
+        self._shard.pin_working_set(top.astype(np.int32),
+                                    fn(data0, jnp.asarray(pos)))
+
+    def _run_grow_streamed(self, base_mask, key):
+        """Grow from the pre-assembled streamed buffer: the chunk core
+        runs with data_prebuilt=True (codes_pack arg IS data0, codes_row
+        a dummy) and is otherwise the identical program — root histogram
+        grouping aside, which the chunk-wise accumulation keeps exact
+        for both the int32 and the exact-arithmetic float cases."""
+        ctx = self._stream_ctx
+        self._stream_ctx = None
+        grow, kw = self._grow_fn_kwargs(trivial_weights=ctx["trivial"])
+        kw["data_prebuilt"] = True
+        dummy_row = jnp.zeros((1, 1), jnp.uint8)
+        rec, rec_cat, leaf_id, n_splits, totals = grow(
+            ctx["data0"], dummy_row, ctx["g"], ctx["h"], ctx["w"],
+            base_mask, self.f_numbins, self.f_missing, self.f_default,
+            self.f_monotone, self.f_penalty, self.f_categorical,
+            self.f_col, self.f_base, self.f_elide, self.hist_idx, key,
+            **kw, **self._statics())
+        if ctx["idx"] is not None:
+            leaf_id = self._stream_full_leaf_id(
+                ctx["idx"], leaf_id, rec, rec_cat, n_splits)
+        self._shard.release_buffer("data0")
+        return rec, rec_cat, leaf_id, n_splits, totals
+
+    def _stream_full_leaf_id(self, idx, leaf_sub, rec, rec_cat, k):
+        """Full-row leaf assignment for the compacted GOSS bag: in-bag
+        rows take the core's ids; out-of-bag rows replay the split
+        records chunk-by-chunk from the wire store (the streamed
+        counterpart of the reference's out-of-bag
+        AddPredictionToScore)."""
+        n = self.dataset.num_data
+        full = jnp.zeros(n, jnp.int32).at[jnp.asarray(idx)].set(
+            leaf_sub, unique_indices=True)
+        mask = np.ones(n, dtype=bool)
+        mask[idx] = False
+        oob = np.nonzero(mask)[0]
+        if not oob.size:
+            return full
+        # emit_phase=False: this routing runs inside grow_dispatch and
+        # recorder phases must not nest (bytes are still counted)
+        for s, cnt, dev in self._shard.iter_chunks(
+                row_ids=oob, emit_phase=False):
+            lc = self._stream_route(dev, rec, rec_cat, k)
+            full = full.at[jnp.asarray(
+                oob[s:s + cnt].astype(np.int64))].set(
+                    lc, unique_indices=True)
+        return full
+
+    def _stream_route(self, rows, rec, rec_cat, k):
+        jkey = ("route", int(rows.shape[0]))
+        fn = self._stream_jits.get(jkey)
+        if fn is None:
+            item_bits = self.item_bits
+            L = int(self.config.num_leaves)
+            f_meta = (self.f_numbins, self.f_missing, self.f_default,
+                      self.f_col, self.f_base, self.f_elide)
+            f_cat = self.f_categorical if self._has_cat else None
+
+            def route(rows, rec, rec_cat, kk):
+                return route_rows_by_rec(
+                    rows, rec, kk, *f_meta, item_bits=item_bits,
+                    num_leaves=L, rec_cat=rec_cat,
+                    f_categorical=f_cat)
+
+            fn = jax.jit(route)
+            self._stream_jits[jkey] = fn
+        return fn(rows, rec, rec_cat, k)
+
+    def stream_note_top(self, top_ids) -> None:
+        """Booster hook (GOSS sampling): the row ids whose |g*h| ranks
+        highest this iteration — the working set to pin for the next.
+        No-op unless this learner streams."""
+        if self._shard is None:
+            return
+        self._stream_top_hint = np.asarray(
+            jax.device_get(top_ids)).astype(np.int64)
+
+    def stream_state(self):
+        """Checkpointable streaming state (None when not streaming)."""
+        if self._shard is None:
+            return None
+        return self._shard.stream_state()
+
+    def load_stream_state(self, st) -> None:
+        if self._shard is not None and st:
+            self._shard.load_stream_state(st)
+
+    def device_data_bytes(self) -> dict:
+        """Model-tracked device bytes of the row data this learner holds
+        — the streamed-vs-resident A/B quantity. In-program temporaries
+        common to both modes (scratch, position arrays, the histogram
+        pool) are excluded. Resident counts the live input buffers plus
+        the in-program data0 copy that coexists with them during
+        growth; streamed reports the shard high-water mark (data0 +
+        in-flight chunks + pinned working set)."""
+        if self._shard is not None:
+            return {"mode": "streamed",
+                    "bytes": int(max(self._shard.peak_bytes,
+                                     self._shard.live_bytes()))}
+        total = 0
+        for a in (self.codes_t, self.codes_pack, self.codes_row):
+            if a is not None and hasattr(a, "nbytes"):
+                total += int(a.nbytes)
+        if self.strategy == "chunk" and self.codes_pack is not None:
+            quant = self.quant_bits > 0
+            gw = 1 if quant else 3  # trivial-weight (unbagged) layout
+            cw = int(self.codes_pack.shape[1])
+            total += ((self.dataset.num_data + self.chunk_rows)
+                      * (cw + gw + 1) * 4)
+        return {"mode": "resident", "bytes": int(total)}
 
     def replay_tree(self, rec_h, k: int, rec_cat_h=None) -> Tree:
         """Materialize a host Tree from the fetched (L-1, 13) split-record

@@ -424,6 +424,10 @@ class GBDT:
             # (_compute_gradients); the fused step computes gradients
             # in-program, so route through the generic path
             return False
+        if getattr(self.config, "stream_mode", "off") != "off":
+            # streamed assembly is a host-driven H2D loop per iteration;
+            # the fused whole-iteration program has no seam for it
+            return False
         return (self.__class__ in (GBDT, GOSS)
                 and isinstance(self.learner, DeviceTreeLearner)
                 and self.objective is not None
@@ -927,6 +931,9 @@ class GBDT:
             st["dart"] = {"tree_weights": list(self._tree_weights),
                           "sum_weight": float(self._sum_weight),
                           "drop_rng": self._drop_rng.get_state()}
+        stream = getattr(self.learner, "stream_state", lambda: None)()
+        if stream is not None:
+            st["stream"] = stream
         return st
 
     def restore_state(self, st: Dict[str, Any]) -> None:
@@ -971,6 +978,9 @@ class GBDT:
             self._tree_weights = list(d["tree_weights"])
             self._sum_weight = float(d["sum_weight"])
             self._drop_rng.set_state(d["drop_rng"])
+        if st.get("stream") is not None and hasattr(
+                self.learner, "load_stream_state"):
+            self.learner.load_stream_state(st["stream"])
         self._last_leaf_ids.clear()
         self._last_leaf_ids_iter = -1
         self.invalidate_ensemble_cache()
@@ -1196,6 +1206,14 @@ class GOSS(GBDT):
             len(rest), min(other_k, len(rest)), replace=False)
         other_idx = rest[sampled]
         self._goss_amplify = (other_idx, multiply)
+        if hasattr(self.learner, "stream_note_top"):
+            # streamed working-set policy: the top-|g*h| rows are the
+            # ones worth keeping device-resident for the next iteration
+            # (goss_working_set caps how many; 0 = the full top set)
+            ws_k = int(getattr(self.config, "goss_working_set", 0) or 0)
+            ws_k = top_k if ws_k <= 0 else min(ws_k, top_k)
+            self.learner.stream_note_top(
+                np.sort(top_idx[:ws_k]).astype(np.int32))
         idx = np.sort(np.concatenate([top_idx, other_idx])).astype(np.int32)
         return idx
 

@@ -119,6 +119,25 @@ def build_histogram(binned_rows: jax.Array, gh: jax.Array, num_bins: int,
     return hist
 
 
+def accumulate_histogram(acc: jax.Array, binned_rows: jax.Array,
+                         gh: jax.Array, num_bins: int,
+                         use_pallas: bool = False) -> jax.Array:
+    """Streamed-accumulation hook: fold one row chunk's histogram into a
+    running (F, B, 3) total — the seam the out-of-core pipeline
+    (io/stream.py feeding the chunk core's prebuilt-data path) uses to
+    build the root histogram chunk-wise. Integer (quantized) totals are
+    chunk-grouping-independent (int32 addition is associative); float
+    totals depend on grouping only through f32 addition order, which is
+    exact whenever the per-chunk sums are exactly representable. The
+    accumulator dtype picks the pipeline: int32 routes to the exact
+    quantized contraction."""
+    if acc.dtype == jnp.int32:
+        return acc + build_histogram_quantized(
+            binned_rows, gh, num_bins, use_pallas=use_pallas)
+    return acc + build_histogram(binned_rows, gh, num_bins,
+                                 use_pallas=use_pallas)
+
+
 @jax.jit
 def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     """Sibling histogram by subtraction (reference:

@@ -1127,8 +1127,31 @@ def create_tree_learner(config: Config, dataset: Dataset,
     falls back to the host-loop learner for unsupported configs."""
     import os
     from ..models.device_learner import DeviceTreeLearner
+    from ..utils.log import LightGBMError
     host_only = os.environ.get("LGBM_TPU_HOST_LEARNER", "0") == "1"
     name = config.tree_learner
+    stream = str(getattr(config, "stream_mode", "off") or "off")
+    if stream != "off":
+        # streaming exists only in the serial device chunk learner; a
+        # silent fallback to a resident learner would defeat the whole
+        # point of the mode, so misconfigurations fail loudly
+        if name not in ("serial",):
+            raise LightGBMError(
+                f"stream_mode={stream} runs on the serial device "
+                f"learner only; tree_learner={name} has no streaming "
+                "path (drop stream_mode or use tree_learner=serial)")
+        if host_only:
+            raise LightGBMError(
+                f"stream_mode={stream} is incompatible with "
+                "LGBM_TPU_HOST_LEARNER=1 (the host-loop learner has no "
+                "streaming path)")
+        if not DeviceTreeLearner.supports(config, dataset):
+            raise LightGBMError(
+                f"stream_mode={stream} needs the device chunk learner "
+                "but this config is unsupported by it (forced splits / "
+                "CEGB / pool budget); fix the config or set "
+                "stream_mode=off")
+        return DeviceTreeLearner(config, dataset)
     if name in ("serial",):
         if not host_only and DeviceTreeLearner.supports(config, dataset):
             return DeviceTreeLearner(config, dataset)

@@ -43,6 +43,11 @@ __all__ = ["CheckpointError", "CheckpointData", "CheckpointManager",
 
 MAGIC = b"LGBMTPUCKPT1\n"
 FORMAT = "lgbm-tpu-checkpoint"
+# Version 2 adds out-of-core streaming state (stream cursor +
+# GOSS working-set membership, io/stream.py). Writers only stamp 2 —
+# with a matching min_reader_version — when stream state is present, so
+# non-streamed checkpoints stay readable by version-1 readers.
+VERSION = 2
 _CKPT_RE = re.compile(r"_iter_(\d+)\.ckpt$")
 
 
@@ -191,11 +196,22 @@ def capture(booster, history: Optional[list] = None
                                                in d["tree_weights"]],
                               "sum_weight": float(d["sum_weight"]),
                               "drop_rng": drop_meta}
+    version = 1
+    if st.get("stream") is not None:
+        # streaming state only exists when stream_mode is active; old
+        # readers cannot resume it bit-identically, so the manifest
+        # demands a version-2 reader in exactly that case
+        stream = st["stream"]
+        arrays["stream_ws_ids"] = np.asarray(
+            stream.get("ws_ids", np.zeros(0, np.int32)), dtype=np.int32)
+        state_json["stream"] = {"cursor": int(stream.get("cursor", 0))}
+        version = VERSION
     arrays["state_json"] = np.array(json.dumps(state_json))
     arrays["history_json"] = np.array(json.dumps(history or []))
     meta = {
         "format": FORMAT,
-        "version": 1,
+        "version": version,
+        "min_reader_version": version,
         "iteration": int(st["iter"]),
         "num_class": int(gbdt.num_class),
         "num_trees": len(gbdt.models),
@@ -216,6 +232,12 @@ def load_checkpoint(path: str) -> CheckpointData:
     if manifest.get("format") != FORMAT:
         raise CheckpointError(f"{path}: unknown format "
                               f"{manifest.get('format')!r}")
+    need = int(manifest.get("min_reader_version", 1))
+    if need > VERSION:
+        raise CheckpointError(
+            f"{path}: checkpoint requires reader version {need} "
+            f"(this build reads up to {VERSION}); it was written by a "
+            "newer build — resume with that build or retrain")
     state_json = json.loads(str(npz["state_json"].item()))
     st: Dict[str, Any] = {
         "iter": int(state_json["iter"]),
@@ -237,6 +259,13 @@ def load_checkpoint(path: str) -> CheckpointData:
             "sum_weight": float(d["sum_weight"]),
             "drop_rng": _unpack_rng(d["drop_rng"],
                                     npz["dart_drop_rng_keys"]),
+        }
+    if "stream" in state_json:
+        st["stream"] = {
+            "cursor": int(state_json["stream"].get("cursor", 0)),
+            "ws_ids": (np.asarray(npz["stream_ws_ids"], dtype=np.int32)
+                       if "stream_ws_ids" in npz
+                       else np.zeros(0, np.int32)),
         }
     history = json.loads(str(npz["history_json"].item()))
     return CheckpointData(manifest, str(npz["model_text"].item()), st,

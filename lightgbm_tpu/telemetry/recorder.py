@@ -12,7 +12,10 @@ Canonical phase names, so breakdowns from different paths diff cleanly:
 
     boost_avg   gradient   quantize   bagging    hist      split
     partition   grow_dispatch         host_sync  tree_replay
-    score_update            sentry    collective eval
+    score_update            sentry    collective eval      stream_wait
+
+`stream_wait` is the out-of-core pipeline's blocking H2D residue
+(io/stream.py): near-zero means the double buffer hid the transfers.
 
 One program can fuse several (the device learners grow the whole tree in
 one dispatch — that is `grow_dispatch`, and the blocking record fetch is

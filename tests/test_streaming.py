@@ -1,0 +1,405 @@
+"""Out-of-core streaming (io/stream.py + the chunk core's prebuilt-data
+path): streamed-vs-resident bit-identity, chunk-size independence, GOSS
+working sets, strategy/learner gating, and checkpoint round-trip.
+
+Parity tests follow tests/test_chunk_strategy.py's exact-arithmetic
+convention (gradients that are multiples of 0.25 with unit hessians
+keep every partial sum exactly representable in f32), BUT streaming
+does not need it for most assertions: assembly is pure data movement,
+so a streamed run is bit-identical to the resident chunk strategy for
+real float gradients too — the root histogram is accumulated chunk-wise
+in BOTH cases (same CH), and everything after the root is the identical
+program. The resident reference is the chunk strategy (shapes shared
+with test_chunk_strategy keep the jit cache warm); chunk == compact is
+that file's job.
+"""
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine
+from lightgbm_tpu.callback import checkpoint
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Dataset
+from lightgbm_tpu.io.stream import DeviceDataShard, derive_stream_chunk_rows
+from lightgbm_tpu.models.device_learner import (DeviceTreeLearner,
+                                                resolve_strategy)
+from lightgbm_tpu.parallel.learners import create_tree_learner
+from lightgbm_tpu.resilience.checkpoint import (
+    FORMAT, CheckpointError, CheckpointManager, load_checkpoint,
+    write_checkpoint_file)
+from lightgbm_tpu.utils.log import LightGBMError
+
+BASE = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+        "min_data_in_leaf": 20, "verbosity": -1}
+
+
+def exact_grads(r, n):
+    g = jnp.asarray((r.randint(-8, 9, n) * 0.25).astype(np.float32))
+    h = jnp.asarray(np.ones(n, np.float32))
+    return g, h
+
+
+def make_learner(monkeypatch, x, y, params=None, strategy=None,
+                 chunk=8192):
+    monkeypatch.setenv("LGBM_TPU_CHUNK", str(chunk))
+    cfg = Config(dict(BASE, **(params or {})))
+    ds = Dataset(x, config=cfg, label=y)
+    return DeviceTreeLearner(cfg, ds, strategy=strategy)
+
+
+def grow_text(monkeypatch, x, y, g, h, params=None, strategy=None,
+              chunk=8192):
+    return make_learner(monkeypatch, x, y, params, strategy,
+                        chunk).train(g, h).to_string()
+
+
+def trees_text(booster):
+    """Model text minus the embedded parameters block (stream params
+    legitimately differ between a streamed and a resident run)."""
+    s = booster._gbdt.save_model_to_string(0, -1)
+    head, _, rest = s.partition("\nparameters:")
+    _, _, tail = rest.partition("end of parameters")
+    return head + tail
+
+
+# ---------------------------------------------------------------------------
+# shard unit behavior
+
+def test_derive_stream_chunk_rows():
+    assert derive_stream_chunk_rows(0, 65536) == 65536   # derive
+    assert derive_stream_chunk_rows(30000, 65536) == 30000  # explicit wins
+    assert derive_stream_chunk_rows(7, 65536) == 1024    # latency floor
+
+
+def test_shard_validates_wire():
+    with pytest.raises(ValueError):
+        DeviceDataShard(np.zeros((4, 2), np.uint8), item_bits=8, c_cols=5)
+
+
+def test_shard_chunk_iteration_exact():
+    wire = np.arange(100 * 3, dtype=np.uint32).reshape(100, 3)
+    sh = DeviceDataShard(wire, item_bits=8, c_cols=12, chunk_rows=1024)
+    assert sh.overlap_fraction() is None     # no pass yet
+    got = list(sh.iter_chunks())
+    # floor clamps tiny requests to 1024 -> one exact-sized chunk here
+    assert [(s, c) for s, c, _ in got] == [(0, 100)]
+    np.testing.assert_array_equal(np.asarray(got[0][2]), wire)
+    assert sh.cursor == 1 and sh.h2d_bytes == wire.nbytes
+    assert sh.overlap_fraction() is not None
+
+
+def test_shard_row_subset_and_working_set():
+    wire = np.arange(50 * 2, dtype=np.uint32).reshape(50, 2)
+    sh = DeviceDataShard(wire, item_bits=8, c_cols=8, chunk_rows=1024)
+    ids = np.array([3, 7, 20, 49], np.int64)
+    (s, c, dev), = list(sh.iter_chunks(row_ids=ids))
+    np.testing.assert_array_equal(np.asarray(dev), wire[ids])
+    sh.pin_working_set(np.array([5, 9], np.int32))       # H2D from wire
+    ws_ids, ws_rows = sh.working_set()
+    np.testing.assert_array_equal(np.asarray(ws_rows), wire[[5, 9]])
+    st = sh.stream_state()
+    sh2 = DeviceDataShard(wire, item_bits=8, c_cols=8, chunk_rows=1024)
+    sh2.load_stream_state(st)
+    assert sh2.cursor == sh.cursor
+    np.testing.assert_array_equal(sh2.ws_ids, ws_ids)
+    np.testing.assert_array_equal(np.asarray(sh2.working_set()[1]),
+                                  wire[[5, 9]])
+
+
+# ---------------------------------------------------------------------------
+# streamed-vs-resident bit-identity (the tentpole acceptance)
+
+def test_streamed_matches_resident_three_chunk_sizes(monkeypatch):
+    """Float chunk core, n=70000 (shared shape with test_chunk_strategy
+    so the resident program comes from the jit cache): streamed training
+    is bit-identical to resident for a dividing chunk size, the derived
+    default, and a non-dividing size with a tail chunk — all three reuse
+    ONE streamed core program (only the tiny assembly jits differ), so
+    the sweep costs one compile."""
+    r = np.random.RandomState(3)
+    n, f = 70000, 7
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] - 0.5 * x[:, 1] + 0.3 * r.randn(n)) > 0) \
+        .astype(np.float64)
+    g, h = exact_grads(r, n)
+    resident = grow_text(monkeypatch, x, y, g, h, strategy="chunk")
+    for rows in (0, 35000, 30000):   # derived(8192, tail) | exact | tail
+        lrn = make_learner(monkeypatch, x, y,
+                           {"stream_mode": "chunked",
+                            "stream_chunk_rows": rows})
+        assert lrn.strategy == "chunk" and lrn._shard is not None
+        assert lrn.codes_t is None and lrn.codes_pack is None
+        streamed = lrn.train(g, h).to_string()
+        assert streamed == resident, f"stream_chunk_rows={rows}"
+        assert lrn._shard.h2d_bytes > 0
+        assert lrn.device_data_bytes()["mode"] == "streamed"
+
+
+def test_streamed_matches_resident_real_gradients(monkeypatch):
+    # no exact-arithmetic crutch: assembly is pure data movement and the
+    # root accumulates chunk-wise with the same CH either way
+    r = np.random.RandomState(5)
+    n, f = 20000, 5
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g = jnp.asarray(r.randn(n).astype(np.float32))
+    h = jnp.asarray((0.1 + r.rand(n)).astype(np.float32))
+    a = grow_text(monkeypatch, x, y, g, h, strategy="chunk")
+    b = grow_text(monkeypatch, x, y, g, h, {"stream_mode": "chunked"})
+    assert a == b
+
+
+def test_streamed_matches_resident_quantized(monkeypatch):
+    """Quantized compact/chunk core: the assembly runs _quant_prepare
+    with the same key the core re-derives its scales from, so the packed
+    gh words match bit-for-bit and int32 histograms make the parity
+    grouping-free."""
+    r = np.random.RandomState(11)
+    n, f = 20000, 5
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+    q = {"quantized_grad": True, "grad_bits": 8}
+    resident = grow_text(monkeypatch, x, y, g, h, q, strategy="chunk")
+    for rows in (0, 6000):           # derived | non-dividing tail
+        streamed = grow_text(monkeypatch, x, y, g, h,
+                             dict(q, stream_mode="chunked",
+                                  stream_chunk_rows=rows))
+        assert streamed == resident, f"stream_chunk_rows={rows}"
+
+
+def test_streamed_engine_with_bagging(monkeypatch):
+    # 0/1 bag weights ride the streamed gh section; engine-level trees
+    # identical to the resident chunk strategy. Streaming always runs
+    # the generic per-tree path, so force it on the resident side too —
+    # fused vs generic is NOT bit-parity with sigmoid gradients (see
+    # test_chunk_strategy.test_chunk_fused_training_end_to_end).
+    from lightgbm_tpu.models.gbdt import GBDT
+    monkeypatch.setattr(GBDT, "_fused_eligible", lambda self: False)
+    monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+    r = np.random.RandomState(21)
+    n, f = 9000, 5
+    x = r.uniform(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + 0.3 * r.normal(size=n) > 0.5).astype(np.float64)
+    params = dict(BASE, num_leaves=15, learning_rate=0.5,
+                  bagging_fraction=0.7, bagging_freq=2)
+
+    def run(extra):
+        return engine.train(dict(params, **extra),
+                            lgb.Dataset(x, y, free_raw_data=False),
+                            num_boost_round=3, verbose_eval=False)
+
+    monkeypatch.setenv("LGBM_TPU_STRATEGY", "chunk")
+    resident = run({})
+    monkeypatch.delenv("LGBM_TPU_STRATEGY")
+    streamed = run({"stream_mode": "chunked"})
+    assert trees_text(resident) == trees_text(streamed)
+
+
+# ---------------------------------------------------------------------------
+# GOSS working sets
+
+def test_goss_streamed_deterministic_and_covers_rows(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+    r = np.random.RandomState(31)
+    n, f = 3000, 5
+    x = r.uniform(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + 0.3 * r.normal(size=n) > 0.5).astype(np.float64)
+    params = dict(BASE, num_leaves=7, learning_rate=0.5,
+                  boosting="goss", stream_mode="goss",
+                  top_rate=0.3, other_rate=0.2)
+
+    def run():
+        return engine.train(dict(params),
+                            lgb.Dataset(x, y, free_raw_data=False),
+                            num_boost_round=5, verbose_eval=False)
+
+    a, b = run(), run()
+    assert trees_text(a) == trees_text(b)
+    lrn = a._gbdt.learner
+    # past warmup the working set is pinned (capped top-gradient rows)
+    ws_ids, ws_rows = lrn._shard.working_set()
+    assert ws_ids.size == max(1, int(n * 0.3))
+    assert ws_rows is not None
+    # every row (in-bag AND out-of-bag) got a leaf assignment
+    leaf = np.asarray(a._gbdt.learner.last_leaf_id)
+    assert leaf.shape == (n,) and (leaf >= 0).all()
+
+
+def test_goss_working_set_cap(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+    r = np.random.RandomState(33)
+    n, f = 3000, 5
+    x = r.uniform(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.float64)
+    params = dict(BASE, num_leaves=7, learning_rate=0.5,
+                  boosting="goss", stream_mode="goss",
+                  goss_working_set=100, top_rate=0.3, other_rate=0.2)
+    bst = engine.train(dict(params),
+                       lgb.Dataset(x, y, free_raw_data=False),
+                       num_boost_round=5, verbose_eval=False)
+    assert bst._gbdt.learner._shard.working_set()[0].size == 100
+
+
+def test_stream_goss_requires_goss_boosting():
+    with pytest.raises(LightGBMError):
+        Config(dict(BASE, stream_mode="goss"))
+
+
+# ---------------------------------------------------------------------------
+# strategy / learner gating
+
+def _tiny_ds():
+    r = np.random.RandomState(0)
+    x = r.uniform(size=(500, 4)).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.float64)
+    return x, y
+
+
+def test_stream_forces_chunk_strategy():
+    x, y = _tiny_ds()
+    cfg = Config(dict(BASE, stream_mode="chunked"))
+    ds = Dataset(x, config=cfg, label=y)
+    # auto would pick masked at n=500; streaming overrides to chunk
+    assert resolve_strategy(cfg, ds) == "chunk"
+
+
+def test_stream_rejects_masked_strategy():
+    x, y = _tiny_ds()
+    cfg = Config(dict(BASE, stream_mode="chunked"))
+    ds = Dataset(x, config=cfg, label=y)
+    with pytest.raises(LightGBMError, match="masked"):
+        resolve_strategy(cfg, ds, forced="masked")
+
+
+def test_stream_rejects_lru_capped_pool():
+    x, y = _tiny_ds()
+    cfg = Config(dict(BASE, stream_mode="chunked", num_leaves=255,
+                      histogram_pool_size=0.001))
+    ds = Dataset(x, config=cfg, label=y)
+    with pytest.raises(LightGBMError, match="histogram_pool_size"):
+        resolve_strategy(cfg, ds)
+
+
+@pytest.mark.parametrize("learner_name", ["data", "voting", "feature"])
+def test_stream_rejects_parallel_learners(learner_name):
+    x, y = _tiny_ds()
+    cfg = Config(dict(BASE, stream_mode="chunked",
+                      tree_learner=learner_name))
+    ds = Dataset(x, config=cfg, label=y)
+    with pytest.raises(LightGBMError, match="serial"):
+        create_tree_learner(cfg, ds)
+
+
+def test_stream_rejects_host_learner(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_HOST_LEARNER", "1")
+    x, y = _tiny_ds()
+    cfg = Config(dict(BASE, stream_mode="chunked"))
+    ds = Dataset(x, config=cfg, label=y)
+    with pytest.raises(LightGBMError, match="HOST_LEARNER"):
+        create_tree_learner(cfg, ds)
+
+
+def test_bad_stream_mode_rejected():
+    with pytest.raises(LightGBMError):
+        Config(dict(BASE, stream_mode="sideways"))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip (version-2 manifest)
+
+def test_stream_resume_bit_identical(monkeypatch, tmp_path):
+    """Kill-and-resume under stream_mode=chunked: the resumed run's
+    model text matches the uninterrupted one bit-for-bit, and the
+    checkpoint carries the version-2 stream state."""
+    monkeypatch.setenv("LGBM_TPU_CHUNK", "8192")
+    r = np.random.RandomState(41)
+    n, f = 3000, 5
+    x = r.uniform(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + 0.3 * r.normal(size=n) > 0.5).astype(np.float64)
+    params = dict(BASE, num_leaves=7, learning_rate=0.5,
+                  stream_mode="chunked", bagging_fraction=0.8,
+                  bagging_freq=3)
+
+    def train(rounds, **kw):
+        return engine.train(dict(params),
+                            lgb.Dataset(x, y, free_raw_data=False),
+                            num_boost_round=rounds, verbose_eval=False,
+                            **kw)
+
+    full = train(6)
+    train(4, callbacks=[checkpoint(str(tmp_path), checkpoint_freq=4)])
+    resumed = train(6, resume_from=str(tmp_path))
+    assert trees_text(full) == trees_text(resumed)
+    data = load_checkpoint(CheckpointManager(str(tmp_path))
+                           .checkpoints()[-1][1])
+    assert data.meta["version"] == 2
+    assert data.meta["min_reader_version"] == 2
+    assert data.state["stream"]["cursor"] > 0
+
+
+def test_nonstream_checkpoint_stays_version1(tmp_path):
+    x, y = _tiny_ds()
+    engine.train(dict(BASE, num_leaves=7),
+                 lgb.Dataset(x, y, free_raw_data=False),
+                 num_boost_round=2, verbose_eval=False,
+                 callbacks=[checkpoint(str(tmp_path), checkpoint_freq=2)])
+    data = load_checkpoint(CheckpointManager(str(tmp_path))
+                           .checkpoints()[-1][1])
+    assert data.meta["version"] == 1
+    assert data.meta["min_reader_version"] == 1
+
+
+def test_newer_checkpoint_rejected_with_message(tmp_path):
+    path = str(tmp_path / "future.ckpt")
+    write_checkpoint_file(path, {"format": FORMAT,
+                                 "min_reader_version": 99},
+                          {"state_json": np.array("{}")})
+    with pytest.raises(CheckpointError, match="reader version 99"):
+        load_checkpoint(path)
+
+
+# ---------------------------------------------------------------------------
+# compile-heavy sweeps (slow tier)
+
+@pytest.mark.slow
+def test_streamed_parity_categorical_sweep(monkeypatch):
+    r = np.random.RandomState(9)
+    n = 70000
+    x = np.stack([
+        r.randn(n).astype(np.float32),
+        r.randint(0, 12, n).astype(np.float32),
+        r.randn(n).astype(np.float32),
+    ], axis=1)
+    y = ((x[:, 0] + (x[:, 1] % 3 == 0) + 0.3 * r.randn(n)) > 0.7) \
+        .astype(np.float64)
+    g, h = exact_grads(r, n)
+    params = {"categorical_feature": "1"}
+    a = grow_text(monkeypatch, x, y, g, h, params, strategy="chunk")
+    b = grow_text(monkeypatch, x, y, g, h,
+                  dict(params, stream_mode="chunked"))
+    assert a == b
+
+
+@pytest.mark.slow
+def test_streamed_quantized_renew_sweep(monkeypatch):
+    # leaf re-quantization on/off x 2 chunk sizes, all bit-identical
+    r = np.random.RandomState(13)
+    n, f = 70000, 6
+    x = r.randn(n, f).astype(np.float32)
+    y = ((x[:, 0] + 0.3 * r.randn(n)) > 0).astype(np.float64)
+    g, h = exact_grads(r, n)
+    for renew in (True, False):
+        q = {"quantized_grad": True, "grad_bits": 8,
+             "quant_renew": renew}
+        resident = grow_text(monkeypatch, x, y, g, h, q,
+                             strategy="chunk")
+        for rows in (0, 25000):
+            streamed = grow_text(monkeypatch, x, y, g, h,
+                                 dict(q, stream_mode="chunked",
+                                      stream_chunk_rows=rows))
+            assert streamed == resident, (renew, rows)

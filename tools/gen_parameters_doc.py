@@ -30,7 +30,8 @@ knobs (`on_nonfinite`, `resume`, `snapshot_keep`, `checkpoint_freq`,
 and the `LGBM_TPU_FAULT_SPEC` / `LGBM_TPU_COLLECTIVE_RETRIES` env
 vars): see `docs/Reliability.md`. Observability knobs (`telemetry` and
 the `LGBM_TPU_TELEMETRY` / `LGBM_TPU_TRACE_RING` env vars): see
-`docs/Observability.md`.
+`docs/Observability.md`. Out-of-core streaming knobs (`stream_mode`,
+`stream_chunk_rows`, `goss_working_set`): see `docs/Streaming.md`.
 
 | Parameter | Default | Aliases | Constraints | Description |
 |---|---|---|---|---|
