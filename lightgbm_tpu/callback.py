@@ -144,9 +144,12 @@ def checkpoint(directory: str, checkpoint_freq: int = 1, keep_last: int = 3,
                              for r in env.evaluation_result_list]])
         if (env.iteration + 1) % checkpoint_freq == 0:
             if state["mgr"] is None:
-                from .resilience.checkpoint import CheckpointManager
-                state["mgr"] = CheckpointManager(directory, keep_last,
-                                                 prefix)
+                # rank-0 writer + post-save barrier on a real process
+                # group; single-process it IS the plain manager
+                from .distributed.checkpoint import (
+                    DistributedCheckpointManager)
+                state["mgr"] = DistributedCheckpointManager(
+                    directory, keep_last, prefix)
             path = state["mgr"].save(env.model, history=history)
             log.debug("checkpoint written: %s", path)
     _callback.order = 25

@@ -78,7 +78,9 @@ def _init_network(cfg: Config) -> None:
                     line.strip().replace(" ", ":") for line in f
                     if line.strip())
         network.init_from_params(machines, cfg.local_listen_port,
-                                 cfg.num_machines)
+                                 cfg.num_machines,
+                                 machine_rank=cfg.machine_rank,
+                                 coordinator=cfg.coordinator)
 
 
 def _train(params: Dict[str, str], cfg: Config) -> None:
@@ -108,17 +110,22 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
     ckpt_dir = cfg.output_model + ".ckpt"
     if cfg.resume:
         # resume=auto resumes from the run's own checkpoint directory;
-        # any other value is a checkpoint file or directory path
-        from .resilience.checkpoint import find_checkpoint, restore_checkpoint
+        # any other value is a checkpoint file or directory path.
+        # Multi-process: rank 0 resolves + broadcasts the bytes and
+        # non-zero ranks wait at the resume barrier (distributed/).
+        from .distributed.checkpoint import restore_for_resume
         src = (ckpt_dir if str(cfg.resume).lower() in ("auto", "true", "1")
                else cfg.resume)
-        restore_checkpoint(booster, find_checkpoint(src))
+        restore_for_resume(booster, src)
         log.info("Resumed training at iteration %d",
                  booster.current_iteration())
     mgr = None
     if cfg.checkpoint_freq > 0:
-        from .resilience.checkpoint import CheckpointManager
-        mgr = CheckpointManager(ckpt_dir, keep_last=cfg.snapshot_keep)
+        # rank-0 writer + post-save barrier; single-process it IS the
+        # plain CheckpointManager
+        from .distributed.checkpoint import DistributedCheckpointManager
+        mgr = DistributedCheckpointManager(ckpt_dir,
+                                           keep_last=cfg.snapshot_keep)
     num_iters = cfg.num_iterations
     metric_freq = max(1, cfg.metric_freq)
     snapshot_freq = cfg.snapshot_freq
@@ -149,8 +156,12 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
             trace_path = cfg.output_model + ".trace.json"
             telemetry.dump_trace(trace_path)
             log.info("telemetry trace written to %s", trace_path)
-    booster.save_model(cfg.output_model)
-    log.info("Model saved to %s", cfg.output_model)
+    from .distributed import bootstrap as dist
+    if dist.rank() == 0:
+        booster.save_model(cfg.output_model)
+        log.info("Model saved to %s", cfg.output_model)
+    else:
+        log.info("rank %d: model output is rank-0 work", dist.rank())
 
 
 def _write_snapshot(booster: Booster, cfg: Config, iteration: int) -> None:
@@ -160,7 +171,10 @@ def _write_snapshot(booster: Booster, cfg: Config, iteration: int) -> None:
     snapshots unboundedly."""
     import glob
     import re
+    from .distributed import bootstrap as dist
     from .resilience.checkpoint import atomic_write_text
+    if dist.rank() != 0:        # snapshots are rank-0 work, like the model
+        return
     atomic_write_text(f"{cfg.output_model}.snapshot_iter_{iteration}",
                       booster.model_to_string(num_iteration=-1))
     snaps = []

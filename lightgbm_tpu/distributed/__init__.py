@@ -1,0 +1,46 @@
+"""Multi-host distributed training layer.
+
+The reference builds its cluster trainer from a userspace transport
+(reference: src/network/ — linkers_socket.cpp full-mesh TCP,
+network.cpp Bruck/recursive-halving collectives) plus per-subsystem
+protocols layered on it (distributed bin finding, histogram
+ReduceScatter, global best-split sync, rank-0 model output). On TPU the
+transport IS the platform: `jax.distributed.initialize` joins the
+multi-host ICI/DCN domain and every in-training collective is an XLA op
+emitted inside the jitted tree programs (parallel/learners.py). What
+remains host-side — and what this package owns — is the *topology*:
+
+* `bootstrap`  — process-group bring-up from the reference's
+  ``machines``/``num_machines``/``machine_rank``/``local_listen_port``
+  config surface (env-var overrides for launchers), the global `Mesh`
+  the learners consume, and a named cross-host barrier.
+* `ingest`     — rank-partitioned dataset loading: each host samples
+  and bins its own row shard against cooperatively-found bin mappers
+  (io/distributed.py protocol), then all-gathers the compact binned
+  blocks so every host holds the identical `Dataset` (the float matrix
+  never crosses the wire; codes are ~8x smaller).
+* `checkpoint` — rank-0 checkpoint writes with a post-save barrier and
+  a broadcast-restore so resume works even when only the coordinator
+  has the checkpoint on disk.
+
+Single-process runs pass through every entry point unchanged — the
+virtual mesh (``XLA_FLAGS=--xla_force_host_platform_device_count=N``)
+stays the default and is bit-identical to a real multi-process run of
+the same mesh shape (asserted by tests/test_distributed_multihost.py).
+"""
+from __future__ import annotations
+
+from . import bootstrap, checkpoint, ingest
+from .bootstrap import (barrier, global_mesh, initialize,
+                        initialize_from_config, is_distributed,
+                        process_count, rank, shutdown)
+from .checkpoint import DistributedCheckpointManager, restore_for_resume
+from .ingest import load_sharded, shard_row_block
+
+__all__ = [
+    "bootstrap", "checkpoint", "ingest",
+    "barrier", "global_mesh", "initialize", "initialize_from_config",
+    "is_distributed", "process_count", "rank", "shutdown",
+    "DistributedCheckpointManager", "restore_for_resume",
+    "load_sharded", "shard_row_block",
+]

@@ -1,0 +1,111 @@
+"""Rank-0 checkpoint topology: one writer, everyone restores.
+
+The reference's cluster runs write the model from machine 0 only
+(reference: application.cpp — output paths are rank-0 work; other
+machines just keep training state in sync). Same topology here, on top
+of resilience/checkpoint.py:
+
+* **save** — rank 0 writes the full checkpoint (atomic file + checksum
+  manifest + rotation, unchanged), then every rank meets at a barrier
+  so no rank races past an un-durable checkpoint. Non-zero ranks do no
+  I/O and need no writable filesystem.
+* **restore** — rank 0 locates + reads the checkpoint bytes and
+  broadcasts them over the all-gather lane (io/distributed.py); every
+  rank restores from the identical bytes. Works with no shared
+  filesystem, and — because restore_checkpoint rebuilds scores from
+  the restored model — every rank's device shards come back bit-exact.
+
+Single-process, both collapse to the plain CheckpointManager /
+restore_checkpoint paths (no barrier, no broadcast, byte-identical
+behaviour), so callers can use these unconditionally.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+from ..resilience.checkpoint import (CheckpointData, CheckpointManager,
+                                     find_checkpoint, load_checkpoint,
+                                     restore_checkpoint)
+from ..utils import log
+from . import bootstrap
+
+
+def _broadcast_bytes_from_rank0(payload: Optional[bytes]) -> bytes:
+    """Rank 0's bytes on every rank (the all-gather lane doubles as a
+    broadcast: non-zero ranks contribute empty payloads)."""
+    from ..io.distributed import _allgather_host_bytes
+    chunks = _allgather_host_bytes(payload if payload is not None else b"")
+    return chunks[0]
+
+
+class DistributedCheckpointManager:
+    """Drop-in for resilience.checkpoint.CheckpointManager with the
+    rank-0 + barrier topology. save() returns the rank-0 path on every
+    rank (informational on non-writers)."""
+
+    def __init__(self, directory: str, keep_last: int = 3,
+                 prefix: str = "ckpt"):
+        self.directory = directory
+        self._writer = (CheckpointManager(directory, keep_last, prefix)
+                        if bootstrap.rank() == 0 else None)
+
+    def save(self, booster, history: Optional[list] = None) -> str:
+        path = ""
+        if bootstrap.is_distributed():
+            # capture is a collective (row-sharded scores are gathered
+            # across processes), so EVERY rank runs it; only rank 0 has
+            # a writer
+            from ..resilience.checkpoint import capture
+            meta, arrays = capture(booster, history)
+            if self._writer is not None:
+                path = self._writer.save_captured(meta, arrays)
+        elif self._writer is not None:
+            path = self._writer.save(booster, history=history)
+        # every rank blocks until rank 0's write is durable — a kill
+        # after the barrier can always resume from this iteration
+        bootstrap.barrier("ckpt_save")
+        return path
+
+    def latest(self) -> Optional[CheckpointData]:
+        if self._writer is not None:
+            return self._writer.latest()
+        return None
+
+
+def restore_for_resume(booster, source) -> CheckpointData:
+    """Distributed resume: rank 0 resolves `source` (checkpoint file or
+    directory, as engine.train resume_from) and broadcasts the raw
+    checkpoint bytes; every rank restores the booster from them. The
+    pre-restore barrier is the reference's resume gate: non-zero ranks
+    WAIT here until rank 0 has a checkpoint in hand."""
+    if not bootstrap.is_distributed():
+        data = (source if isinstance(source, CheckpointData)
+                else find_checkpoint(source))
+        restore_checkpoint(booster, data)
+        return data
+    bootstrap.barrier("ckpt_resume")
+    payload = None
+    if bootstrap.rank() == 0:
+        data0 = (source if isinstance(source, CheckpointData)
+                 else find_checkpoint(source))
+        with open(data0.path, "rb") as fh:
+            payload = fh.read()
+    raw = _broadcast_bytes_from_rank0(payload)
+    # parse via a temp file: the on-disk format (manifest + npz) is the
+    # one wire format, so rank 0 and everyone else read identical bytes
+    fd, tmp = tempfile.mkstemp(suffix=".ckpt")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(raw)
+        data = load_checkpoint(tmp)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:  # pragma: no cover
+            pass
+    restore_checkpoint(booster, data)
+    log.info("rank %d restored checkpoint at iteration %d",
+             bootstrap.rank(), data.iteration)
+    return data

@@ -94,11 +94,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     begin_iteration = init_iteration = booster.current_iteration()
     end_iteration = init_iteration + num_boost_round
     if resume_from is not None:
-        from .resilience import checkpoint as ckpt_mod
-        data = (resume_from if isinstance(resume_from,
-                                          ckpt_mod.CheckpointData)
-                else ckpt_mod.find_checkpoint(resume_from))
-        ckpt_mod.restore_checkpoint(booster, data)
+        # distributed/: rank 0 resolves + broadcasts the checkpoint
+        # bytes, non-zero ranks wait at the resume barrier; collapses
+        # to plain find/restore single-process
+        from .distributed.checkpoint import restore_for_resume
+        data = restore_for_resume(booster, resume_from)
         init_iteration = booster.current_iteration()
         # resume finishes the ORIGINAL run: num_boost_round is the total
         begin_iteration, end_iteration = 0, num_boost_round

@@ -80,9 +80,15 @@ def _allgather_host_bytes(payload: bytes) -> List[bytes]:
     padded[: arr.size] = arr
     gathered = np.asarray(multihost_utils.process_allgather(
         jnp.asarray(padded)))
-    gathered = gathered.reshape(jax.process_count(), max_len)
-    return [gathered[i, : int(sizes[i])].tobytes()
-            for i in range(jax.process_count())]
+    nproc = jax.process_count()
+    gathered = gathered.reshape(nproc, max_len)
+    # forensic counters (unconditional, low-frequency): every byte that
+    # crosses the host boundary through this lane — mapper exchange,
+    # sharded ingest blocks, checkpoint broadcast — lands here
+    from ..telemetry import counters
+    counters.incr("dist_allgathers")
+    counters.incr("dist_wire_bytes", float(max_len) * nproc + 8 * nproc)
+    return [gathered[i, : int(sizes[i])].tobytes() for i in range(nproc)]
 
 
 def distributed_find_bins(local_data: np.ndarray, config: Config,

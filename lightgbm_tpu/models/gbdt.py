@@ -41,6 +41,20 @@ K_EPSILON = 1e-15
 MODEL_VERSION = "v3"
 
 
+def _host_global(arr) -> Optional[np.ndarray]:
+    """Host copy of a device array that may span processes. Addressable
+    arrays fetch directly; process-spanning ones (row-sharded scores on
+    a real multi-host mesh) replicate through a collective — so when a
+    process group is active EVERY rank must reach this call in the same
+    order (distributed/checkpoint.py runs capture on all ranks)."""
+    if arr is None:
+        return None
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(arr))
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
 def _threshold_l1_np(s: float, l1: float) -> float:
     return math.copysign(max(0.0, abs(s) - l1), s)
 
@@ -920,11 +934,10 @@ class GBDT:
             "bag_rng": self._bag_rng.get_state(),
             "bag_indices": (None if self._bag_indices is None
                             else np.asarray(self._bag_indices)),
-            "train_score": (np.asarray(
-                jax.device_get(self.score_updater.score))
-                if getattr(self, "score_updater", None) is not None
-                else None),
-            "valid_scores": [np.asarray(jax.device_get(vu.score))
+            "train_score": (_host_global(self.score_updater.score)
+                            if getattr(self, "score_updater", None)
+                            is not None else None),
+            "valid_scores": [_host_global(vu.score)
                              for vu in self.valid_updaters],
         }
         if isinstance(self, DART):

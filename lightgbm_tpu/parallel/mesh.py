@@ -19,7 +19,16 @@ from ..utils import log
 
 def make_mesh(num_devices: Optional[int] = None, axis_name: str = "data",
               devices=None) -> Mesh:
-    """1-D mesh over the first num_devices devices (default: all)."""
+    """1-D mesh over the first num_devices devices (default: all).
+
+    The default (all devices) is the GLOBAL mesh owned by
+    distributed/bootstrap — jax.devices() spans every process under
+    jax.distributed, so the identical learner code serves the virtual
+    single-process mesh and a real multi-host group. Cached there so
+    learners, ingest, and checkpoints agree on one mesh object."""
+    if num_devices is None and devices is None:
+        from ..distributed import bootstrap
+        return bootstrap.global_mesh(axis_name)
     devs = list(devices if devices is not None else jax.devices())
     if num_devices is not None:
         devs = devs[:num_devices]

@@ -1,76 +1,52 @@
-"""Distributed process-group bootstrap.
+"""Distributed process-group bootstrap (thin delegate).
 
 Replaces the reference's socket/MPI transport stack
 (reference: src/network/linkers_socket.cpp full-mesh TCP handshake,
-network.cpp Bruck/recursive-halving collectives). On TPU the transport IS the
-platform: `jax.distributed.initialize` joins the multi-host ICI/DCN domain
-and all collectives are XLA ops emitted inside jitted programs
-(see parallel/*.py) — there is no userspace collective code to run.
+network.cpp Bruck/recursive-halving collectives). On TPU the transport
+IS the platform: `jax.distributed.initialize` joins the multi-host
+ICI/DCN domain and all collectives are XLA ops emitted inside jitted
+programs (see parallel/*.py) — there is no userspace collective code.
 
 This module keeps the reference's *bootstrap* API surface
-(`machines=host:port,...`, Booster.set_network) mapped onto
-jax.distributed, so CLI/Python driver code ports unchanged.
+(`machines=host:port,...`, Booster.set_network) for CLI/Python driver
+compatibility; the actual bring-up, env overrides, mesh, and barrier
+live in `lightgbm_tpu.distributed.bootstrap`. The one extra state kept
+here is the externally-injected identity (`init_external`) for hosts
+like Spark/Dask that own the process group themselves.
 """
 from __future__ import annotations
 
-from typing import Optional
-
+from ..distributed import bootstrap
 from ..utils import log
 
-_initialized = False
-_num_machines = 1
-_rank = 0
+_external = {"set": False, "num_machines": 1, "rank": 0}
 
 
 def init_from_params(machines: str, local_listen_port: int = 12400,
-                     num_machines: int = 1) -> None:
+                     num_machines: int = 1, machine_rank: int = -1,
+                     coordinator: str = "") -> None:
     """machines='ip1:port1,ip2:port2,...' -> jax.distributed.initialize.
 
-    Rank = index of our address in the machine list, coordinator = entry 0
-    (the reference derives rank the same way, linkers_socket.cpp:80)."""
-    global _initialized, _num_machines, _rank
-    if isinstance(machines, (list, tuple)):
-        machines = ",".join(machines)
-    entries = [m.strip() for m in str(machines).split(",") if m.strip()]
-    if len(entries) <= 1:
-        _num_machines = 1
-        return
-    import socket
-    my_names = {socket.gethostname(), "localhost", "127.0.0.1"}
-    try:
-        my_names.add(socket.gethostbyname(socket.gethostname()))
-    except OSError:
-        pass
-    rank = None
-    for i, e in enumerate(entries):
-        host = e.split(":")[0]
-        if host in my_names:
-            rank = i
-            break
-    if rank is None:
-        log.fatal("Could not find local machine in machine list: %s", machines)
-    import jax
-    from ..resilience import faults
-    # bootstrap is the other host-collective boundary: joining the
-    # process group retries transient failures with the same bounded
-    # backoff as the in-training collectives (resilience/faults.py)
-    faults.run_collective(
-        lambda: jax.distributed.initialize(
-            coordinator_address=entries[0],
-            num_processes=len(entries), process_id=rank),
-        site="bootstrap")
-    _initialized = True
-    _num_machines = len(entries)
-    _rank = rank
-    log.info("jax.distributed initialized: rank %d of %d", rank, len(entries))
+    Rank = `machine_rank` when >= 0, else the index of our address in
+    the machine list (the reference derives rank the same way,
+    linkers_socket.cpp:80); coordinator defaults to entry 0. Env trio
+    LGBM_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID wins over all of it."""
+    bootstrap.initialize_from_config(
+        machines, local_listen_port=local_listen_port,
+        num_machines=num_machines, machine_rank=machine_rank,
+        coordinator=coordinator)
 
 
 def num_machines() -> int:
-    return _num_machines
+    if _external["set"]:
+        return _external["num_machines"]
+    return bootstrap.process_count()
 
 
 def rank() -> int:
-    return _rank
+    if _external["set"]:
+        return _external["rank"]
+    return bootstrap.rank()
 
 
 def init_external(num_machines: int, rank: int) -> None:
@@ -78,22 +54,15 @@ def init_external(num_machines: int, rank: int) -> None:
     Spark/Dask inject collectives. Collectives here are XLA ops over the
     mesh, so only the (num_machines, rank) identity is recorded for the
     host-side coordination paths (rank-partitioned loading, logging)."""
-    global _initialized, _num_machines, _rank
-    _initialized = True
-    _num_machines = int(num_machines)
-    _rank = int(rank)
-    log.info("Network initialized externally: rank %d/%d", _rank,
-             _num_machines)
+    _external["set"] = True
+    _external["num_machines"] = int(num_machines)
+    _external["rank"] = int(rank)
+    log.info("Network initialized externally: rank %d/%d", rank,
+             num_machines)
 
 
 def free() -> None:
-    global _initialized, _num_machines, _rank
-    if _initialized:
-        import jax
-        try:
-            jax.distributed.shutdown()
-        except Exception:  # pragma: no cover
-            pass
-    _initialized = False
-    _num_machines = 1
-    _rank = 0
+    _external["set"] = False
+    _external["num_machines"] = 1
+    _external["rank"] = 0
+    bootstrap.shutdown()

@@ -341,7 +341,12 @@ class CheckpointManager:
         return out
 
     def save(self, booster, history: Optional[list] = None) -> str:
-        meta, arrays = capture(booster, history)
+        return self.save_captured(*capture(booster, history))
+
+    def save_captured(self, meta: Dict[str, Any],
+                      arrays: Dict[str, np.ndarray]) -> str:
+        """Write an already-captured state (distributed/checkpoint.py
+        captures on every rank — a collective — but writes on rank 0)."""
         path = self.path_for(meta["iteration"])
         write_checkpoint_file(path, meta, arrays)
         self._rotate()

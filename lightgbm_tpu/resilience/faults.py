@@ -247,6 +247,10 @@ def run_collective(fn, site: str = "collective",
     is a plain call — zero overhead on the clean path. Retrying re-runs
     the same jitted program, which is side-effect-free, so a retry is
     always consistent."""
+    # dispatch count is forensic ground truth either way (low-frequency:
+    # bootstrap, barriers, ingest — never per-split), so it does not
+    # gate on an active plan or on telemetry mode
+    telem_counters.incr("collective_dispatches")
     plan = active_plan()
     if plan is None:
         # clean path: one recorder-gate read (a no-op context manager
@@ -257,7 +261,6 @@ def run_collective(fn, site: str = "collective",
     budget = env_retries if retries is None else int(retries)
     delay = env_base if base_delay_s is None else float(base_delay_s)
     attempt = 0
-    telem_counters.incr("collective_dispatches")
     while True:
         try:
             plan.before_collective(site)

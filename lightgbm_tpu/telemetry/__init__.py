@@ -37,10 +37,49 @@ from .spans import span
 __all__ = ["counters", "recorder", "spans", "span", "mode", "set_mode",
            "enabled", "resolve_mode", "configure", "dump_trace",
            "telemetry_summary", "phase_breakdown", "prometheus_text",
-           "reset"]
+           "reset", "xla_trace_active"]
 
 MODES = ("off", "summary", "trace")
 _mode = "off"
+
+# -- XLA timeline (jax.profiler) under trace mode ---------------------------
+# Opt-in via LGBM_TPU_XLA_TRACE=<dir>: entering trace mode starts a
+# jax.profiler trace session writing the XLA device timeline next to the
+# host spans; leaving trace mode (or dump_trace) stops it. With the env
+# var unset — or any mode below trace — this is never consulted, so the
+# off-mode byte path is unchanged.
+_xla_trace = {"active": False, "dir": ""}
+
+
+def _xla_trace_start() -> None:
+    path = os.environ.get("LGBM_TPU_XLA_TRACE", "").strip()
+    if not path or _xla_trace["active"]:
+        return
+    try:
+        import jax
+        jax.profiler.start_trace(path)
+    except Exception as exc:          # profiler backend unavailable
+        log.warning("LGBM_TPU_XLA_TRACE: profiler start failed: %s", exc)
+        return
+    _xla_trace["active"] = True
+    _xla_trace["dir"] = path
+    log.info("XLA profiler trace started (dir %s)", path)
+
+
+def _xla_trace_stop() -> None:
+    if not _xla_trace["active"]:
+        return
+    try:
+        import jax
+        jax.profiler.stop_trace()
+        log.info("XLA profiler trace written to %s", _xla_trace["dir"])
+    except Exception as exc:  # pragma: no cover - stop raced the runtime
+        log.warning("LGBM_TPU_XLA_TRACE: profiler stop failed: %s", exc)
+    _xla_trace["active"] = False
+
+
+def xla_trace_active() -> bool:
+    return _xla_trace["active"]
 
 
 def mode() -> str:
@@ -67,6 +106,10 @@ def set_mode(new_mode: str) -> str:
     recorder.enable(active)
     counters.set_active(active)
     spans.enable(new_mode == "trace")
+    if new_mode == "trace":
+        _xla_trace_start()
+    else:
+        _xla_trace_stop()
     if active:
         counters.install_compile_listener()
     return _mode
@@ -93,7 +136,10 @@ def configure(param: str = "", explicit: bool = False) -> str:
 
 
 def dump_trace(path: str) -> str:
-    """Export the span ring as Chrome trace-event JSON; returns `path`."""
+    """Export the span ring as Chrome trace-event JSON; returns `path`.
+    An active jax.profiler session (LGBM_TPU_XLA_TRACE) is stopped
+    first so the XLA timeline is flushed next to the host spans."""
+    _xla_trace_stop()
     return spans.dump_trace(path)
 
 

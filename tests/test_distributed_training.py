@@ -27,11 +27,21 @@ import jax
 import jax.numpy as jnp
 
 rank = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
-jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
-                           num_processes=2, process_id=rank)
+# bootstrap selects gloo for CPU cross-process collectives BEFORE the
+# backend exists, then joins the process group
+from lightgbm_tpu.distributed import bootstrap
+bootstrap.initialize(f"127.0.0.1:{port}", 2, rank)
 assert jax.process_count() == 2 and len(jax.devices()) == 2
 
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:   # jax < 0.5: experimental API, check_rep not check_vma
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map_exp(f, *args, **kwargs)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.dataset import Dataset
@@ -217,6 +227,7 @@ def _free_port():
 
 
 @pytest.mark.slow
+@pytest.mark.distributed
 def test_two_process_data_parallel_training_step(tmp_path):
     port = _free_port()
     env = dict(os.environ)

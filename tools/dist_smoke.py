@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Distributed smoke: two-process localhost training vs virtual mesh.
+
+Launches the full multi-host topology on one machine — two
+`jax.distributed` processes with one CPU device each (gloo collectives)
+— trains a small data-parallel model through `lightgbm_tpu.distributed`
+(bootstrap + sharded ingest + rank-0 checkpointing), and compares the
+model text against the single-process virtual-mesh run
+(``XLA_FLAGS=--xla_force_host_platform_device_count=2``), which must be
+BIT-IDENTICAL (same mesh shape => same XLA program).
+
+Emits ONE JSON line (`dist_smoke`) like the other tools/ benches:
+
+* ``dist_parity``        — two-process model text == virtual-mesh text
+* ``quant_parity``       — same, quantized (grad_bits=8) lanes
+* ``wire_bytes_per_host``— telemetry `dist_wire_bytes` from rank 0
+  (mapper exchange + binned-block all-gather + checkpoint barrier)
+* ``collective_dispatches`` / ``collective_retries`` — host-collective
+  counters from the bootstrap/barrier sites (resilience/faults.py)
+
+Usage: python tools/dist_smoke.py
+Env:   DIST_ROWS (2000), DIST_FEATURES (8), DIST_ITERS (3),
+       DIST_LEAVES (15), DIST_QUANT (1 to include the quantized pass)
+       — defaults sized for a 1-core CPU CI host.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N = int(os.environ.get("DIST_ROWS", 2000))
+F = int(os.environ.get("DIST_FEATURES", 8))
+ITERS = int(os.environ.get("DIST_ITERS", 3))
+LEAVES = int(os.environ.get("DIST_LEAVES", 15))
+RUN_QUANT = os.environ.get("DIST_QUANT", "1") == "1"
+
+_WORKER = r"""
+import json, os, sys
+import numpy as np
+rank = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+quantized = sys.argv[4] == "1"
+N, F, ITERS, LEAVES = (int(v) for v in sys.argv[5:9])
+import jax
+from lightgbm_tpu.distributed import bootstrap, ingest
+if rank >= 0:
+    bootstrap.initialize(f"127.0.0.1:{port}", 2, rank)
+    assert bootstrap.is_distributed() and len(jax.devices()) == 2
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import counters
+
+r = np.random.RandomState(7)
+x = r.randn(N, F)
+y = (1.5 * x[:, 0] - x[:, 1] + r.randn(N) * 0.5 > 0).astype(np.float64)
+params = {"objective": "binary", "num_leaves": LEAVES, "verbosity": -1,
+          "max_bin": 63, "min_data_in_leaf": 20, "tree_learner": "data",
+          "metric": "none"}
+if quantized:
+    params.update(quantized_grad=True, grad_bits=8)
+ds = ingest.wrap_train_set(ingest.load_sharded(x, label=y, params=params))
+bst = lgb.train(params, ds, num_boost_round=ITERS, verbose_eval=False)
+txt = bst.model_to_string()
+payload = {"model": txt,
+           "wire_bytes": counters.get("dist_wire_bytes"),
+           "allgathers": counters.get("dist_allgathers"),
+           "dispatches": counters.get("collective_dispatches"),
+           "retries": counters.get("collective_retries")}
+with open(out, "w") as fh:
+    json.dump(payload, fh)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _run(script, args, env, timeout=600):
+    p = subprocess.run([sys.executable, script] + [str(a) for a in args],
+                       env=env, capture_output=True, text=True,
+                       timeout=timeout)
+    if p.returncode != 0:
+        raise RuntimeError(f"worker failed:\n{p.stderr[-3000:]}")
+
+
+def _pair(script, tmp, quant):
+    """One parity measurement: 2-process localhost vs virtual mesh."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = ""            # 1 device per process
+    outs = [os.path.join(tmp, f"r{i}_{quant}.json") for i in range(2)]
+    args = [quant, N, F, ITERS, LEAVES]
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(r), str(port), outs[r]]
+        + [str(a) for a in args],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True) for r in range(2)]
+    for p in procs:
+        _, err = p.communicate(timeout=600)
+        if p.returncode != 0:
+            raise RuntimeError(f"dist worker failed:\n{err[-3000:]}")
+    envv = dict(env)
+    envv["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    vout = os.path.join(tmp, f"v_{quant}.json")
+    _run(script, [-1, 0, vout] + args, envv)
+    res = []
+    for path in outs + [vout]:
+        with open(path) as fh:
+            res.append(json.load(fh))
+    r0, r1, v = res
+    parity = (r0["model"] == r1["model"] == v["model"])
+    return parity, r0
+
+
+def main():
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix="dist_smoke_") as tmp:
+        script = os.path.join(tmp, "worker.py")
+        with open(script, "w") as fh:
+            fh.write(_WORKER)
+        parity, r0 = _pair(script, tmp, "0")
+        quant_parity = None
+        if RUN_QUANT:
+            quant_parity, _ = _pair(script, tmp, "1")
+    print(json.dumps({
+        "dist_smoke": {
+            "rows": N, "features": F, "iters": ITERS, "leaves": LEAVES,
+            "processes": 2,
+            "dist_parity": bool(parity),
+            "quant_parity": quant_parity,
+            "wire_bytes_per_host": int(r0["wire_bytes"]),
+            "allgathers": int(r0["allgathers"]),
+            "collective_dispatches": int(r0["dispatches"]),
+            "collective_retries": int(r0["retries"]),
+            "wall_secs": round(time.time() - t0, 1),
+        }}))
+
+
+if __name__ == "__main__":
+    main()
