@@ -404,6 +404,12 @@ def main():
         # breakdown should cover the TIMED loop only: drop the warmup
         # iterations' phases (first-jit compile stalls live there)
         telemetry.recorder.reset()
+    if telemetry.events.enabled():
+        # same for the flight recorder: ring/counters restart at the
+        # timed loop (the JSONL sink stays open — warmup records remain
+        # on disk for forensics, the summary block below excludes them)
+        telemetry.events.reset()
+        telemetry.watchdogs.reset()
 
     def rank_auc(scores, labels):
         # tie-aware (mid-rank) AUC: few-tree models collapse many rows
@@ -531,7 +537,19 @@ def main():
         "telemetry": telemetry.mode(),
         "phase_breakdown": (telemetry.phase_breakdown()
                             if telemetry.enabled() else None),
+        # flight-recorder digest (telemetry/events.py; null with events
+        # off): where the JSONL landed plus the headline health signals
+        # a fleet dashboard wants without parsing the stream
+        "events_file": telemetry.events.sink_path(),
+        "run_report": ({
+            "events": sum(telemetry.events.counts().values()),
+            "stragglers": telemetry.events.counts().get("straggler", 0),
+            "watchdog_fires": sum(telemetry.watchdogs.fired().values()),
+            "overlap": (round(overlap, 4) if overlap is not None
+                        else None),
+        } if telemetry.events.enabled() else None),
     }))
+    telemetry.events.flush()
 
 
 if __name__ == "__main__":

@@ -151,6 +151,9 @@ def checkpoint(directory: str, checkpoint_freq: int = 1, keep_last: int = 3,
                 state["mgr"] = DistributedCheckpointManager(
                     directory, keep_last, prefix)
             path = state["mgr"].save(env.model, history=history)
+            from .telemetry import events as telem_events
+            telem_events.emit("checkpoint", iteration=env.iteration,
+                              path=path)
             log.debug("checkpoint written: %s", path)
     _callback.order = 25
     _callback._ckpt_history = history

@@ -152,6 +152,11 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
         import json
         log.info("telemetry summary: %s",
                  json.dumps(telemetry.telemetry_summary()))
+        if telemetry.events.sink_path():
+            telemetry.events.flush()
+            log.info("telemetry events written to %s "
+                     "(tools/run_report.py renders a markdown report)",
+                     telemetry.events.sink_path())
         if telemetry.mode() == "trace":
             trace_path = cfg.output_model + ".trace.json"
             telemetry.dump_trace(trace_path)

@@ -12,6 +12,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from . import callback as callback_mod
+from . import telemetry
 from .basic import Booster, Dataset
 from .telemetry import recorder as telem
 from .utils import log
@@ -86,6 +87,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
         cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
         cbs.add(callback_mod.record_evaluation(evals_result))
+    if telemetry.watchdogs.loss_guard_requested() \
+            and not any(hasattr(c, "_spike_state") for c in cbs):
+        # arm_loss_guard=1 in LGBM_TPU_WATCHDOGS: the watchdogs observe,
+        # the armed guard acts (rolls a loss spike back at order 22)
+        from .resilience import loss_spike_guard
+        cbs.add(loss_spike_guard())
     cbs_before = {c for c in cbs if getattr(c, "before_iteration", False)}
     cbs_after = cbs - cbs_before
     cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
@@ -108,35 +115,53 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if replayed is not None:      # stopping point predates checkpoint
             return replayed
 
+    from .resilience import faults
     evaluation_result_list = []
-    for i in range(init_iteration, end_iteration):
-        for cb in cbs_before:
-            cb(callback_mod.CallbackEnv(
-                model=booster, params=params, iteration=i,
-                begin_iteration=begin_iteration,
-                end_iteration=end_iteration,
-                evaluation_result_list=None))
-        stop = booster.update(fobj=fobj)
-        evaluation_result_list = []
-        if reduced_valid_sets or booster._gbdt.train_metrics:
-            # recorder phase OUTSIDE the iteration bracket: eval cost
-            # lands in the run totals, not in any iteration's wall
-            with telem.phase("eval"):
-                evaluation_result_list = (booster.eval_train(feval)
-                                          + booster.eval_valid(feval))
-        try:
-            for cb in cbs_after:
+    try:
+        for i in range(init_iteration, end_iteration):
+            for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=begin_iteration,
                     end_iteration=end_iteration,
-                    evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            evaluation_result_list = e.best_score
-            break
-        if stop:
-            break
+                    evaluation_result_list=None))
+            stop = booster.update(fobj=fobj)
+            evaluation_result_list = []
+            if reduced_valid_sets or booster._gbdt.train_metrics:
+                # recorder phase OUTSIDE the iteration bracket: eval cost
+                # lands in the run totals, not in any iteration's wall
+                with telem.phase("eval"):
+                    evaluation_result_list = (booster.eval_train(feval)
+                                              + booster.eval_valid(feval))
+            # per-iteration pure-delay fault site (delay_ms clause). It
+            # sits AFTER update() — whose in-program collectives are a
+            # sync point that would absorb the delay into every rank's
+            # wall — and BEFORE the aggregation gather, so a delayed
+            # rank arrives measurably late: the straggler harness's
+            # whole signal
+            faults.sleep_point("train_iter")
+            # flight recorder: metrics ride the staged iteration record;
+            # the fleet aggregator gathers per-rank summaries to rank 0
+            # on its period (a collective — same schedule on every rank)
+            telemetry.events.attach_metrics(evaluation_result_list)
+            telemetry.aggregate.maybe_tick(i)
+            try:
+                for cb in cbs_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=begin_iteration,
+                        end_iteration=end_iteration,
+                        evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                evaluation_result_list = e.best_score
+                break
+            if stop:
+                break
+    finally:
+        # the last staged iteration record (metrics attached) must land
+        # in the JSONL even when a callback raises
+        telemetry.events.flush()
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for item in evaluation_result_list:
         booster.best_score[item[0]][item[1]] = item[2]

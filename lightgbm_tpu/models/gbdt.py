@@ -29,6 +29,7 @@ from ..metrics import create_metrics
 from ..objectives import create_objective
 from ..objectives.objective import MAPE
 from ..ops import predict as predict_ops
+from ..ops import quantize as quantize_ops
 from ..resilience import faults
 from ..telemetry import counters as telem_counters
 from ..telemetry import recorder as telem
@@ -57,6 +58,17 @@ def _host_global(arr) -> Optional[np.ndarray]:
 
 def _threshold_l1_np(s: float, l1: float) -> float:
     return math.copysign(max(0.0, abs(s) - l1), s)
+
+
+def _grad_norm_summary(grad, hess) -> dict:
+    """Host L2/max summary of the iteration's gradient pair for the
+    flight recorder. Costs one device fetch — callers gate on
+    telemetry.events.enabled()."""
+    g = np.asarray(jax.device_get(grad), dtype=np.float64)
+    h = np.asarray(jax.device_get(hess), dtype=np.float64)
+    return {"grad_l2": float(np.linalg.norm(g)),
+            "grad_max_abs": float(np.max(np.abs(g))) if g.size else 0.0,
+            "hess_l2": float(np.linalg.norm(h))}
 
 
 class ScoreUpdater:
@@ -164,6 +176,7 @@ class GBDT:
         self.label_idx = 0
         self.loaded_parameter = ""
         self._sentry_retrying = False
+        self._ev_grad_norms = None
         # tensorized-ensemble cache: trees_to_arrays is O(T*M) host work
         # plus a device upload, and back-to-back predicts on a static
         # model were re-paying it every call. Keyed on a model
@@ -352,10 +365,14 @@ class GBDT:
                 and len(self.models) > self.num_tree_per_iteration:
             log.warning("non-finite %s at iteration %d: rolling back one "
                         "iteration", what, self.iter)
+            telemetry.events.emit("rollback", iteration=self.iter,
+                                  what=what, reason="non_finite")
             self.rollback_one_iter()
             return "retry"
         log.warning("non-finite %s at iteration %d: skipping iteration",
                     what, self.iter)
+        telemetry.events.emit("skip_iter", iteration=self.iter, what=what,
+                              reason="non_finite")
         return "skip"
 
     def _guard_gradients(self, grad, hess, recompute=None):
@@ -571,11 +588,59 @@ class GBDT:
     def train_one_iter(self, gradients=None, hessians=None) -> bool:
         """One boosting iteration; returns True when training should stop
         (no tree with >1 leaf was produced)."""
+        ev_on = telemetry.events.enabled()
+        if ev_on:
+            coll0 = (telem_counters.get("collective_dispatches"),
+                     telem_counters.get("collective_retries"))
+            self._ev_grad_norms = None
         with telem.iteration(self.iter):
             if gradients is None and hessians is None \
                     and self._fused_eligible():
-                return self._train_one_iter_fused()
-            return self._train_one_iter_generic(gradients, hessians)
+                stop = self._train_one_iter_fused()
+            else:
+                stop = self._train_one_iter_generic(gradients, hessians)
+        if ev_on:
+            self._emit_iteration_event(stop, coll0)
+        return stop
+
+    def _emit_iteration_event(self, stop: bool, coll0) -> None:
+        """Assemble this iteration's flight-recorder record: recorder
+        phases, grad/hess norms (generic path), quantization plan,
+        stream overlap/peaks, and collective deltas. Events-gated — the
+        off path never reaches here."""
+        rec: Dict[str, Any] = {}
+        last = telem.last_iteration()
+        if last is not None:
+            rec.update(last)
+        else:
+            rec["iteration"] = self.iter - (0 if stop else 1)
+        if stop:
+            rec["stop"] = True
+        if self._ev_grad_norms is not None:
+            rec["grad_norms"] = self._ev_grad_norms
+        cfg = self.config
+        if getattr(cfg, "quantized_grad", False):
+            rec["quant"] = {
+                "grad_bits": int(cfg.grad_bits),
+                "renew": bool(getattr(cfg, "quant_renew", False)),
+                "storage_bits": quantize_ops.storage_bits(
+                    int(cfg.grad_bits),
+                    bool(getattr(cfg, "quant_renew", False)))}
+        shard = getattr(self.learner, "_shard", None)
+        if shard is not None:
+            overlap = shard.overlap_fraction()
+            rec["stream"] = {
+                "overlap_fraction": (None if overlap is None
+                                     else round(overlap, 4)),
+                "peak_bytes": int(getattr(shard, "peak_bytes", 0)),
+                "h2d_bytes": int(getattr(shard, "h2d_bytes", 0))}
+        d0, r0 = coll0
+        dispatches = telem_counters.get("collective_dispatches") - d0
+        retries = telem_counters.get("collective_retries") - r0
+        if dispatches or retries:
+            rec["collectives"] = {"dispatches": int(dispatches),
+                                  "retries": int(retries)}
+        telemetry.record_iteration(rec)
 
     def _train_one_iter_generic(self, gradients=None, hessians=None) -> bool:
         init_scores = [0.0] * self.num_tree_per_iteration
@@ -597,6 +662,8 @@ class GBDT:
             self.iter += 1   # skipped: seeds keep moving, no tree/score
             return False
         grad, hess = guarded
+        if telemetry.events.enabled():
+            self._ev_grad_norms = _grad_norm_summary(grad, hess)
 
         with telem.phase("bagging"):
             bag_indices = self._bagging(self.iter)
@@ -1270,6 +1337,8 @@ class GOSS(GBDT):
             return False
         grad, hess = guarded
         self._last_grad_hess = (grad, hess)
+        if telemetry.events.enabled():
+            self._ev_grad_norms = _grad_norm_summary(grad, hess)
         with telem.phase("bagging"):
             if self._fused_goss() is None:
                 # reference warmup: no subsampling for the first

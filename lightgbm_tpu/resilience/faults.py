@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..telemetry import counters as telem_counters
+from ..telemetry import events as telem_events
 from ..telemetry import recorder as telem
 from ..utils import log
 
@@ -152,6 +153,8 @@ class FaultPlan:
             val = np.inf if c.name == "inf_grad" else np.nan
             grad = self._poison(grad, frac, val)
             self.events.append(f"{c.name}@iter={iteration}")
+            telem_events.emit("fault", fault=c.name, iteration=iteration,
+                              frac=frac)
             log.warning("fault injection: %s at iteration %d (frac=%g)",
                         c.name, iteration, frac)
         return grad, hess
@@ -185,6 +188,8 @@ class FaultPlan:
             else:
                 continue
             self.events.append(f"fail_collective@{site}#{call_n}")
+            telem_events.emit("fault", fault="fail_collective", site=site,
+                              call=call_n)
             raise TransientCollectiveError(
                 f"injected collective failure at {site} (call {call_n})")
 

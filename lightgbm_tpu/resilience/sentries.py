@@ -109,6 +109,9 @@ def loss_spike_guard(threshold: float = 2.0, lr_cut: float = 1.0,
             log.warning(
                 "loss spike at iteration %d (train metric %g -> %g): "
                 "rolling back", env.iteration + 1, prev, val)
+        from ..telemetry import events as telem_events
+        telem_events.emit("rollback", iteration=env.iteration,
+                          reason="loss_spike", prev=prev, value=val)
         env.model.rollback_one_iter()
         if lr_cut < 1.0 and hasattr(env.model, "reset_parameter"):
             cur = float(env.params.get("learning_rate", 0.1))

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 from ..utils import log
@@ -57,15 +58,23 @@ class ModelRegistry:
             ver = version or f"v{next(self._version_counter)}"
             if ver in self._models:
                 raise ValueError(f"model version {ver!r} already loaded")
+        from ..telemetry import events as telem_events
         with timer("serve_model_load"):
+            t0 = time.monotonic()
             prepared = PreparedModel(gbdt, ver, num_iteration)
             if warm:
                 for raw in self.warm_raw_score:
                     for b in self.warm_buckets:
                         self.predictor.warm(prepared, b, raw_score=raw)
+                telem_events.emit(
+                    "serve_warmup", version=ver,
+                    buckets=list(self.warm_buckets),
+                    warm_s=round(time.monotonic() - t0, 6))
         with self._lock:
+            previous = self._latest
             self._models[ver] = prepared
             self._latest = ver
+        telem_events.emit("serve_swap", version=ver, previous=previous)
         log.info("serving: loaded model %s (%d trees, %d features)",
                  ver, prepared.n_trees, prepared.num_features)
         return ver

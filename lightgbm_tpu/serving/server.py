@@ -55,12 +55,23 @@ class ServingApp:
         if rows is None:
             raise BadRequest("missing 'rows'")
         t0 = time.monotonic()
-        out, version = self.batcher.submit(
-            rows,
-            version=payload.get("version"),
-            raw_score=bool(payload.get("raw_score", False)),
-            timeout_ms=payload.get("timeout_ms"))
-        self.stats.observe("serve_request", time.monotonic() - t0)
+        try:
+            out, version = self.batcher.submit(
+                rows,
+                version=payload.get("version"),
+                raw_score=bool(payload.get("raw_score", False)),
+                timeout_ms=payload.get("timeout_ms"))
+        except Exception:
+            # error series keyed by the *requested* tag — no answer
+            # resolved one, and "which version is erroring" is exactly
+            # the canary question these labels exist to answer
+            requested = payload.get("version") or self.registry.latest \
+                or "latest"
+            self.stats.observe_version(requested, error=True)
+            raise
+        dt = time.monotonic() - t0
+        self.stats.observe("serve_request", dt)
+        self.stats.observe_version(version, dt)
         preds = (out[:, 0] if out.ndim == 2 and out.shape[1] == 1 else out)
         return {"predictions": preds.tolist(), "version": version,
                 "num_rows": int(out.shape[0])}

@@ -65,12 +65,21 @@ class LatencyHistogram:
 
 
 class ServingStats:
-    """Thread-safe counter + histogram registry for one serving stack."""
+    """Thread-safe counter + histogram registry for one serving stack.
+
+    Besides the flat counters/histograms, per-model-version series
+    (`observe_version`) track request count, error count, and a latency
+    histogram keyed by the version tag that answered (or was asked for,
+    on errors) — the observability half of canary/shadow traffic
+    splitting: `/stats` exposes them under `"versions"`, `/metrics`
+    renders them as `{version="..."}`-labeled series."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._hists: Dict[str, LatencyHistogram] = {}
+        self._versions: Dict[str, Dict[str, int]] = {}
+        self._vhists: Dict[str, LatencyHistogram] = {}
 
     def incr(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -87,9 +96,33 @@ class ServingStats:
                 hist = self._hists[name] = LatencyHistogram()
             hist.record(seconds)
 
+    def observe_version(self, version: str, seconds: float = None,
+                        error: bool = False) -> None:
+        """Count one request against a model version; `seconds` records
+        into the version's latency histogram (None on error paths where
+        no answer was produced)."""
+        version = str(version)
+        with self._lock:
+            ent = self._versions.setdefault(
+                version, {"requests": 0, "errors": 0})
+            ent["requests"] += 1
+            if error:
+                ent["errors"] += 1
+            if seconds is not None:
+                hist = self._vhists.get(version)
+                if hist is None:
+                    hist = self._vhists[version] = LatencyHistogram()
+                hist.record(seconds)
+
     def snapshot(self) -> Dict[str, dict]:
         with self._lock:
             return {
                 "counters": dict(self._counters),
                 "latency": {k: h.snapshot() for k, h in self._hists.items()},
+                "versions": {
+                    v: {"requests": ent["requests"],
+                        "errors": ent["errors"],
+                        "latency": (self._vhists[v].snapshot()
+                                    if v in self._vhists else None)}
+                    for v, ent in self._versions.items()},
             }

@@ -14,6 +14,13 @@ hook when off:
   `phase_breakdown` field, tools/profile_iter.py and the
   `record_telemetry` callback.
 
+Built on top of those, the flight-recorder layer: `events` (durable
+structured per-iteration JSONL stream, `LGBM_TPU_EVENTS=path`),
+`watchdogs` (slow-iteration / overlap-regression / grad-norm-spike
+monitors) and `aggregate` (per-rank summaries gathered to rank 0 with
+a straggler detector). tools/run_report.py renders the event stream as
+a markdown run report.
+
 Modes (`telemetry` config param, `LGBM_TPU_TELEMETRY` env — env wins):
 
 * ``off``     every hook is a no-op; the float path is byte-for-byte
@@ -31,12 +38,13 @@ from __future__ import annotations
 import os
 
 from ..utils import log
-from . import counters, recorder, spans
+from . import aggregate, counters, events, recorder, spans, watchdogs
 from .spans import span
 
-__all__ = ["counters", "recorder", "spans", "span", "mode", "set_mode",
-           "enabled", "resolve_mode", "configure", "dump_trace",
-           "telemetry_summary", "phase_breakdown", "prometheus_text",
+__all__ = ["counters", "recorder", "spans", "span", "events", "watchdogs",
+           "aggregate", "mode", "set_mode", "enabled", "resolve_mode",
+           "configure", "dump_trace", "telemetry_summary",
+           "phase_breakdown", "prometheus_text", "record_iteration",
            "reset", "xla_trace_active"]
 
 MODES = ("off", "summary", "trace")
@@ -105,6 +113,7 @@ def set_mode(new_mode: str) -> str:
     active = new_mode != "off"
     recorder.enable(active)
     counters.set_active(active)
+    events.enable(active)
     spans.enable(new_mode == "trace")
     if new_mode == "trace":
         _xla_trace_start()
@@ -160,15 +169,39 @@ def phase_breakdown() -> dict:
 def prometheus_text(serving_snapshot=None, cache_info=None) -> str:
     """Prometheus text for the serving `/metrics` endpoint: process
     counters + compile events + the serving stack's counters/latency
-    histograms + compiled-predictor cache gauges."""
-    extra_counters, latency, extra_gauges = None, None, None
+    histograms (per-version series labeled `{version="..."}`) +
+    compiled-predictor cache gauges + (on rank 0, once an aggregation
+    tick landed) the fleet-merged counters and per-rank skew gauges."""
+    extra_counters, latency, extra_gauges = {}, {}, {}
     if serving_snapshot:
-        extra_counters = serving_snapshot.get("counters")
-        latency = serving_snapshot.get("latency")
+        extra_counters.update(serving_snapshot.get("counters") or {})
+        latency.update(serving_snapshot.get("latency") or {})
+        for ver, vs in (serving_snapshot.get("versions") or {}).items():
+            label = f'{{version="{ver}"}}'
+            extra_counters[f"serve_version_requests{label}"] = \
+                vs.get("requests", 0)
+            extra_counters[f"serve_version_errors{label}"] = \
+                vs.get("errors", 0)
+            if vs.get("latency"):
+                latency[f"serve_version_request{label}"] = vs["latency"]
     if cache_info:
-        extra_gauges = {f"predictor_cache_{k}": v
-                        for k, v in cache_info.items()}
-    return counters.prometheus_text(extra_counters, latency, extra_gauges)
+        extra_gauges.update({f"predictor_cache_{k}": v
+                             for k, v in cache_info.items()})
+    fleet_counters, fleet_gauges = aggregate.prometheus_extras()
+    extra_counters.update(fleet_counters)
+    extra_gauges.update(fleet_gauges)
+    return counters.prometheus_text(extra_counters or None, latency or None,
+                                    extra_gauges or None)
+
+
+def record_iteration(rec: dict) -> None:
+    """Feed one assembled iteration record through the watchdogs and
+    into the flight recorder (GBDT.train_one_iter owns the assembly).
+    No-op while events are off."""
+    if not events.enabled():
+        return
+    watchdogs.observe(rec)
+    events.iteration_record(rec)
 
 
 def reset() -> None:
@@ -177,6 +210,9 @@ def reset() -> None:
     recorder.reset()
     counters.reset()
     spans.clear()
+    events.reset()
+    watchdogs.reset()
+    aggregate.reset()
 
 
 try:

@@ -1,0 +1,193 @@
+"""Fleet aggregation: per-rank iteration summaries gathered to rank 0.
+
+A two-process run was previously observable only as each process's own
+counters — no rank could answer "which host is slow". This module
+gathers a small per-rank summary over the existing all-gather lane
+(io/distributed._allgather_host_bytes, the same transport as mapper
+exchange and checkpoint broadcast) every `period` iterations and gives
+rank 0 three fleet views:
+
+* a **per-rank skew table** (`skew_table()`, also emitted as a
+  `kind="fleet"` event so tools/run_report.py renders it from rank 0's
+  JSONL alone);
+* **fleet-merged counters** folded into rank-0's Prometheus exposition
+  (`prometheus_extras()` — `fleet_*` totals plus per-rank labeled
+  iteration-wall gauges);
+* a **straggler detector**: each rank timestamps its ARRIVAL at the
+  aggregation collective; a rank arriving later than the fleet median
+  by more than the threshold is the one everyone else is waiting for
+  (in synchronous SPMD every rank's iteration *wall* converges to the
+  slowest rank's, so arrival skew at a barrier — not wall time — is
+  the honest straggler signal). Detection emits a `kind="straggler"`
+  event and bumps the `stragglers_detected` counter on rank 0.
+  Verifiable by injecting ``delay_ms`` via LGBM_TPU_FAULT_SPEC on one
+  rank (tools/dist_smoke.py topology).
+
+Knobs: ``LGBM_TPU_AGG_PERIOD`` (iterations between gathers, default 8,
+0 disables) and ``LGBM_TPU_STRAGGLER_MS`` (arrival-skew threshold,
+default 250 ms). The tick is a collective — every rank calls
+`maybe_tick(i)` at the same iterations (the engine loop owns the call
+site) — and is gated on a real multi-process group plus an enabled
+flight recorder, so single-process and telemetry-off runs never pay
+anything.
+
+Arrival timestamps are `time.time()` — comparable across ranks of one
+host (the CI topology) and NTP-close across a real fleet; the default
+threshold sits far above sane NTP skew.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+from typing import Dict, List, Optional
+
+from . import counters, events, recorder
+
+__all__ = ["period", "straggler_threshold_s", "enabled", "maybe_tick",
+           "skew_table", "prometheus_extras", "reset"]
+
+# counters worth shipping per tick: small, and the fleet sum is the
+# number an operator actually pages on
+_SHIPPED_COUNTERS = ("collective_dispatches", "collective_retries",
+                     "collective_failures", "dist_allgathers",
+                     "dist_wire_bytes", "watchdog_fires")
+
+_state = {
+    "prev_totals": None,     # recorder snapshot at the last tick
+    "skew_table": [],        # rank-0 rows from the latest tick
+    "fleet_counters": {},    # rank-0 fleet-summed counters
+    "stragglers": 0,
+}
+
+
+def period() -> int:
+    try:
+        return int(os.environ.get("LGBM_TPU_AGG_PERIOD", "8") or 8)
+    except ValueError:
+        return 8
+
+
+def straggler_threshold_s() -> float:
+    try:
+        return float(os.environ.get("LGBM_TPU_STRAGGLER_MS", "250")) / 1e3
+    except ValueError:
+        return 0.25
+
+
+def enabled() -> bool:
+    """Gathers run only with the flight recorder on AND a real
+    multi-process group up AND a non-zero period."""
+    if not events.enabled() or period() <= 0:
+        return False
+    from ..distributed import bootstrap
+    return bootstrap.is_distributed()
+
+
+def _local_summary(iteration: int) -> dict:
+    """This rank's contribution: per-phase seconds + iteration wall as
+    DELTAS since the previous tick, the shipped counters as run totals
+    (rank 0 sums them — they are per-process totals already), and the
+    arrival timestamp the straggler detector keys on."""
+    from ..distributed import bootstrap
+    bd = recorder.phase_breakdown()
+    prev = _state["prev_totals"] or {"phases": {}, "iterations": 0,
+                                     "wall_s": 0.0}
+    phases = {name: round(ent["secs"]
+                          - prev["phases"].get(name, {}).get("secs", 0.0), 6)
+              for name, ent in bd["phases"].items()}
+    iters = bd["iterations"] - prev["iterations"]
+    wall = bd["wall_s"] - prev["wall_s"]
+    _state["prev_totals"] = bd
+    return {
+        "rank": bootstrap.rank(),
+        "iteration": iteration,
+        "arrival_ts": time.time(),
+        "iters": iters,
+        "iter_wall_s": round(wall, 6),
+        "mean_iter_s": round(wall / iters, 6) if iters > 0 else 0.0,
+        "phases": phases,
+        "counters": {k: counters.get(k) for k in _SHIPPED_COUNTERS},
+    }
+
+
+def _ingest(summaries: List[dict]) -> List[dict]:
+    """Rank-0 side: build the skew table, merge fleet counters, detect
+    stragglers. Pure on its inputs (unit tests feed synthetic
+    summaries); emits fleet/straggler events as a side effect."""
+    arrivals = [s["arrival_ts"] for s in summaries]
+    med_arrival = statistics.median(arrivals)
+    threshold = straggler_threshold_s()
+    table = []
+    for s in summaries:
+        skew = s["arrival_ts"] - med_arrival
+        row = {"rank": s["rank"], "iteration": s["iteration"],
+               "iters": s["iters"], "mean_iter_s": s["mean_iter_s"],
+               "arrival_skew_s": round(skew, 6),
+               "phases": s.get("phases", {}),
+               "straggler": bool(skew > threshold)}
+        table.append(row)
+        if row["straggler"]:
+            _state["stragglers"] += 1
+            counters.incr("stragglers_detected")
+            events.emit("straggler", rank=s["rank"],
+                        iteration=s["iteration"],
+                        arrival_skew_s=row["arrival_skew_s"],
+                        threshold_s=threshold)
+    fleet: Dict[str, float] = {}
+    for s in summaries:
+        for k, v in (s.get("counters") or {}).items():
+            fleet[k] = fleet.get(k, 0.0) + float(v)
+    _state["skew_table"] = table
+    _state["fleet_counters"] = fleet
+    events.emit("fleet", ranks=len(summaries),
+                iteration=summaries[0]["iteration"] if summaries else None,
+                skew_table=[{k: v for k, v in row.items() if k != "phases"}
+                            for row in table])
+    return table
+
+
+def maybe_tick(iteration: int) -> Optional[List[dict]]:
+    """The engine loop's per-iteration hook: on period boundaries every
+    rank gathers its summary; rank 0 ingests the fleet view (other
+    ranks return None). A collective — all ranks must call it with the
+    same iteration sequence."""
+    if not enabled() or (iteration + 1) % period() != 0:
+        return None
+    from ..distributed import bootstrap
+    from ..io.distributed import _allgather_host_bytes
+    payload = json.dumps(_local_summary(iteration)).encode()
+    chunks = _allgather_host_bytes(payload)
+    if bootstrap.rank() != 0:
+        return None
+    return _ingest([json.loads(c.decode()) for c in chunks if c])
+
+
+def skew_table() -> List[dict]:
+    """The latest per-rank skew table (rank 0 only; [] elsewhere)."""
+    return list(_state["skew_table"])
+
+
+def prometheus_extras():
+    """(extra_counters, extra_gauges) for rank-0's exposition: fleet
+    totals as `fleet_*` counters, per-rank mean iteration wall as
+    labeled gauges. Empty until the first tick lands."""
+    extra_counters = {f"fleet_{k}": v
+                      for k, v in _state["fleet_counters"].items()}
+    extra_gauges = {}
+    for row in _state["skew_table"]:
+        extra_gauges[f'rank_mean_iter_seconds{{rank="{row["rank"]}"}}'] = \
+            row["mean_iter_s"]
+        extra_gauges[f'rank_arrival_skew_seconds{{rank="{row["rank"]}"}}'] = \
+            row["arrival_skew_s"]
+    if _state["skew_table"]:
+        extra_gauges["fleet_stragglers_detected"] = _state["stragglers"]
+    return extra_counters, extra_gauges
+
+
+def reset() -> None:
+    _state["prev_totals"] = None
+    _state["skew_table"] = []
+    _state["fleet_counters"] = {}
+    _state["stragglers"] = 0

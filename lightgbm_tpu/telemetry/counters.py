@@ -156,6 +156,18 @@ def _metric_name(name: str) -> str:
     return "lgbm_tpu_" + _NAME_RE.sub("_", str(name))
 
 
+def _split_labels(name: str):
+    """`serve_version_requests{version="v3"}` -> (family, `{...}`).
+    Plain names pass through with an empty label set; the label block is
+    already Prometheus syntax and is appended verbatim after the
+    sanitized family name."""
+    name = str(name)
+    brace = name.find("{")
+    if brace < 0:
+        return name, ""
+    return name[:brace], name[brace:]
+
+
 def prometheus_text(extra_counters: Optional[Dict] = None,
                     latency: Optional[Dict[str, dict]] = None,
                     extra_gauges: Optional[Dict] = None) -> str:
@@ -164,16 +176,22 @@ def prometheus_text(extra_counters: Optional[Dict] = None,
     mean_ms, p50_ms, p95_ms, p99_ms}}) and renders them as summaries."""
     snap = snapshot()
     lines: List[str] = []
+    typed = set()                    # families already TYPE-declared:
+    # labeled series of one family share a single TYPE line
 
     def emit(name: str, kind: str, value) -> None:
-        mname = _metric_name(name)
-        lines.append(f"# TYPE {mname} {kind}")
-        lines.append(f"{mname} {value}")
+        family, labels = _split_labels(name)
+        mname = _metric_name(family)
+        if mname not in typed:
+            typed.add(mname)
+            lines.append(f"# TYPE {mname} {kind}")
+        lines.append(f"{mname}{labels} {value}")
 
     merged_counters = dict(snap["counters"])
     merged_counters.update(extra_counters or {})
     for key in sorted(merged_counters):
-        emit(key + "_total", "counter", merged_counters[key])
+        family, labels = _split_labels(key)
+        emit(family + "_total" + labels, "counter", merged_counters[key])
     emit("compile_events_total", "counter", snap["compile"]["events"])
     emit("compile_seconds_total", "counter", snap["compile"]["seconds"])
     merged_gauges = dict(snap["gauges"])
@@ -182,13 +200,17 @@ def prometheus_text(extra_counters: Optional[Dict] = None,
         emit(key, "gauge", merged_gauges[key])
     for key in sorted(latency or {}):
         hist = latency[key]
-        mname = _metric_name(key) + "_seconds"
-        lines.append(f"# TYPE {mname} summary")
+        family, labels = _split_labels(key)
+        mname = _metric_name(family) + "_seconds"
+        if mname not in typed:
+            typed.add(mname)
+            lines.append(f"# TYPE {mname} summary")
         for quantile, field in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
                                 ("0.99", "p99_ms")):
-            lines.append(
-                f'{mname}{{quantile="{quantile}"}} {hist[field] / 1e3}')
+            qlabels = (labels[:-1] + f',quantile="{quantile}"}}' if labels
+                       else f'{{quantile="{quantile}"}}')
+            lines.append(f'{mname}{qlabels} {hist[field] / 1e3}')
         total_s = hist["mean_ms"] * hist["count"] / 1e3
-        lines.append(f"{mname}_sum {total_s}")
-        lines.append(f"{mname}_count {hist['count']}")
+        lines.append(f"{mname}_sum{labels} {total_s}")
+        lines.append(f"{mname}_count{labels} {hist['count']}")
     return "\n".join(lines) + "\n"

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Lint: recorder phase names in code <-> docs/Observability.md table.
+
+The per-iteration phase breakdown is only as trustworthy as its
+documentation: a phase added in code but missing from the docs table is
+invisible to whoever reads a waterfall, and a documented phase that no
+code records is a dashboard lying about coverage. This check extracts
+
+* every literal ``phase("name")`` call under ``lightgbm_tpu/``, and
+* every backticked name in the FIRST column of the phase table in
+  ``docs/Observability.md``,
+
+and fails (exit 1) on any difference, in either direction. Run directly
+or via tests/test_tools.py (tier-1, fast — pure text, no jax).
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO, "lightgbm_tpu")
+DOCS_PATH = os.path.join(REPO, "docs", "Observability.md")
+
+_PHASE_CALL = re.compile(r"\bphase\(\s*[\"']([a-z0-9_]+)[\"']")
+
+
+def code_phases(pkg_dir: str = PKG_DIR) -> Set[str]:
+    """All literal phase names recorded anywhere in the package."""
+    names: Set[str] = set()
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                names.update(_PHASE_CALL.findall(f.read()))
+    return names
+
+
+def doc_phases(docs_path: str = DOCS_PATH) -> Set[str]:
+    """Backticked names from the first column of the phase table (the
+    table whose header row is ``| Phase | Where |``)."""
+    names: Set[str] = set()
+    in_table = False
+    with open(docs_path) as f:
+        for line in f:
+            stripped = line.strip()
+            if re.match(r"^\|\s*Phase\s*\|\s*Where\s*\|", stripped):
+                in_table = True
+                continue
+            if in_table:
+                if not stripped.startswith("|"):
+                    break                      # table ended
+                first_col = stripped.split("|")[1]
+                names.update(re.findall(r"`([a-z0-9_]+)`", first_col))
+    return names
+
+
+def check() -> Tuple[Set[str], Set[str]]:
+    """-> (undocumented, phantom): code-not-docs and docs-not-code."""
+    code = code_phases()
+    docs = doc_phases()
+    return code - docs, docs - code
+
+
+def main() -> int:
+    undocumented, phantom = check()
+    ok = True
+    if undocumented:
+        ok = False
+        print("phase(s) recorded in code but missing from the "
+              "docs/Observability.md phase table: "
+              + ", ".join(sorted(undocumented)))
+    if phantom:
+        ok = False
+        print("phase(s) documented in docs/Observability.md but never "
+              "recorded by any phase(...) call: "
+              + ", ".join(sorted(phantom)))
+    if ok:
+        print(f"phase docs in sync ({len(code_phases())} phases)")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Render a markdown run report from a flight-recorder JSONL stream.
+
+Input is the file the trainer wrote under ``LGBM_TPU_EVENTS=path``
+(lightgbm_tpu/telemetry/events.py): one JSON object per line, iteration
+records interleaved with discrete events (checkpoint, rollback, fault,
+watchdog, straggler, fleet, serve_*). Output is a self-contained
+markdown document:
+
+* run summary (iterations, wall, event counts)
+* phase waterfall — per-phase seconds with ASCII bars
+* metric curve — per train/valid metric: first/best/last + sparkline
+* per-rank skew table — from the newest ``fleet`` aggregation event
+* event timeline — every non-iteration event, time-offset ordered
+
+Usage::
+
+    python tools/run_report.py events.jsonl [-o report.md]
+
+Pure stdlib + no jax import: safe to run anywhere, including on a
+laptop against a JSONL scp'd off a pod.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+BAR_WIDTH = 40
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def load_events(path: str) -> List[dict]:
+    """Parse the JSONL stream; malformed lines (torn final write of a
+    killed run) are skipped, not fatal."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "kind" in rec:
+                out.append(rec)
+    return out
+
+
+def _bar(value: float, vmax: float, width: int = BAR_WIDTH) -> str:
+    n = int(round(width * value / vmax)) if vmax > 0 else 0
+    return "█" * max(n, 1 if value > 0 else 0)
+
+
+def _sparkline(values: List[float], width: int = 32) -> str:
+    if not values:
+        return ""
+    if len(values) > width:           # downsample to terminal width
+        step = len(values) / width
+        values = [values[int(i * step)] for i in range(width)]
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return SPARK[0] * len(values)
+    return "".join(
+        SPARK[int((v - lo) / (hi - lo) * (len(SPARK) - 1))] for v in values)
+
+
+def summarize(path: str) -> dict:
+    """Digest the stream into the report's data model (also the
+    programmatic API — tests and bench tooling read this dict)."""
+    events = load_events(path)
+    iters = [e for e in events if e["kind"] == "iteration"]
+    others = [e for e in events if e["kind"] != "iteration"]
+    counts: Dict[str, int] = {}
+    for e in events:
+        counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+
+    phases: Dict[str, float] = {}
+    wall = 0.0
+    metrics: Dict[str, List] = {}
+    for rec in iters:
+        wall += float(rec.get("wall_s", 0.0))
+        for name, secs in (rec.get("phases") or {}).items():
+            phases[name] = phases.get(name, 0.0) + float(secs)
+        for name, val in (rec.get("metrics") or {}).items():
+            metrics.setdefault(name, []).append(
+                (rec.get("iteration"), float(val)))
+
+    skew_table = None
+    for e in reversed(others):        # newest fleet snapshot wins
+        if e["kind"] == "fleet" and e.get("skew_table"):
+            skew_table = e["skew_table"]
+            break
+
+    return {
+        "path": path,
+        "events": len(events),
+        "counts": counts,
+        "iterations": len(iters),
+        "first_iteration": iters[0].get("iteration") if iters else None,
+        "last_iteration": iters[-1].get("iteration") if iters else None,
+        "wall_s": round(wall, 6),
+        "phases": {k: round(v, 6) for k, v in phases.items()},
+        "metrics": metrics,
+        "skew_table": skew_table,
+        "stragglers": counts.get("straggler", 0),
+        "watchdog_fires": counts.get("watchdog", 0),
+        "timeline": others,
+    }
+
+
+def render(summary: dict) -> str:
+    lines: List[str] = []
+    w = lines.append
+    w(f"# Training run report")
+    w("")
+    w(f"Source: `{summary['path']}`")
+    w("")
+    w("| | |")
+    w("|---|---|")
+    w(f"| iterations | {summary['iterations']} "
+      f"({summary['first_iteration']}..{summary['last_iteration']}) |")
+    w(f"| iteration wall | {summary['wall_s']:.3f} s |")
+    w(f"| events | {summary['events']} |")
+    w(f"| stragglers | {summary['stragglers']} |")
+    w(f"| watchdog fires | {summary['watchdog_fires']} |")
+    kinds = ", ".join(f"{k}={n}" for k, n in sorted(summary["counts"].items()))
+    w(f"| event kinds | {kinds} |")
+    w("")
+
+    phases = summary["phases"]
+    if phases:
+        w("## Phase waterfall")
+        w("")
+        total = sum(phases.values())
+        vmax = max(phases.values())
+        w("| phase | seconds | share | |")
+        w("|---|---|---|---|")
+        for name, secs in sorted(phases.items(), key=lambda kv: -kv[1]):
+            share = secs / total * 100 if total > 0 else 0.0
+            w(f"| {name} | {secs:.4f} | {share:.1f}% | `{_bar(secs, vmax)}` |")
+        cov = total / summary["wall_s"] * 100 if summary["wall_s"] else 0.0
+        w("")
+        w(f"Phase coverage of iteration wall: {cov:.1f}%")
+        w("")
+
+    if summary["metrics"]:
+        w("## Metric curves")
+        w("")
+        w("| metric | first | best | last | curve |")
+        w("|---|---|---|---|---|")
+        for name in sorted(summary["metrics"]):
+            series = [v for _, v in summary["metrics"][name]]
+            best = min(series)  # direction-agnostic label: show min & max
+            worst = max(series)
+            best_s = (f"{best:g}/{worst:g}" if best != worst
+                      else f"{best:g}")
+            w(f"| {name} | {series[0]:g} | {best_s} | {series[-1]:g} "
+              f"| `{_sparkline(series)}` |")
+        w("")
+
+    if summary["skew_table"]:
+        w("## Per-rank skew (last fleet aggregation)")
+        w("")
+        w("| rank | iteration | iters | mean iter (s) | arrival skew (s) "
+          "| straggler |")
+        w("|---|---|---|---|---|---|")
+        for row in sorted(summary["skew_table"],
+                          key=lambda r: r.get("rank", 0)):
+            w(f"| {row.get('rank')} | {row.get('iteration')} "
+              f"| {row.get('iters')} | {row.get('mean_iter_s', 0):.4f} "
+              f"| {row.get('arrival_skew_s', 0):+.4f} "
+              f"| {'YES' if row.get('straggler') else ''} |")
+        w("")
+
+    timeline = summary["timeline"]
+    if timeline:
+        w("## Event timeline")
+        w("")
+        t0 = min(e.get("ts", 0.0) for e in timeline)
+        w("| t+s | kind | detail |")
+        w("|---|---|---|")
+        for e in timeline:
+            detail = ", ".join(
+                f"{k}={v}" for k, v in sorted(e.items())
+                if k not in ("kind", "ts", "seq", "skew_table"))
+            w(f"| {e.get('ts', t0) - t0:+.3f} | {e['kind']} | {detail} |")
+        w("")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("events", help="flight-recorder JSONL (LGBM_TPU_EVENTS)")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write markdown here (default: stdout)")
+    ns = ap.parse_args(argv)
+    text = render(summarize(ns.events))
+    if ns.output:
+        with open(ns.output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
