@@ -157,6 +157,9 @@ def checkpoint(directory: str, checkpoint_freq: int = 1, keep_last: int = 3,
             log.debug("checkpoint written: %s", path)
     _callback.order = 25
     _callback._ckpt_history = history
+    # the engine's rank-failure recovery resumes from this directory
+    # (engine._recover_after_rank_failure finds it by attribute)
+    _callback._ckpt_dir = directory
     return _callback
 
 

@@ -80,7 +80,13 @@ def _init_network(cfg: Config) -> None:
         network.init_from_params(machines, cfg.local_listen_port,
                                  cfg.num_machines,
                                  machine_rank=cfg.machine_rank,
-                                 coordinator=cfg.coordinator)
+                                 coordinator=cfg.coordinator,
+                                 supervise=cfg.dist_heartbeat_ms > 0)
+        # liveness + collective deadline, both opt-in (dist_heartbeat_ms
+        # / dist_collective_timeout_ms); no-ops single-process
+        from .distributed import supervisor
+        supervisor.start_supervision(cfg.dist_heartbeat_ms,
+                                     cfg.dist_collective_timeout_ms)
 
 
 def _train(params: Dict[str, str], cfg: Config) -> None:
@@ -130,20 +136,63 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
     metric_freq = max(1, cfg.metric_freq)
     snapshot_freq = cfg.snapshot_freq
     t0 = time.time()
-    for it in range(booster.current_iteration(), num_iters):
-        t_it = time.time()
-        stop = booster.update()
-        log.info("%.6f seconds elapsed, finished iteration %d",
-                 time.time() - t_it, it + 1)
-        if (it + 1) % metric_freq == 0:
-            for dname, mname, val, _ in booster.eval():
-                log.info("Iteration:%d, %s %s : %g", it + 1, dname, mname, val)
-        if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
-            _write_snapshot(booster, cfg, it + 1)
-        if mgr is not None and (it + 1) % cfg.checkpoint_freq == 0:
-            mgr.save(booster)
-        if stop:
-            break
+    from .distributed import supervisor
+    from .resilience import faults
+
+    def _boost_loop(booster, mgr):
+        sup = supervisor.active()
+        for it in range(booster.current_iteration(), num_iters):
+            # chaos + liveness boundary, same placement as engine.train
+            faults.kill_point(it)
+            if sup is not None:
+                sup.check()
+            t_it = time.time()
+            stop = booster.update()
+            log.info("%.6f seconds elapsed, finished iteration %d",
+                     time.time() - t_it, it + 1)
+            if (it + 1) % metric_freq == 0:
+                for dname, mname, val, _ in booster.eval():
+                    log.info("Iteration:%d, %s %s : %g", it + 1, dname,
+                             mname, val)
+            if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+                _write_snapshot(booster, cfg, it + 1)
+            if mgr is not None and (it + 1) % cfg.checkpoint_freq == 0:
+                mgr.save(booster)
+            if stop:
+                break
+
+    try:
+        _boost_loop(booster, mgr)
+    except Exception as exc:
+        rf = supervisor.classify_failure(exc)
+        if rf is None or cfg.on_rank_failure != "shrink":
+            raise
+        if mgr is None:
+            log.warning("on_rank_failure=shrink without checkpoint_freq: "
+                        "nothing to resume from")
+            raise
+        # shrink-and-resume: tear the dead group down, rebuild the
+        # dataset for the surviving world (CLI ingest re-reads the
+        # file; single-host construction is the byte path a fresh
+        # resumed run would take), restore the last rank-0 checkpoint,
+        # and finish the boosting budget (docs/Reliability.md)
+        del exc
+        del booster
+        supervisor.shrink_after_failure(rf)
+        train_set = Dataset(cfg.data, params=params)
+        train_set.construct()
+        booster = Booster(params=params, train_set=train_set)
+        for i, vpath in enumerate(cfg.valid or []):
+            vset = train_set.create_valid(vpath)
+            booster.add_valid(vset, f"valid_{i + 1}" if i else "valid_1")
+        from .distributed.checkpoint import (DistributedCheckpointManager,
+                                             restore_for_resume)
+        restore_for_resume(booster, ckpt_dir)
+        mgr = DistributedCheckpointManager(ckpt_dir,
+                                           keep_last=cfg.snapshot_keep)
+        log.warning("recovered: resuming at iteration %d single-host",
+                    booster.current_iteration())
+        _boost_loop(booster, mgr)
     log.info("Finished training in %.3f seconds", time.time() - t0)
     from . import telemetry
     if telemetry.enabled():

@@ -239,6 +239,9 @@ class Config:
                       "working-set policy and needs boosting=goss "
                       "(got boosting=%s); use stream_mode=chunked for "
                       "plain streaming", self.boosting)
+        if self.on_rank_failure not in ("raise", "shrink"):
+            log.fatal("on_rank_failure must be one of raise/shrink, "
+                      "got %s", self.on_rank_failure)
 
     # -- helpers used by the trainer -------------------------------------
     @property

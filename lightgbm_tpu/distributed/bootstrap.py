@@ -65,24 +65,69 @@ def resolve_rank(entries, explicit_rank: int = -1) -> Optional[int]:
     return None
 
 
+def _initialize_supervised(coordinator_address: str, num_processes: int,
+                           process_id: int) -> None:
+    """Join the group with the platform coordination service made INERT.
+
+    The stock ``jax.distributed.initialize`` arms the coordination
+    service's own heartbeat: when a rank dies, the service tears down
+    every *survivor* (hard process abort from a C++ polling thread) —
+    the opposite of elastic recovery, and its Python
+    missed-heartbeat callback path aborts with std::bad_cast on this
+    jaxlib. So the supervised path builds the same service/client pair
+    manually with effectively-infinite heartbeat knobs: the service
+    degenerates to the bootstrap KV store the backends need, while
+    OUR supervision (distributed/supervisor.py) owns liveness with a
+    clean Python-side failure path. ``shutdown_on_destruction=False``
+    keeps the client destructor from joining threads blocked on dead
+    peers during shrink."""
+    from jax._src import distributed as _jd
+    from jaxlib import xla_extension as xe
+
+    # seconds; the service only declares death after
+    # heartbeat_interval * max_missing_heartbeats — push it past any
+    # plausible job length
+    inert_s = 1_000_000
+    if int(process_id) == 0 and _jd.global_state.service is None:
+        port = coordinator_address.rsplit(":", 1)[1]
+        _jd.global_state.service = xe.get_distributed_runtime_service(
+            f"[::]:{port}", int(num_processes),
+            heartbeat_interval=inert_s, max_missing_heartbeats=10)
+    client = xe.get_distributed_runtime_client(
+        coordinator_address, int(process_id), init_timeout=60,
+        heartbeat_interval=inert_s, max_missing_heartbeats=10,
+        shutdown_on_destruction=False, use_compression=True)
+    client.connect()
+    _jd.global_state.client = client
+    _jd.global_state.num_processes = int(num_processes)
+    _jd.global_state.process_id = int(process_id)
+    _jd.global_state.coordinator_address = coordinator_address
+
+
 def initialize(coordinator_address: str, num_processes: int,
-               process_id: int) -> None:
+               process_id: int, supervise: bool = False) -> None:
     """Join the process group (idempotent). Bootstrap is a host
     collective boundary: joining retries transient failures with the
     same bounded backoff as in-training collectives
-    (resilience/faults.py)."""
+    (resilience/faults.py). ``supervise=True`` (or env
+    ``LGBM_TPU_SUPERVISE=1``) routes through the supervised bring-up so
+    rank death is OUR layer's to detect, not the platform's to abort
+    on."""
     if _state["initialized"]:
         return
     import jax
     from ..resilience import faults
     from ..telemetry import counters
     _enable_cpu_collectives()
-    faults.run_collective(
-        lambda: jax.distributed.initialize(
+    if supervise or os.environ.get("LGBM_TPU_SUPERVISE", "") == "1":
+        join = lambda: _initialize_supervised(  # noqa: E731
+            coordinator_address, num_processes, process_id)
+    else:
+        join = lambda: jax.distributed.initialize(  # noqa: E731
             coordinator_address=coordinator_address,
             num_processes=int(num_processes),
-            process_id=int(process_id)),
-        site="bootstrap")
+            process_id=int(process_id))
+    faults.run_collective(join, site="bootstrap")
     _state["initialized"] = True
     _state["num_processes"] = int(num_processes)
     _state["rank"] = int(process_id)
@@ -106,10 +151,13 @@ def initialize_from_env() -> bool:
 
 def initialize_from_config(machines: str = "", local_listen_port: int = 12400,
                            num_machines: int = 1, machine_rank: int = -1,
-                           coordinator: str = "") -> None:
+                           coordinator: str = "",
+                           supervise: bool = False) -> None:
     """The reference's config surface -> process group. Precedence:
     env-var trio > explicit ``coordinator`` + ``machine_rank`` >
-    ``machines`` list with hostname rank detection."""
+    ``machines`` list with hostname rank detection. ``supervise``
+    (set from ``dist_heartbeat_ms > 0``) selects the supervised
+    bring-up."""
     if _state["initialized"]:
         return
     if initialize_from_env():
@@ -119,7 +167,8 @@ def initialize_from_config(machines: str = "", local_listen_port: int = 12400,
             log.fatal("coordinator=%s requires machine_rank>=0 "
                       "(hostname detection needs the machines list)",
                       coordinator)
-        initialize(coordinator, num_machines, machine_rank)
+        initialize(coordinator, num_machines, machine_rank,
+                   supervise=supervise)
         return
     if isinstance(machines, (list, tuple)):
         machines = ",".join(machines)
@@ -130,7 +179,7 @@ def initialize_from_config(machines: str = "", local_listen_port: int = 12400,
     if rank_ is None:
         log.fatal("Could not find local machine in machine list: %s "
                   "(set machine_rank=<idx> to override)", machines)
-    initialize(entries[0], len(entries), rank_)
+    initialize(entries[0], len(entries), rank_, supervise=supervise)
 
 
 def _external_group():

@@ -136,9 +136,38 @@ def load_sharded(data: np.ndarray, config: Optional[Config] = None,
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
     lo, hi = shard_row_block(arr.shape[0], bootstrap.rank(), nproc)
-    return load_partition(
+    ds = load_partition(
         arr[lo:hi], cfg,
         label_local=None if label is None else np.asarray(label)[lo:hi],
         weight_local=None if weight is None else np.asarray(weight)[lo:hi],
         categorical=categorical, params=params,
         feature_names=feature_names)
+    # remember the construction inputs so a post-shrink `reshard` can
+    # rebuild for the new world size (multi-process only: the raw
+    # matrix is already resident here, so this is a reference, not a
+    # copy — single-process runs carry no extra state)
+    ds._reshard = {"data": arr, "label": label, "weight": weight,
+                   "group": group, "categorical": categorical,
+                   "params": params, "config": cfg,
+                   "feature_names": feature_names}
+    return ds
+
+
+def reshard(train_set):
+    """Rebuild a `load_sharded`-produced train set for the CURRENT
+    process group (called after a shrink changed the world size).
+    Accepts either the inner io Dataset or the lazy wrapper; returns a
+    wrapped train set ready for `engine.train`. After a shrink to
+    single-host this degenerates to plain local construction — byte-
+    identical to `Dataset(data, ...)`, which is what makes the resumed
+    run bit-identical to a fresh single-host resume."""
+    inner = getattr(train_set, "_inner", train_set)
+    src = getattr(inner, "_reshard", None)
+    if src is None:
+        log.fatal("reshard: train set was not produced by "
+                  "ingest.load_sharded (no construction record)")
+    return wrap_train_set(load_sharded(
+        src["data"], config=src["config"], label=src["label"],
+        weight=src["weight"], group=src["group"],
+        categorical=src["categorical"], params=src["params"],
+        feature_names=src["feature_names"]))

@@ -115,10 +115,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if replayed is not None:      # stopping point predates checkpoint
             return replayed
 
+    from .distributed import supervisor as _supervisor
     from .resilience import faults
+    sup = _supervisor.active()
     evaluation_result_list = []
     try:
         for i in range(init_iteration, end_iteration):
+            # chaos boundary (kill_rank@iter=) then liveness poll: one
+            # attribute read + one lock acquire per iteration, nothing
+            # on the device path — the float loop stays byte-identical
+            faults.kill_point(i)
+            if sup is not None:
+                sup.check()
             for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
@@ -158,6 +166,28 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 break
             if stop:
                 break
+    except Exception as exc:
+        # peer-death triage: only failures the supervision layer can
+        # attribute to a dead rank enter recovery; everything else
+        # propagates untouched
+        rf = _supervisor.classify_failure(exc, sup)
+        if rf is None:
+            raise
+        # drop the stale booster (device arrays on the dead backend) and
+        # the captured traceback before teardown so nothing pins the old
+        # topology through the shrink
+        del exc
+        del booster
+        return _recover_after_rank_failure(
+            rf, params, train_set, num_boost_round, cbs,
+            dict(valid_sets=valid_sets, valid_names=valid_names,
+                 fobj=fobj, feval=feval, feature_name=feature_name,
+                 categorical_feature=categorical_feature,
+                 early_stopping_rounds=early_stopping_rounds,
+                 evals_result=evals_result, verbose_eval=verbose_eval,
+                 learning_rates=learning_rates,
+                 keep_training_booster=keep_training_booster,
+                 callbacks=callbacks))
     finally:
         # the last staged iteration record (metrics attached) must land
         # in the JSONL even when a callback raises
@@ -166,6 +196,40 @@ def train(params: Dict[str, Any], train_set: Dataset,
     for item in evaluation_result_list:
         booster.best_score[item[0]][item[1]] = item[2]
     return booster
+
+
+def _recover_after_rank_failure(rf, params, train_set, num_boost_round,
+                                cbs, train_kwargs):
+    """Shrink-and-resume after a confirmed rank failure.
+
+    Policy gate: ``on_rank_failure=shrink`` AND a checkpoint callback
+    in the run (its ``_ckpt_dir`` is where the resume comes from) —
+    without a checkpoint there is nothing correct to resume, so the
+    failure propagates. Recovery tears the dead group down
+    (distributed/supervisor.py), re-shards the ingest for the shrunken
+    world, and re-enters ``train`` with ``resume_from`` pointed at the
+    last rank-0 checkpoint; everything downstream (history replay,
+    early stopping, evals_result) is the ordinary resume path, which is
+    what makes the recovered run bit-identical to a fresh train resumed
+    from that same checkpoint."""
+    from .distributed import ingest, supervisor
+    on_failure = str(params.get("on_rank_failure", "raise")).lower()
+    ckpt_dir = next((getattr(cb, "_ckpt_dir") for cb in cbs
+                     if getattr(cb, "_ckpt_dir", None)), None)
+    if on_failure != "shrink":
+        raise rf
+    if ckpt_dir is None:
+        log.warning("on_rank_failure=shrink but no checkpoint callback "
+                    "in this run: nothing to resume from, re-raising")
+        raise rf
+    log.warning("recovering from %s: shrink + resume from %s", rf,
+                ckpt_dir)
+    supervisor.shrink_after_failure(rf)
+    inner = getattr(train_set, "_inner", train_set)
+    if getattr(inner, "_reshard", None) is not None:
+        train_set = ingest.reshard(train_set)
+    return train(params, train_set, num_boost_round=num_boost_round,
+                 resume_from=ckpt_dir, **train_kwargs)
 
 
 def _replay_history(booster, params, history, evals_result, es_cb,

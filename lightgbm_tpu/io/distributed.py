@@ -61,7 +61,19 @@ def feature_slice(num_features: int, rank: int, num_processes: int
 def _allgather_host_bytes(payload: bytes) -> List[bytes]:
     """All-gather arbitrary host bytes across processes via a padded u8
     device array (the role of Network::Allgather on serialized mappers,
-    dataset_loader.cpp:697-716)."""
+    dataset_loader.cpp:697-716). Dispatches through
+    ``faults.run_collective`` so the wire hop shares the collective
+    deadline (``dist_collective_timeout_ms``) and jittered retry with
+    every other cross-rank lane — a dead peer surfaces as a typed
+    ``CollectiveTimeout``/transport error here instead of a silent hang
+    mid-ingest."""
+    from ..resilience import faults
+    return faults.run_collective(
+        lambda: _allgather_host_bytes_inner(payload),
+        site="allgather_bytes")
+
+
+def _allgather_host_bytes_inner(payload: bytes) -> List[bytes]:
     import jax
     import jax.numpy as jnp
     from jax.experimental import multihost_utils

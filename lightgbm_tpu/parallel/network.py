@@ -24,17 +24,20 @@ _external = {"set": False, "num_machines": 1, "rank": 0}
 
 def init_from_params(machines: str, local_listen_port: int = 12400,
                      num_machines: int = 1, machine_rank: int = -1,
-                     coordinator: str = "") -> None:
+                     coordinator: str = "", supervise: bool = False) -> None:
     """machines='ip1:port1,ip2:port2,...' -> jax.distributed.initialize.
 
     Rank = `machine_rank` when >= 0, else the index of our address in
     the machine list (the reference derives rank the same way,
     linkers_socket.cpp:80); coordinator defaults to entry 0. Env trio
-    LGBM_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID wins over all of it."""
+    LGBM_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID wins over all of it.
+    ``supervise`` (from ``dist_heartbeat_ms > 0``) selects the
+    supervised bring-up so rank liveness is owned by
+    distributed/supervisor.py instead of the platform's abort path."""
     bootstrap.initialize_from_config(
         machines, local_listen_port=local_listen_port,
         num_machines=num_machines, machine_rank=machine_rank,
-        coordinator=coordinator)
+        coordinator=coordinator, supervise=supervise)
 
 
 def num_machines() -> int:

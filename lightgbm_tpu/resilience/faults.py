@@ -25,6 +25,11 @@ Fault spec grammar (env ``LGBM_TPU_FAULT_SPEC`` or ``faults.install``):
                                     heal (exercises the retry path)
     fail_collective@p=0.1           fail each collective call with
                                     probability p (seeded)
+    kill_rank@iter=3[,code=137]     hard-exit THIS process (os._exit)
+                                    at boosting iteration 3 — the chaos
+                                    verb behind the two-process kill
+                                    harness (install the spec only in
+                                    the victim rank's environment)
     delay_ms=50                     sleep 50 ms at every fault site
                                     (collectives + serving flush)
     seed=123                        RNG seed for probabilistic clauses
@@ -39,6 +44,7 @@ attribute read when no plan is installed.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -49,16 +55,27 @@ from ..telemetry import events as telem_events
 from ..telemetry import recorder as telem
 from ..utils import log
 
-__all__ = ["TransientCollectiveError", "FaultPlan", "install", "clear",
-           "active_plan", "run_collective", "sleep_point"]
+__all__ = ["TransientCollectiveError", "CollectiveTimeout", "FaultPlan",
+           "install", "clear", "active_plan", "run_collective",
+           "sleep_point", "kill_point", "jittered_delay",
+           "set_collective_timeout_ms", "collective_timeout_ms"]
 
 _GLOBAL_KNOBS = ("seed", "delay_ms")
-_KNOWN = ("nan_grad", "inf_grad", "fail_collective")
+_KNOWN = ("nan_grad", "inf_grad", "fail_collective", "kill_rank")
 
 
 class TransientCollectiveError(RuntimeError):
     """A collective failed in a way worth retrying (injected here; the
     real-world analogs are preempted hosts and dropped DCN links)."""
+
+
+class CollectiveTimeout(RuntimeError):
+    """A collective dispatch exceeded its deadline
+    (``dist_collective_timeout_ms``). Deliberately NOT a
+    TransientCollectiveError: a deadline miss means a peer is likely
+    dead or wedged, and re-entering the same collective would block the
+    survivor again — the caller must consult the supervision layer
+    (distributed/supervisor.py) instead of retrying blindly."""
 
 
 class _Clause:
@@ -198,6 +215,21 @@ class FaultPlan:
             self.events.append(f"delay@{site}")
             time.sleep(self.delay_ms / 1e3)
 
+    # -- process-death boundary -----------------------------------------
+    def kill_code(self, iteration: int) -> Optional[int]:
+        """Exit code to die with at this boosting iteration, or None.
+        Pure decision logic so tests can pin it without dying; the
+        actual os._exit lives in module-level `kill_point`."""
+        for c in self.clauses:
+            if c.name != "kill_rank" or c.fired:
+                continue
+            if "iter" not in c.args or iteration != int(c.args["iter"]):
+                continue
+            c.fired = True
+            self.events.append(f"kill_rank@iter={iteration}")
+            return int(c.args.get("code", 137))
+        return None
+
 
 # -- global plan -------------------------------------------------------
 _plan: Optional[FaultPlan] = None
@@ -239,28 +271,115 @@ def sleep_point(site: str) -> None:
         plan.maybe_delay(site)
 
 
+def kill_point(iteration: int) -> None:
+    """Process-death fault site (`kill_rank@iter=` clauses). The engine
+    loop calls this at the top of each boosting iteration; the victim
+    dies with os._exit so no atexit/teardown runs — exactly how a
+    preempted or OOM-killed rank disappears."""
+    plan = active_plan()
+    if plan is None:
+        return
+    code = plan.kill_code(iteration)
+    if code is not None:
+        telem_events.emit("fault", fault="kill_rank", iteration=iteration,
+                          code=code)
+        telem_events.flush()
+        log.warning("fault injection: kill_rank at iteration %d "
+                    "(os._exit(%d))", iteration, code)
+        os._exit(code)
+
+
 def _retry_budget():
     return (int(os.environ.get("LGBM_TPU_COLLECTIVE_RETRIES", 3)),
             float(os.environ.get("LGBM_TPU_RETRY_BASE_MS", 10.0)) / 1e3)
+
+
+def jittered_delay(delay_s: float, rng) -> float:
+    """Uniform jitter in [delay/2, delay): simultaneous retriers across
+    a fleet decorrelate instead of re-colliding every backoff step
+    (full backoff growth is preserved — only the sleep is jittered)."""
+    return float(delay_s) * (0.5 + 0.5 * float(rng.rand()))
+
+
+# -- collective deadline ------------------------------------------------
+# Set from Config.dist_collective_timeout_ms by the distributed
+# supervisor (or the env var below). 0 = off, which is the single-
+# process default: the deadline thread costs a dispatch per collective,
+# so it is strictly opt-in.
+_timeout_override: Optional[float] = None
+
+
+def set_collective_timeout_ms(ms: Optional[float]) -> None:
+    """Install a process-wide collective deadline (None re-reads env)."""
+    global _timeout_override
+    _timeout_override = None if ms is None else float(ms)
+
+
+def collective_timeout_ms() -> float:
+    if _timeout_override is not None:
+        return _timeout_override
+    try:
+        return float(os.environ.get("LGBM_TPU_COLLECTIVE_TIMEOUT_MS", 0))
+    except ValueError:
+        return 0.0
+
+
+def _call_with_deadline(fn, site: str, timeout_ms: float):
+    """Dispatch fn on a watchdog-timed worker thread. On deadline the
+    worker is abandoned (it is blocked inside a dead collective; the
+    caller is about to tear the process group down anyway) and a typed
+    CollectiveTimeout is raised instead of hanging forever."""
+    done = threading.Event()
+    box: Dict[str, object] = {}
+
+    def _runner():
+        try:
+            box["result"] = fn()
+        except BaseException as exc:   # noqa: BLE001 — marshalled below
+            box["error"] = exc
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_runner, daemon=True,
+                         name=f"lgbm-tpu-collective-{site}")
+    t.start()
+    if not done.wait(timeout_ms / 1e3):
+        telem_counters.incr("collective_timeouts")
+        telem_events.emit("collective_timeout", site=site,
+                          timeout_ms=timeout_ms)
+        log.warning("collective %s exceeded its %.0f ms deadline", site,
+                    timeout_ms)
+        raise CollectiveTimeout(
+            f"collective {site} exceeded {timeout_ms:.0f} ms deadline")
+    err = box.get("error")
+    if err is not None:
+        raise err
+    return box.get("result")
 
 
 def run_collective(fn, site: str = "collective",
                    retries: Optional[int] = None,
                    base_delay_s: Optional[float] = None):
     """Dispatch a host-side collective call with bounded exponential-
-    backoff retry on TransientCollectiveError. With no active plan this
-    is a plain call — zero overhead on the clean path. Retrying re-runs
-    the same jitted program, which is side-effect-free, so a retry is
-    always consistent."""
+    backoff retry (jittered) on TransientCollectiveError, under the
+    optional process-wide deadline (dist_collective_timeout_ms — a
+    deadline miss raises CollectiveTimeout, which is NOT retried here).
+    With no active plan and no deadline this is a plain call — zero
+    overhead on the clean path. Retrying re-runs the same jitted
+    program, which is side-effect-free, so a retry is always
+    consistent."""
     # dispatch count is forensic ground truth either way (low-frequency:
     # bootstrap, barriers, ingest — never per-split), so it does not
     # gate on an active plan or on telemetry mode
     telem_counters.incr("collective_dispatches")
+    deadline_ms = collective_timeout_ms()
     plan = active_plan()
     if plan is None:
         # clean path: one recorder-gate read (a no-op context manager
         # while telemetry is off) on top of the plain call
         with telem.phase("collective"):
+            if deadline_ms > 0:
+                return _call_with_deadline(fn, site, deadline_ms)
             return fn()
     env_retries, env_base = _retry_budget()
     budget = env_retries if retries is None else int(retries)
@@ -270,6 +389,8 @@ def run_collective(fn, site: str = "collective",
         try:
             plan.before_collective(site)
             with telem.phase("collective"):
+                if deadline_ms > 0:
+                    return _call_with_deadline(fn, site, deadline_ms)
                 return fn()
         except TransientCollectiveError as exc:
             attempt += 1
@@ -279,8 +400,9 @@ def run_collective(fn, site: str = "collective",
                 log.warning("collective %s failed after %d retries", site,
                             budget)
                 raise
+            sleep_s = jittered_delay(delay, plan.rng)
             log.warning("transient failure at %s (attempt %d/%d): %s; "
                         "retrying in %.0f ms", site, attempt, budget, exc,
-                        delay * 1e3)
-            time.sleep(delay)
+                        sleep_s * 1e3)
+            time.sleep(sleep_s)
             delay = min(delay * 2.0, 1.0)

@@ -97,6 +97,7 @@ class MicroBatcher:
         self._queued_rows = 0
         self._cv = threading.Condition()
         self._closed = False
+        self._draining = False
         self._worker = None
         if start:
             self._worker = threading.Thread(
@@ -144,6 +145,11 @@ class MicroBatcher:
         with self._cv:
             if self._closed:
                 raise RuntimeError("batcher is closed")
+            if self._draining:
+                # graceful shutdown: stop admitting, keep flushing what
+                # is already queued (run_http_server drains on exit)
+                self.stats.incr("serve_rejected_draining")
+                raise OverloadedError("batcher is draining")
             if self._queued_rows + x.shape[0] > self.max_queue_rows:
                 self.stats.incr("serve_rejected_overload")
                 raise OverloadedError(
@@ -255,6 +261,47 @@ class MicroBatcher:
             batch = self._pop_batch()
             if batch:
                 self._execute(batch)
+
+    # -- liveness / shutdown --------------------------------------------
+    def alive(self) -> bool:
+        """Liveness for /healthz: open for business and (when a worker
+        was started) the worker thread still running. Inline mode
+        (start=False) has no worker to die, so open == alive."""
+        if self._closed or self._draining:
+            return False
+        return self._worker is None or self._worker.is_alive()
+
+    @property
+    def queued_rows(self) -> int:
+        with self._cv:
+            return self._queued_rows
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, timeout_s: float = 5.0) -> None:
+        """Graceful shutdown: stop admitting new requests, flush every
+        batch already in the queue (the worker keeps flushing; inline
+        mode flushes here), then close. In-flight waiters get real
+        results — only requests arriving after the drain started are
+        rejected."""
+        with self._cv:
+            if self._closed:
+                return
+            self._draining = True
+            self._cv.notify_all()
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        while time.monotonic() < deadline:
+            if self._worker is None or not self._worker.is_alive():
+                # no worker to flush for us: do it inline
+                if self.flush() == 0 and self.queued_rows == 0:
+                    break
+            else:
+                if self.queued_rows == 0:
+                    break
+                time.sleep(0.005)
+        self.close()
 
     def close(self) -> None:
         with self._cv:

@@ -17,7 +17,9 @@ Endpoints:
 * ``GET  /models``   loaded versions
 * ``POST /models``   {"model_file": path} | {"model_str": text}
   [, "version": tag] — load + warm + hot-swap to latest
-* ``GET  /healthz``  liveness + whether a model is loaded
+* ``GET  /healthz``  registry + batcher liveness: 200 with
+  ``status=ok`` when routable, 503 with ``status=draining``/
+  ``degraded`` during graceful shutdown or after a dead batcher worker
 """
 from __future__ import annotations
 
@@ -105,8 +107,25 @@ class ServingApp:
             self.stats.snapshot(), self.registry.predictor.cache_info())
 
     def health(self) -> dict:
-        return {"status": "ok", "model_loaded": self.registry.latest
-                is not None}
+        """Liveness for load balancers: registry + batcher state.
+        ``status`` is ``ok`` (routable), ``draining`` (shutdown in
+        progress — stop routing, in-flight work still completes) or
+        ``degraded`` (batcher worker dead/closed — not servable). The
+        HTTP layer maps non-``ok`` to 503."""
+        batcher_alive = self.batcher.alive()
+        draining = self.batcher.draining
+        status = ("draining" if draining
+                  else "ok" if batcher_alive else "degraded")
+        return {"status": status,
+                "model_loaded": self.registry.latest is not None,
+                "batcher_alive": batcher_alive,
+                "draining": draining,
+                "queued_rows": self.batcher.queued_rows}
+
+    def drain(self, timeout_s: float = 5.0) -> None:
+        """Graceful shutdown: stop admitting, flush in-flight batches,
+        then close the batcher."""
+        self.batcher.drain(timeout_s)
 
     def close(self) -> None:
         self.batcher.close()
@@ -178,7 +197,14 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/models":
             self._dispatch(self.app.models)
         elif self.path in ("/healthz", "/health"):
-            self._dispatch(self.app.health)
+            # non-ok health is a 503 so load balancers stop routing
+            # while drain/degradation is in progress
+            try:
+                body = self.app.health()
+                self._reply(200 if body.get("status") == "ok" else 503,
+                            body)
+            except Exception as exc:   # noqa: BLE001 — keep serving
+                self._reply(500, {"error": str(exc)})
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -216,6 +242,9 @@ def run_http_server(app: ServingApp, host: str = "127.0.0.1",
     except KeyboardInterrupt:   # pragma: no cover
         pass
     finally:
+        # graceful exit: stop admitting, flush what is queued, then
+        # close — in-flight requests get answers, not connection resets
+        app.drain()
         httpd.server_close()
         app.close()
     return httpd

@@ -39,8 +39,9 @@ def test_resolve_rank_explicit_and_hostname():
 def test_initialize_from_config_precedence(monkeypatch):
     from lightgbm_tpu.distributed import bootstrap
     calls = []
-    monkeypatch.setattr(bootstrap, "initialize",
-                        lambda c, n, p: calls.append((c, n, p)))
+    monkeypatch.setattr(
+        bootstrap, "initialize",
+        lambda c, n, p, supervise=False: calls.append((c, n, p)))
     # single machine: no-op
     bootstrap.initialize_from_config("", num_machines=1)
     bootstrap.initialize_from_config("host:1", num_machines=1)

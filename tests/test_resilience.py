@@ -110,6 +110,31 @@ def test_resume_parity_bit_identical(tmp_path, extra):
     assert _model_str(full) == _model_str(resumed)
 
 
+def test_resume_skips_torn_checkpoint(tmp_path):
+    """A checkpoint torn mid-write (truncated file) must not brick
+    resume: engine.train(resume_from=dir) skips the torn newest file
+    and resumes from the previous valid checkpoint, landing bit-
+    identical to the uninterrupted run."""
+    x, y = make_binary(n=600, f=10)
+    params = dict(BASE, bagging_fraction=0.8, bagging_freq=3)
+    full = engine.train(dict(params), lgb.Dataset(x, y),
+                        num_boost_round=8, verbose_eval=False)
+    engine.train(dict(params), lgb.Dataset(x, y), num_boost_round=6,
+                 verbose_eval=False,
+                 callbacks=[checkpoint(str(tmp_path), checkpoint_freq=2)])
+    ckpts = CheckpointManager(str(tmp_path)).checkpoints()
+    assert [it for it, _ in ckpts] == [2, 4, 6]
+    newest = ckpts[-1][1]
+    blob = open(newest, "rb").read()
+    open(newest, "wb").write(blob[:len(blob) // 2])      # tear it
+    assert find_checkpoint(str(tmp_path)).iteration == 4  # auto-skips
+    resumed = engine.train(dict(params), lgb.Dataset(x, y),
+                           num_boost_round=8, verbose_eval=False,
+                           resume_from=str(tmp_path))
+    assert resumed.current_iteration() == 8
+    assert _model_str(full) == _model_str(resumed)
+
+
 def test_resume_restores_evals_result_and_best_iteration(tmp_path):
     """best_iteration and evals_result after an interrupted + resumed
     run match the uninterrupted run (satellite regression test)."""

@@ -109,6 +109,81 @@ def test_http_error_paths(served):
     assert exc.value.code == 404
 
 
+def test_healthz_fields_and_200(served):
+    base, _, _, app = served
+    status, health = _get(base + "/healthz")
+    assert status == 200
+    assert health["status"] == "ok"
+    assert health["model_loaded"] is True
+    assert health["batcher_alive"] is True
+    assert health["draining"] is False
+    assert health["queued_rows"] == 0
+    assert app.health()["status"] == "ok"
+
+
+def test_healthz_503_and_reject_while_draining():
+    """Mid-drain the server stops admitting (429) and /healthz flips to
+    503/draining so load balancers pull the instance; a dedicated app so
+    the shared fixture's batcher is untouched."""
+    from lightgbm_tpu.serving.batcher import OverloadedError
+    bst, x = _train(num_boost_round=2)
+    registry = ModelRegistry(warm_buckets=(4,))
+    registry.load(bst)
+    app = ServingApp(registry, max_batch=8, max_delay_ms=1.0)
+    httpd = make_http_server(app, port=0)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        status, _ = _post(base + "/predict", {"rows": x[:2].tolist()})
+        assert status == 200
+        # freeze the batcher in the draining state (drain() itself
+        # finishes by closing; here we pin the intermediate state the
+        # load balancer sees during the flush window)
+        with app.batcher._cv:
+            app.batcher._draining = True
+        status_h, health = None, None
+        try:
+            _get(base + "/healthz")
+        except urllib.error.HTTPError as exc:
+            status_h, health = exc.code, json.loads(exc.read())
+        assert status_h == 503 and health["status"] == "draining"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base + "/predict", {"rows": x[:2].tolist()})
+        assert exc.value.code == 429           # OverloadedError: draining
+        with pytest.raises(OverloadedError):
+            app.batcher.submit(x[:1].tolist())
+        with app.batcher._cv:
+            app.batcher._draining = False
+        status, _ = _post(base + "/predict", {"rows": x[:2].tolist()})
+        assert status == 200                   # back to routable
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.close()
+
+
+def test_drain_flushes_inflight_then_closes():
+    """Graceful shutdown: requests queued before the drain get real
+    answers; the batcher ends closed with an empty queue."""
+    from lightgbm_tpu.serving.batcher import MicroBatcher
+    bst, x = _train(num_boost_round=2)
+    registry = ModelRegistry(warm_buckets=(4,))
+    registry.load(bst)
+    batcher = MicroBatcher(registry, max_batch=8, max_delay_ms=1.0,
+                           start=False)          # inline: deterministic
+    handles = batcher.submit_async(x[:3].tolist())
+    assert batcher.queued_rows == 3
+    batcher.drain(timeout_s=5.0)
+    out, version = handles[0].wait(0.1)          # already flushed
+    assert out.shape[0] == 3 and version
+    np.testing.assert_allclose(out[:, 0], bst.predict(x[:3]), atol=1e-6)
+    assert batcher.queued_rows == 0
+    assert not batcher.alive()
+    with pytest.raises(RuntimeError):            # closed, not draining
+        batcher.submit_async(x[:1].tolist())
+
+
 def test_cli_serve_task(tmp_path):
     """task=serve loads + warms the model and binds the HTTP server."""
     from lightgbm_tpu.cli import _serve

@@ -16,10 +16,20 @@ Emits ONE JSON line (`chaos_bench`) like the other tools/ benches:
   ``fail_collective@n=2`` probe through ``faults.run_collective``
   (transient failures must be retried, counted, and survive).
 
-Usage: python tools/chaos_bench.py
+``python tools/chaos_bench.py dist_kill`` runs the elastic-training
+scenario instead (one ``dist_kill`` JSON line): a two-process
+localhost run under supervision (``tools/dist_smoke.py`` plumbing),
+rank 1 hard-killed mid-train via the ``kill_rank@iter=`` fault verb;
+reports the survivor's detection latency, the recovery outcome
+(shrink to single-host + resume from the last rank-0 checkpoint), and
+whether the recovered model text is bit-identical to a single-host run
+resumed from that same checkpoint.
+
+Usage: python tools/chaos_bench.py [dist_kill]
 Env:   CHAOS_ROWS (6000), CHAOS_FEATURES (20), CHAOS_ITERS (24),
        CHAOS_WARMUP (4), CHAOS_LEAVES (15) — defaults sized for a
-       1-core CPU CI host; raise them on real hardware.
+       1-core CPU CI host; raise them on real hardware. The dist_kill
+       scenario uses the DIST_* knobs of tools/dist_smoke.py.
 """
 import json
 import os
@@ -95,6 +105,125 @@ def measure_overhead(x, y, k=None):
     return t_base, t_guard
 
 
+# -- dist_kill scenario -------------------------------------------------
+# two-process elastic-training probe; rank semantics in the worker:
+#   0 / 1  — the supervised pair (rank 1 installs kill_rank@iter=3)
+#   -1     — the single-host baseline resuming from the same checkpoint
+_KILL_WORKER = r"""
+import json, os, sys, time
+import numpy as np
+rank = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+ckpt_dir = sys.argv[4]; kill_iter = int(sys.argv[5])
+N, F, ITERS, LEAVES = (int(v) for v in sys.argv[6:10])
+import jax
+from lightgbm_tpu.distributed import bootstrap, ingest, supervisor
+if rank >= 0:
+    bootstrap.initialize(f"127.0.0.1:{port}", 2, rank, supervise=True)
+    supervisor.start_supervision(heartbeat_ms=100,
+                                 collective_timeout_ms=30000)
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine
+from lightgbm_tpu.callback import checkpoint
+from lightgbm_tpu.resilience import faults
+from lightgbm_tpu.telemetry import counters
+
+r = np.random.RandomState(7)
+x = r.randn(N, F)
+y = (1.5 * x[:, 0] - x[:, 1] + r.randn(N) * 0.5 > 0).astype(np.float64)
+params = {"objective": "binary", "num_leaves": LEAVES, "verbosity": -1,
+          "max_bin": 63, "min_data_in_leaf": 20, "tree_learner": "data",
+          "metric": "none", "on_rank_failure": "shrink"}
+if rank < 0:
+    # baseline: fresh single-host train resumed from the SAME checkpoint
+    src = os.path.join(ckpt_dir, sys.argv[10])
+    bst = engine.train(dict(params), lgb.Dataset(x, y),
+                       num_boost_round=ITERS, verbose_eval=False,
+                       resume_from=src)
+else:
+    if rank == 1:
+        faults.install(f"kill_rank@iter={kill_iter}")
+    ds = ingest.wrap_train_set(ingest.load_sharded(x, label=y,
+                                                   params=params))
+    bst = engine.train(params, ds, num_boost_round=ITERS,
+                       verbose_eval=False,
+                       callbacks=[checkpoint(ckpt_dir,
+                                             checkpoint_freq=2)])
+payload = {"model": bst.model_to_string(),
+           "shrinks": counters.get("shrinks"),
+           "rank_failures": counters.get("rank_failures"),
+           "heartbeat_probes": counters.get("heartbeat_probes"),
+           "shrink_unix": counters.get("last_shrink_unix")}
+with open(out, "w") as fh:
+    json.dump(payload, fh)
+"""
+
+
+def dist_kill_main():
+    """Two-process kill scenario; emits one `dist_kill` JSON line."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import subprocess
+    import dist_smoke                           # noqa: E402 — plumbing
+    kill_iter = 3
+    n, f = dist_smoke.N, dist_smoke.F
+    iters, leaves = max(6, dist_smoke.ITERS * 2), dist_smoke.LEAVES
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix="dist_kill_") as tmp:
+        script = os.path.join(tmp, "worker.py")
+        with open(script, "w") as fh:
+            fh.write(_KILL_WORKER)
+        ckpt_dir = os.path.join(tmp, "ckpt")
+        port = dist_smoke._free_port()
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = (dist_smoke.REPO + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        env["XLA_FLAGS"] = ""            # 1 device per process
+        outs = [os.path.join(tmp, f"r{i}.json") for i in range(2)]
+        args = [ckpt_dir, kill_iter, n, f, iters, leaves]
+        procs = [subprocess.Popen(
+            [sys.executable, script, str(r), str(port), outs[r]]
+            + [str(a) for a in args],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            text=True) for r in range(2)]
+        # the victim's observed exit stamps t_kill for detection latency
+        t_kill = None
+        while t_kill is None:
+            if procs[1].poll() is not None:
+                t_kill = time.time()
+            else:
+                time.sleep(0.002)
+        _, err0 = procs[0].communicate(timeout=600)
+        procs[1].communicate(timeout=60)
+        if procs[0].returncode != 0:
+            raise RuntimeError(f"survivor failed:\n{err0[-3000:]}")
+        kill_code = procs[1].returncode
+        with open(outs[0]) as fh:
+            r0 = json.load(fh)
+        # baseline: resume single-host from the checkpoint the recovery
+        # used — the newest one at kill time (kill at iteration
+        # `kill_iter`, freq 2 => iteration kill_iter - 1)
+        ckpt_name = f"ckpt_iter_{kill_iter - 1:07d}.ckpt"
+        vout = os.path.join(tmp, "baseline.json")
+        dist_smoke._run(script, [-1, 0, vout] + args + [ckpt_name], env)
+        with open(vout) as fh:
+            base = json.load(fh)
+    detect_ms = (None if not r0.get("shrink_unix") else
+                 round((r0["shrink_unix"] - t_kill) * 1e3, 1))
+    print(json.dumps({
+        "dist_kill": {
+            "rows": n, "features": f, "iters": iters,
+            "kill_iter": kill_iter, "kill_code": kill_code,
+            "detection_ms": detect_ms,
+            "recovered": bool(r0.get("shrinks") == 1 and r0["model"]),
+            "rank_failures": int(r0.get("rank_failures", 0)),
+            "heartbeat_probes": int(r0.get("heartbeat_probes", 0)),
+            "parity_vs_single_host_resume":
+                bool(r0["model"] == base["model"]),
+            "wall_secs": round(time.time() - t0, 1),
+        }}))
+
+
 def main():
     x, y = make_data()
     faults.clear()
@@ -166,4 +295,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "dist_kill":
+        dist_kill_main()
+    else:
+        main()
