@@ -283,15 +283,37 @@ def _serve(params: Dict[str, str], block: bool = True):
 
     Options (all `serve_*` to stay clear of the training namespace):
     serve_host, serve_port, serve_max_batch, serve_max_delay_ms,
-    serve_queue_rows, serve_timeout_ms, serve_warm_buckets (csv).
+    serve_queue_rows, serve_timeout_ms, serve_warm_buckets (csv),
+    serve_export_cache (bool or explicit dir — persist compiled
+    executables next to the model for zero-compile restarts),
+    serve_placement (``auto`` or ``version=ordinal,...`` device pins),
+    serve_predictor_cache_entries (LRU bound, 0 = unbounded).
     """
-    from .serving import ModelRegistry, ServingApp, run_http_server
+    from .serving import ModelRegistry, PredictorCache, ServingApp, \
+        run_http_server
     model_file = params.get("input_model") or params.get("model")
     if not model_file:
         log.fatal("task=serve requires input_model")
     warm = [int(v) for v in
             str(params.get("serve_warm_buckets", "1,16,256")).split(",") if v]
-    registry = ModelRegistry(warm_buckets=warm)
+    export_cache = None
+    cache_opt = str(params.get("serve_export_cache", "")).strip()
+    if cache_opt and cache_opt.lower() not in ("0", "false", "off"):
+        from .fleet import ExportCache, cache_dir_for_model
+        cache_dir = (cache_dir_for_model(model_file)
+                     if cache_opt.lower() in ("1", "true", "on", "auto")
+                     else cache_opt)
+        export_cache = ExportCache(cache_dir)
+    placement = None
+    place_opt = str(params.get("serve_placement", "")).strip()
+    if place_opt and place_opt.lower() not in ("0", "false", "off"):
+        from .fleet import PlacementPlan
+        placement = PlacementPlan(
+            "" if place_opt.lower() in ("1", "true", "on") else place_opt)
+    max_entries = int(params.get("serve_predictor_cache_entries", 0)) or None
+    registry = ModelRegistry(
+        predictor=PredictorCache(max_entries=max_entries),
+        warm_buckets=warm, export_cache=export_cache, placement=placement)
     app = ServingApp(
         registry,
         max_batch=int(params.get("serve_max_batch", 256)),
@@ -300,8 +322,10 @@ def _serve(params: Dict[str, str], block: bool = True):
         default_timeout_ms=float(params.get("serve_timeout_ms", 5000.0)))
     t0 = time.time()
     version = registry.load(model_file)
-    log.info("Loaded + warmed model %s in %.3f seconds (buckets %s)",
-             version, time.time() - t0, warm)
+    app.router.set_stable(version)
+    log.info("Loaded + warmed model %s in %.3f seconds (buckets %s%s)",
+             version, time.time() - t0, warm,
+             ", export cache on" if export_cache else "")
     return run_http_server(app, host=params.get("serve_host", "127.0.0.1"),
                            port=int(params.get("serve_port", 8080)),
                            background=not block)

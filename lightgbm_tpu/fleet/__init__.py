@@ -1,0 +1,20 @@
+"""Fleet control plane: the layer above `serving/` that runs MANY
+models on MANY devices for MANY replicas.
+
+- `export_cache` — persistent compiled-predictor cache: serialized warm
+  executables next to the model file, zero-compile process restarts.
+- `placement` — multi-model mesh placement: pin model versions to
+  distinct devices, no eviction thrash between co-resident boosters.
+- `router` — canary/shadow traffic router over the registry's version
+  pinning: weighted split, shadow mirroring, counter-gated promotion,
+  watchdog-triggered demotion.
+
+Rolling-restart tooling that drives this plane lives in
+`tools/rollout.py`.
+"""
+from .export_cache import ExportCache, cache_dir_for_model
+from .placement import PlacementPlan
+from .router import CanaryRouter, RouterState
+
+__all__ = ["ExportCache", "cache_dir_for_model", "PlacementPlan",
+           "CanaryRouter", "RouterState"]

@@ -1,0 +1,105 @@
+"""Multi-model mesh placement: pin model versions to distinct devices.
+
+One process serving a fleet of boosters wants each version's tensors
+AND compiled executables resident on its own device — co-locating them
+on device 0 (the jax default) serializes every request behind one
+queue and makes the predictor cache thrash between ensembles. A
+PlacementPlan hands each version a sticky device; the PreparedModel
+carries it into `device_put` and into the executable family key, so
+two placed versions never contend for the same cache entries.
+
+Assignment is deliberately dumb and predictable:
+
+* explicit — a spec like ``"stable=0,canary=1"`` pins versions to
+  device ordinals (the operator's escape hatch);
+* round-robin — unassigned versions take the least-loaded device,
+  ties broken by ordinal, so N versions over D devices spread evenly
+  and a re-loaded version keeps its slot (sticky until `release`).
+
+The plan is a host-side bookkeeping object — it never touches jax
+until a device is actually resolved, so it is constructible (and
+testable) before any backend is initialized.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..utils import log
+
+__all__ = ["PlacementPlan", "parse_placement_spec"]
+
+
+def parse_placement_spec(spec: str) -> Dict[str, int]:
+    """``"stable=0,canary=1"`` -> {"stable": 0, "canary": 1}.
+    Empty / "auto" -> {} (pure round-robin)."""
+    out: Dict[str, int] = {}
+    spec = (spec or "").strip()
+    if spec in ("", "auto", "round_robin"):
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"placement spec entry {part!r} is not version=ordinal")
+        version, ordinal = part.split("=", 1)
+        out[version.strip()] = int(ordinal)
+    return out
+
+
+class PlacementPlan:
+    """version -> device assignment, sticky and thread-safe."""
+
+    def __init__(self, spec: str = "", devices: Optional[List] = None):
+        self._explicit = parse_placement_spec(spec)
+        self._devices = devices          # resolved lazily
+        self._assigned: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def _resolve_devices(self) -> List:
+        if self._devices is None:
+            import jax
+            self._devices = list(jax.devices())
+        return self._devices
+
+    # ------------------------------------------------------------------
+    def assign(self, version: str):
+        """The device for `version`, assigning one if new. Explicit spec
+        entries win; otherwise least-loaded round-robin."""
+        devices = self._resolve_devices()
+        with self._lock:
+            if version in self._assigned:
+                return devices[self._assigned[version]]
+            if version in self._explicit:
+                ordinal = self._explicit[version] % len(devices)
+            else:
+                load = [0] * len(devices)
+                for o in self._assigned.values():
+                    load[o % len(devices)] += 1
+                for o in self._explicit.values():
+                    load[o % len(devices)] += 1
+                ordinal = min(range(len(devices)), key=lambda i: load[i])
+            self._assigned[version] = ordinal
+            log.info("placement: version %s -> device %d (%s)",
+                     version, ordinal,
+                     getattr(devices[ordinal], "platform", "?"))
+            return devices[ordinal]
+
+    def device_for(self, version: str):
+        """Assigned device or None — never assigns."""
+        with self._lock:
+            ordinal = self._assigned.get(version)
+        if ordinal is None:
+            return None
+        return self._resolve_devices()[ordinal]
+
+    def release(self, version: str) -> None:
+        """Free the slot (version retired) so round-robin rebalances."""
+        with self._lock:
+            self._assigned.pop(version, None)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._assigned)

@@ -1,0 +1,265 @@
+"""Canary/shadow traffic router: weighted split, counter-gated promotion.
+
+PR 9 built the measurement half of canary deployment — per-version
+request/error/latency series in ServingStats. This is the missing
+half: a router that decides, per request, which model version answers,
+and moves versions through the canary state machine on the evidence of
+their own counters.
+
+State machine (one stable, at most one canary):
+
+    deploy(v, weight)        stable answers 1-w of traffic, canary w
+      |                      (or 0 in shadow mode: canary only sees
+      |                      mirrored copies, responses discarded)
+      +-- promote            canary becomes stable (auto when its
+      |                      counters clear the health gate, or forced)
+      +-- demote(reason)     canary dropped (auto on error spike /
+                             latency blowout / watchdog fire, or forced)
+
+The split is deterministic, not random: request n goes to the canary
+iff ``floor(n*w) > floor((n-1)*w)``, which hits the weight exactly on
+every prefix — reproducible in tests and drift-free in production.
+
+Promotion gate (evaluated per request, O(dict reads)):
+
+* at least `min_requests` canary requests since deploy;
+* canary error rate <= `max_error_rate`;
+* canary p99 <= `p99_ratio` x stable p99 (skipped when the stable has
+  no latency history);
+* no watchdog fire since deploy (`telemetry.counters` watchdog_fires).
+
+Demotion fires immediately — before min_requests — on an absolute
+error burst (`demote_errors`) or a watchdog fire: a bleeding canary is
+cut, not averaged out.
+
+Both routed versions are pinned in the predictor cache for as long as
+they hold a slot (ModelRegistry.pin_version), so LRU eviction under
+multi-model load can never drop an executable that live traffic needs.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..telemetry import counters as telem_counters
+from ..telemetry import events as telem_events
+from ..utils import log
+
+__all__ = ["CanaryRouter", "RouterState"]
+
+
+class RouterState:
+    STABLE_ONLY = "stable_only"
+    CANARY = "canary"
+    SHADOW = "shadow"
+
+
+class CanaryRouter:
+    """Per-request version routing over a ModelRegistry + ServingStats."""
+
+    def __init__(self, registry, stats, min_requests: int = 50,
+                 max_error_rate: float = 0.02, p99_ratio: float = 3.0,
+                 demote_errors: int = 3):
+        self.registry = registry
+        self.stats = stats
+        self.min_requests = int(min_requests)
+        self.max_error_rate = float(max_error_rate)
+        self.p99_ratio = float(p99_ratio)
+        self.demote_errors = int(demote_errors)
+        self._lock = threading.Lock()
+        self._stable: Optional[str] = None
+        self._canary: Optional[str] = None
+        self._weight = 0.0
+        self._shadow = False
+        self._route_n = 0
+        self._canary_routed = 0
+        self._baseline: Dict[str, float] = {}
+        self.history: List[dict] = []
+
+    # -- configuration ---------------------------------------------------
+    def set_stable(self, version: str) -> None:
+        """Install/replace the stable version (pinned against eviction)."""
+        with self._lock:
+            previous = self._stable
+            self._stable = version
+        self.registry.pin_version(version)
+        if previous and previous != version:
+            self.registry.unpin_version(previous)
+        telem_events.emit("router_stable", version=version,
+                          previous=previous)
+
+    def deploy(self, version: str, weight: float = 0.10,
+               shadow: bool = False) -> None:
+        """Start canarying `version` at `weight` of traffic (shadow mode
+        mirrors instead of splitting). Baselines the canary's counters
+        and the process watchdog counter so the gate judges only what
+        happens AFTER this deploy."""
+        if not (0.0 < weight <= 1.0) and not shadow:
+            raise ValueError(f"canary weight {weight} not in (0, 1]")
+        self.registry.get(version)          # raises on unknown version
+        with self._lock:
+            if self._stable is None:
+                raise RuntimeError("deploy a stable version first")
+            if self._canary is not None:
+                raise RuntimeError(
+                    f"canary {self._canary!r} already in flight")
+            self._canary = version
+            self._weight = 0.0 if shadow else float(weight)
+            self._shadow = bool(shadow)
+            self._route_n = 0
+            self._canary_routed = 0
+            self._baseline = self._counters_for(version)
+            self._baseline["watchdog_fires"] = telem_counters.get(
+                "watchdog_fires")
+        self.registry.pin_version(version)
+        telem_counters.set_gauge("router_canary_weight",
+                                 0.0 if shadow else weight)
+        telem_events.emit("router_deploy", version=version, weight=weight,
+                          shadow=shadow)
+        log.info("router: canary %s at %.0f%%%s", version, weight * 100,
+                 " (shadow)" if shadow else "")
+
+    # -- routing ---------------------------------------------------------
+    def route(self) -> Optional[str]:
+        """The version that should answer the next request (None when no
+        stable is installed — caller falls back to registry latest)."""
+        with self._lock:
+            if self._stable is None:
+                return None
+            if self._canary is None or self._shadow:
+                return self._stable
+            self._route_n += 1
+            n, w = self._route_n, self._weight
+            if math.floor(n * w) > math.floor((n - 1) * w):
+                self._canary_routed += 1
+                return self._canary
+            return self._stable
+
+    def shadow_target(self) -> Optional[str]:
+        """The version to mirror this request to (None = no mirroring)."""
+        with self._lock:
+            return self._canary if (self._shadow and self._canary) else None
+
+    @property
+    def active(self) -> bool:
+        with self._lock:
+            return self._stable is not None
+
+    @property
+    def stable(self) -> Optional[str]:
+        with self._lock:
+            return self._stable
+
+    @property
+    def canary(self) -> Optional[str]:
+        with self._lock:
+            return self._canary
+
+    # -- the gate --------------------------------------------------------
+    def _counters_for(self, version: str) -> Dict[str, float]:
+        snap = self.stats.snapshot()["versions"].get(version) or {}
+        return {"requests": snap.get("requests", 0),
+                "errors": snap.get("errors", 0)}
+
+    def _p99_ms(self, version: str) -> float:
+        snap = self.stats.snapshot()["versions"].get(version) or {}
+        lat = snap.get("latency") or {}
+        return float(lat.get("p99_ms", 0.0))
+
+    def evaluate(self) -> str:
+        """Apply the state machine once: returns "promoted", "demoted",
+        or "hold". Called per request by the serving app (cheap) or on a
+        timer by embedders."""
+        with self._lock:
+            canary = self._canary
+            baseline = dict(self._baseline)
+        if canary is None:
+            return "hold"
+        if telem_counters.get("watchdog_fires") > \
+                baseline.get("watchdog_fires", 0):
+            self.demote("watchdog_fire", missing_ok=True)
+            return "demoted"
+        now = self._counters_for(canary)
+        requests = now["requests"] - baseline["requests"]
+        errors = now["errors"] - baseline["errors"]
+        if errors >= self.demote_errors:
+            self.demote(f"error_spike ({int(errors)} errors in "
+                        f"{int(requests)} requests)", missing_ok=True)
+            return "demoted"
+        if requests < self.min_requests:
+            return "hold"
+        if requests > 0 and errors / requests > self.max_error_rate:
+            self.demote(f"error_rate {errors / requests:.3f}",
+                        missing_ok=True)
+            return "demoted"
+        stable_p99 = self._p99_ms(self.stable) if self.stable else 0.0
+        canary_p99 = self._p99_ms(canary)
+        if stable_p99 > 0 and canary_p99 > self.p99_ratio * stable_p99:
+            self.demote(f"p99 {canary_p99:.1f}ms > {self.p99_ratio:g}x "
+                        f"stable {stable_p99:.1f}ms", missing_ok=True)
+            return "demoted"
+        self.promote(missing_ok=True)
+        return "promoted"
+
+    # -- transitions -----------------------------------------------------
+    def promote(self, missing_ok: bool = False) -> None:
+        """Canary becomes stable; the old stable is unpinned (it stays
+        loaded in the registry for instant rollback until unload).
+        `missing_ok` is the auto-transition path: concurrent evaluate()
+        calls may race to the same verdict, and the loser finds the slot
+        already empty — a no-op, not an error."""
+        with self._lock:
+            canary, old_stable = self._canary, self._stable
+            if canary is None:
+                if missing_ok:
+                    return
+                raise RuntimeError("no canary to promote")
+            self._stable, self._canary = canary, None
+            self._weight, self._shadow = 0.0, False
+            self._record_locked("promote", canary, old=old_stable)
+        if old_stable and old_stable != canary:
+            self.registry.unpin_version(old_stable)
+        telem_counters.incr("router_promotions")
+        telem_counters.set_gauge("router_canary_weight", 0.0)
+        telem_events.emit("router_promote", version=canary,
+                          previous=old_stable)
+        log.info("router: promoted %s (was %s)", canary, old_stable)
+
+    def demote(self, reason: str = "manual",
+               missing_ok: bool = False) -> None:
+        """Cut the canary: all traffic back to stable, pin released."""
+        with self._lock:
+            canary = self._canary
+            if canary is None:
+                if missing_ok:
+                    return
+                raise RuntimeError("no canary to demote")
+            self._canary = None
+            self._weight, self._shadow = 0.0, False
+            self._record_locked("demote", canary, reason=reason)
+        self.registry.unpin_version(canary)
+        telem_counters.incr("router_demotions")
+        telem_counters.set_gauge("router_canary_weight", 0.0)
+        telem_events.emit("router_demote", version=canary, reason=reason)
+        log.warning("router: demoted %s (%s)", canary, reason)
+
+    def _record_locked(self, action: str, version: str, **detail) -> None:
+        self.history.append({"action": action, "version": version,
+                             "t": time.time(), **detail})
+
+    # -- introspection ---------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            state = (RouterState.SHADOW if self._shadow and self._canary
+                     else RouterState.CANARY if self._canary
+                     else RouterState.STABLE_ONLY)
+            return {"state": state, "stable": self._stable,
+                    "canary": self._canary, "weight": self._weight,
+                    "shadow": self._shadow, "routed": self._route_n,
+                    "canary_routed": self._canary_routed,
+                    "min_requests": self.min_requests,
+                    "max_error_rate": self.max_error_rate,
+                    "p99_ratio": self.p99_ratio,
+                    "history": list(self.history[-20:])}

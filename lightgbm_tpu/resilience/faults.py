@@ -30,6 +30,11 @@ Fault spec grammar (env ``LGBM_TPU_FAULT_SPEC`` or ``faults.install``):
                                     verb behind the two-process kill
                                     harness (install the spec only in
                                     the victim rank's environment)
+    fail_request@version=v2,n=5     fail the first 5 serving batches
+                                    answered by model version v2 (omit
+                                    version= to hit all versions; p=
+                                    for probabilistic) — the router-
+                                    chaos verb driving canary demotion
     delay_ms=50                     sleep 50 ms at every fault site
                                     (collectives + serving flush)
     seed=123                        RNG seed for probabilistic clauses
@@ -57,11 +62,12 @@ from ..utils import log
 
 __all__ = ["TransientCollectiveError", "CollectiveTimeout", "FaultPlan",
            "install", "clear", "active_plan", "run_collective",
-           "sleep_point", "kill_point", "jittered_delay",
+           "sleep_point", "kill_point", "request_point", "jittered_delay",
            "set_collective_timeout_ms", "collective_timeout_ms"]
 
 _GLOBAL_KNOBS = ("seed", "delay_ms")
-_KNOWN = ("nan_grad", "inf_grad", "fail_collective", "kill_rank")
+_KNOWN = ("nan_grad", "inf_grad", "fail_collective", "kill_rank",
+          "fail_request")
 
 
 class TransientCollectiveError(RuntimeError):
@@ -139,6 +145,7 @@ class FaultPlan:
         self.seed = spec_seed if seed is None else int(seed)
         self.rng = np.random.RandomState(self.seed % (2 ** 31 - 1))
         self.collective_calls = 0
+        self._request_fail_counts: Dict[int, int] = {}
         self.events: List[str] = []     # fired faults, for tests/forensics
 
     @property
@@ -215,6 +222,31 @@ class FaultPlan:
             self.events.append(f"delay@{site}")
             time.sleep(self.delay_ms / 1e3)
 
+    def before_request(self, version: str) -> None:
+        """Called by the serving batcher before executing a batch for
+        `version`: may raise to fail every request in that batch — the
+        deterministic error spike the canary demotion gate watches for."""
+        for idx, c in enumerate(self.clauses):
+            if c.name != "fail_request":
+                continue
+            want = c.args.get("version")
+            if want and want != str(version):
+                continue
+            if "n" in c.args:
+                fired = self._request_fail_counts.get(idx, 0)
+                if fired >= int(c.args["n"]):
+                    continue
+                self._request_fail_counts[idx] = fired + 1
+            elif "p" in c.args:
+                if self.rng.rand() >= float(c.args["p"]):
+                    continue
+            # bare fail_request@version=v: fail every matching batch
+            self.events.append(f"fail_request@{version}")
+            telem_events.emit("fault", fault="fail_request",
+                              version=str(version))
+            raise RuntimeError(
+                f"injected request failure for version {version}")
+
     # -- process-death boundary -----------------------------------------
     def kill_code(self, iteration: int) -> Optional[int]:
         """Exit code to die with at this boosting iteration, or None.
@@ -269,6 +301,14 @@ def sleep_point(site: str) -> None:
     plan = active_plan()
     if plan is not None:
         plan.maybe_delay(site)
+
+
+def request_point(version: str) -> None:
+    """Request-failure fault site (`fail_request@` clauses); the serving
+    batcher calls this with the resolved model version per flush."""
+    plan = active_plan()
+    if plan is not None:
+        plan.before_request(version)
 
 
 def kill_point(iteration: int) -> None:

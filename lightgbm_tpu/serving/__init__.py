@@ -2,11 +2,18 @@
 
 Layers (each usable on its own):
 
-* `registry` — versioned, hot-swappable PreparedModels with warm-up
-* `predictor` — AOT-compiled, shape-bucketed predictor cache
+* `registry` — versioned, hot-swappable PreparedModels with warm-up,
+  optional persistent export cache + device placement (fleet hooks)
+* `predictor` — AOT-compiled, shape-bucketed predictor cache with
+  LRU eviction, router pins, and donated/staged batch buffers
 * `batcher` — micro-batching scheduler with admission control
-* `server` — in-process API + stdlib JSON-over-HTTP front end
+* `server` — in-process API + stdlib JSON-over-HTTP front end, with
+  the fleet canary router on the un-versioned request path
 * `stats` — request counters and latency histograms
+
+The fleet control plane (persistent compiled-predictor cache,
+multi-model placement, canary/shadow router) lives in
+`lightgbm_tpu.fleet` and plugs in through ModelRegistry/ServingApp.
 
 Quick start::
 

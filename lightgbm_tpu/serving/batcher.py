@@ -222,6 +222,9 @@ class MicroBatcher:
              else np.concatenate([i.x for i in live], axis=0))
         try:
             t0 = time.monotonic()
+            # fault site: fail_request@version= clauses raise here — the
+            # injected per-version error spike the canary router demotes on
+            faults.request_point(version)
             model = self.registry.get(version)
             out = self.registry.predictor.predict(model, x, raw_score)
             self.stats.observe("serve_batch_exec", time.monotonic() - t0)

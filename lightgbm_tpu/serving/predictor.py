@@ -15,12 +15,36 @@ Two properties fall out of the key design:
   hot-swap to a retrained model of the same padded shape (the common
   periodic-retrain case) reuses every compiled executable and serves its
   first request with zero compile stalls.
+
+Fleet extensions (PR 11):
+
+* **LRU eviction with router pins** — `max_entries` bounds the
+  executable count under multi-model load; eviction walks least-recently
+  -used first but NEVER drops an executable whose ensemble shape
+  signature is pinned (`pin`/`unpin`, driven by the canary router and
+  the placement plan through `ModelRegistry.pin_version`).
+* **Donated device batch buffers** — on backends that support input
+  aliasing (donation is a no-op-with-warning on CPU) the batch operand
+  is donated so XLA reuses its memory for the output instead of
+  allocating per flush (`donate="auto"`).
+* **Staging buffer pool** — padding a request up to its bucket reuses a
+  pooled host buffer instead of allocating + concatenating per call,
+  cutting two allocations out of the flush latency path
+  (`LGBM_TPU_SERVE_NO_STAGING=1` restores the old path for A/B).
+* **Placement-aware keys** — a PreparedModel pinned to a mesh device
+  carries that device in its executable family, so two versions placed
+  on different devices never collide in the cache.
+* **install()/entries()** — the persistent export cache
+  (fleet/export_cache.py) enumerates warm executables for serialization
+  and installs deserialized ones without counting a compile.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,21 +58,41 @@ from ..utils import log
 from ..utils.timer import timer
 
 
+def _device_key(device) -> str:
+    """Stable string identity of a placement device ('' = default)."""
+    if device is None:
+        return ""
+    return f"{getattr(device, 'platform', 'dev')}:{getattr(device, 'id', 0)}"
+
+
 class PreparedModel:
     """A Booster/GBDT tensorized once for serving.
 
     Holds the bucketed EnsembleArrays on device plus everything the
     compiled scoring function needs as static context. Immutable after
-    construction — hot swaps publish a new PreparedModel.
+    construction — hot swaps publish a new PreparedModel. An optional
+    `device` pins every tensor (and, through the executable family key,
+    every compiled program) to one mesh device — the placement unit of
+    fleet/placement.py.
     """
 
     def __init__(self, gbdt, version: str,
-                 num_iteration: Optional[int] = None):
+                 num_iteration: Optional[int] = None, device=None):
         arrays, tree_class, n_models = gbdt.ensemble_arrays(
             num_iteration, 0, bucket=True)
         if not n_models:
             raise ValueError("cannot serve a model with no trees")
         self.version = version
+        self.device = device
+        self.device_key = _device_key(device)
+        if device is not None:
+            # per-field put: the NamedTuple carries a plain-int max_depth
+            # that a pytree-wide device_put would wrongly tensorize
+            arrays = arrays._replace(**{
+                f: jax.device_put(getattr(arrays, f), device)
+                for f in arrays._fields
+                if hasattr(getattr(arrays, f), "shape")})
+            tree_class = jax.device_put(tree_class, device)
         self.arrays = arrays
         self.tree_class = tree_class
         self.n_trees = n_models
@@ -58,7 +102,8 @@ class PreparedModel:
         self.objective = gbdt.objective
         denom = (max(1, n_models // max(gbdt.num_tree_per_iteration, 1))
                  if gbdt.average_output else 1)
-        self.denom = jnp.float32(denom)
+        self.denom = (jax.device_put(jnp.float32(denom), device)
+                      if device is not None else jnp.float32(denom))
         # identifies the output transform for executable sharing: two
         # models convert identically iff the objective serializes the same
         self.convert_key = (gbdt.objective.to_string()
@@ -69,28 +114,49 @@ class PreparedModel:
 
     @classmethod
     def from_booster(cls, booster, version: str,
-                     num_iteration: Optional[int] = None) -> "PreparedModel":
+                     num_iteration: Optional[int] = None,
+                     device=None) -> "PreparedModel":
         gbdt = getattr(booster, "_gbdt", booster)
-        return cls(gbdt, version, num_iteration)
+        return cls(gbdt, version, num_iteration, device=device)
+
+
+def resolve_donate(donate="auto") -> bool:
+    """'auto' donates the batch operand wherever XLA can actually alias
+    it (every accelerator backend); CPU ignores donation and warns, so
+    auto stays off there."""
+    if donate == "auto":
+        return jax.default_backend() != "cpu"
+    return bool(donate)
 
 
 class PredictorCache:
-    """(shape signature, batch bucket, raw_score) -> AOT-compiled executable.
+    """(shape signature, batch bucket, raw_score, device) -> AOT-compiled
+    executable, LRU-bounded with pin protection.
 
     `compile_count` is the ground-truth XLA compile counter the
     no-recompile tests assert on: every lowering/compile in the serving
-    hot path goes through `_compile` below.
+    hot path goes through `_compile` below — executables restored from
+    the persistent export cache arrive via `install()` and count as
+    neither compiles nor misses.
     """
 
-    def __init__(self, max_batch_rows: int = 4096):
+    def __init__(self, max_batch_rows: int = 4096,
+                 max_entries: Optional[int] = None, donate="auto"):
         self.max_batch_rows = max_batch_rows
-        self._exec: Dict[Tuple, object] = {}
+        self.max_entries = (int(max_entries) if max_entries else None)
+        self.donate_input = resolve_donate(donate)
+        self._exec: "OrderedDict[Tuple, object]" = OrderedDict()
         # family key (everything but the bucket) -> sorted compiled
         # buckets: lets a small request ride an already-warm larger
         # bucket instead of paying a compile for its exact power of two
         self._buckets: Dict[Tuple, list] = {}
+        self._pinned_sigs: set = set()
         self._lock = threading.Lock()
+        self._staging: Dict[Tuple[int, int], list] = {}
+        self._staging_off = bool(os.environ.get("LGBM_TPU_SERVE_NO_STAGING"))
         self.compile_count = 0
+        self.install_count = 0
+        self.evictions = 0
         self.hits = 0
         self.misses = 0
 
@@ -109,11 +175,14 @@ class PredictorCache:
             return out
         return fn
 
-    def _family(self, model: PreparedModel, n_features: int,
-                raw_score: bool) -> Tuple:
+    def family(self, model: PreparedModel, n_features: int,
+               raw_score: bool) -> Tuple:
         return (model.shape_sig, n_features, model.max_depth,
                 model.num_class, bool(raw_score),
-                "" if raw_score else model.convert_key)
+                "" if raw_score else model.convert_key,
+                model.device_key)
+
+    _family = family          # internal alias kept for older callers
 
     def _pick_bucket(self, family: Tuple, n: int) -> int:
         """Smallest already-compiled bucket that fits n rows, else n's own
@@ -124,9 +193,55 @@ class PredictorCache:
                     return b
         return _bucket_up(n)
 
+    # -- pinning / eviction ---------------------------------------------
+    def pin(self, shape_sig) -> None:
+        """Protect every executable of this ensemble shape signature from
+        LRU eviction (the router pins its stable + canary versions)."""
+        with self._lock:
+            self._pinned_sigs.add(shape_sig)
+
+    def unpin(self, shape_sig) -> None:
+        with self._lock:
+            self._pinned_sigs.discard(shape_sig)
+
+    def pinned(self) -> set:
+        with self._lock:
+            return set(self._pinned_sigs)
+
+    def _evict_locked(self) -> None:
+        """Drop least-recently-used unpinned executables until the cache
+        fits max_entries (caller holds the lock). Pinned families are
+        never dropped, even if that leaves the cache over budget — a
+        routed version must stay servable without a compile stall."""
+        if self.max_entries is None:
+            return
+        while len(self._exec) > self.max_entries:
+            victim = None
+            for key in self._exec:          # OrderedDict: LRU first
+                if key[0][0] not in self._pinned_sigs:
+                    victim = key
+                    break
+            if victim is None:
+                log.warning(
+                    "serving: predictor cache over budget (%d > %d) but "
+                    "every entry is pinned; not evicting",
+                    len(self._exec), self.max_entries)
+                return
+            del self._exec[victim]
+            fam, bucket = victim[0], victim[1][-1]
+            if bucket in self._buckets.get(fam, ()):
+                self._buckets[fam].remove(bucket)
+            self.evictions += 1
+            telem_counters.incr("serve_cache_evictions")
+
+    # -- compile / install ----------------------------------------------
+    @staticmethod
+    def _key(family: Tuple, bucket: int) -> Tuple:
+        return (family, (bucket,))
+
     def _compile(self, family, bucket, model: PreparedModel,
                  x_dev, raw_score: bool) -> object:
-        key = family + (bucket,)
+        key = self._key(family, bucket)
         with self._lock:
             compiled = self._exec.get(key)
             if compiled is not None:
@@ -135,7 +250,8 @@ class PredictorCache:
             with timer("serve_compile"), \
                     telem_spans.span("serve_compile", bucket=bucket):
                 fn = self._make_fn(model, raw_score)
-                compiled = jax.jit(fn).lower(
+                donate = (0,) if self.donate_input else ()
+                compiled = jax.jit(fn, donate_argnums=donate).lower(
                     x_dev, model.arrays, model.tree_class,
                     model.denom).compile()
             # compiles are rare and expensive: count unconditionally so
@@ -147,8 +263,60 @@ class PredictorCache:
             self._buckets.setdefault(family, []).append(bucket)
             self._buckets[family].sort()
             self.compile_count += 1
+            self._evict_locked()
             log.debug("serving: compiled predictor bucket=%d", bucket)
             return compiled
+
+    def install(self, family: Tuple, bucket: int, compiled) -> None:
+        """Register an executable that did NOT come from `_compile` —
+        deserialized from the persistent export cache. Counts neither a
+        compile nor a miss; the zero-compile restart property rests on
+        this seam."""
+        key = self._key(family, int(bucket))
+        with self._lock:
+            if key in self._exec:
+                return
+            self._exec[key] = compiled
+            if bucket not in self._buckets.setdefault(family, []):
+                self._buckets[family].append(int(bucket))
+                self._buckets[family].sort()
+            self.install_count += 1
+            self._evict_locked()
+
+    def entries(self) -> List[Tuple[Tuple, int, object]]:
+        """Snapshot of (family, bucket, executable) — the export cache's
+        serialization feed."""
+        with self._lock:
+            return [(key[0], key[1][-1], compiled)
+                    for key, compiled in self._exec.items()]
+
+    # -- staging ---------------------------------------------------------
+    def _stage(self, x: np.ndarray, bucket: int):
+        """Pad x up to `bucket` rows. Returns (padded array, pool token);
+        the token goes back to the pool after the device copy so the
+        buffer is reused by the next flush instead of reallocated."""
+        if self._staging_off:
+            return np.concatenate(
+                [x, np.zeros((bucket - x.shape[0], x.shape[1]),
+                             dtype=x.dtype)], axis=0), None
+        pkey = (bucket, x.shape[1])
+        with self._lock:
+            pool = self._staging.setdefault(pkey, [])
+            buf = pool.pop() if pool else None
+        if buf is None:
+            buf = np.empty((bucket, x.shape[1]), dtype=np.float32)
+        n = x.shape[0]
+        buf[:n] = x
+        buf[n:] = 0.0
+        return buf, pkey
+
+    def _unstage(self, buf, pkey) -> None:
+        if pkey is None:
+            return
+        with self._lock:
+            pool = self._staging.setdefault(pkey, [])
+            if len(pool) < 4:       # bound the pool per shape
+                pool.append(buf)
 
     # ------------------------------------------------------------------
     def predict(self, model: PreparedModel, x: np.ndarray,
@@ -170,16 +338,23 @@ class PredictorCache:
                                   raw_score)
                      for i in range(0, n, self.max_batch_rows)]
             return np.concatenate(parts, axis=0)
-        family = self._family(model, x.shape[1], raw_score)
+        family = self.family(model, x.shape[1], raw_score)
         bucket = self._pick_bucket(family, n)
+        token = None
         if bucket != n:
-            x = np.concatenate(
-                [x, np.zeros((bucket - n, x.shape[1]), dtype=x.dtype)],
-                axis=0)
+            x, token = self._stage(x, bucket)
         if telem_counters.is_active():
             telem_counters.incr("transfer_h2d_bytes", x.nbytes)
-        x_dev = jnp.asarray(x)
-        compiled = self._exec.get(family + (bucket,))
+        x_dev = (jax.device_put(x, model.device)
+                 if model.device is not None else jnp.asarray(x))
+        if token is not None:
+            jax.block_until_ready(x_dev)      # host buffer copied out
+            self._unstage(x, token)
+        key = self._key(family, bucket)
+        with self._lock:
+            compiled = self._exec.get(key)
+            if compiled is not None:
+                self._exec.move_to_end(key)   # LRU touch
         if compiled is None:
             self.misses += 1
             compiled = self._compile(family, bucket, model, x_dev, raw_score)
@@ -206,4 +381,9 @@ class PredictorCache:
         with self._lock:
             return {"entries": len(self._exec),
                     "compiles": self.compile_count,
+                    "installs": self.install_count,
+                    "evictions": self.evictions,
+                    "pinned_sigs": len(self._pinned_sigs),
+                    "max_entries": self.max_entries or 0,
+                    "donate": int(self.donate_input),
                     "hits": self.hits, "misses": self.misses}

@@ -30,13 +30,21 @@ class ModelRegistry:
 
     def __init__(self, predictor: Optional[PredictorCache] = None,
                  warm_buckets: Sequence[int] = DEFAULT_WARM_BUCKETS,
-                 warm_raw_score: Sequence[bool] = (False,)):
+                 warm_raw_score: Sequence[bool] = (False,),
+                 export_cache=None, placement=None):
         self.predictor = predictor or PredictorCache()
         self.warm_buckets = tuple(warm_buckets)
         self.warm_raw_score = tuple(warm_raw_score)
+        # fleet hooks: a fleet.ExportCache persists warm executables
+        # across process restarts; a fleet.PlacementPlan pins versions
+        # to distinct devices. Both optional — None keeps the
+        # single-model single-device behavior.
+        self.export_cache = export_cache
+        self.placement = placement
         self._lock = threading.RLock()
         self._models: Dict[str, PreparedModel] = {}
         self._latest: Optional[str] = None
+        self._pinned_versions: Dict[str, tuple] = {}
         self._version_counter = itertools.count(1)
 
     # ------------------------------------------------------------------
@@ -61,7 +69,18 @@ class ModelRegistry:
         from ..telemetry import events as telem_events
         with timer("serve_model_load"):
             t0 = time.monotonic()
-            prepared = PreparedModel(gbdt, ver, num_iteration)
+            device = (self.placement.assign(ver)
+                      if self.placement is not None else None)
+            prepared = PreparedModel(gbdt, ver, num_iteration,
+                                     device=device)
+            restored = {}
+            if self.export_cache is not None:
+                # restore serialized executables BEFORE warm-up: a full
+                # restore turns the warm loop below into pure cache hits
+                # (zero compiles) — the fleet restart property
+                restored = self.export_cache.restore(
+                    prepared, self.predictor, self.warm_buckets,
+                    self.warm_raw_score)
             if warm:
                 for raw in self.warm_raw_score:
                     for b in self.warm_buckets:
@@ -69,7 +88,10 @@ class ModelRegistry:
                 telem_events.emit(
                     "serve_warmup", version=ver,
                     buckets=list(self.warm_buckets),
+                    restored=restored.get("restored", 0),
                     warm_s=round(time.monotonic() - t0, 6))
+            if self.export_cache is not None:
+                self.export_cache.save(prepared, self.predictor)
         with self._lock:
             previous = self._latest
             self._models[ver] = prepared
@@ -111,11 +133,40 @@ class ModelRegistry:
             del self._models[version]
             if self._latest == version:
                 self._latest = (max(self._models) if self._models else None)
+        self.unpin_version(version)
+        if self.placement is not None:
+            self.placement.release(version)
+
+    # -- eviction pins (fleet router) -----------------------------------
+    def pin_version(self, version: str) -> None:
+        """Protect a routed version's executables from LRU eviction. Pins
+        are refcounted by shape signature: two same-shape versions (the
+        periodic-retrain case) share executables, so the signature stays
+        pinned until the LAST pinned version releases it."""
+        model = self.get(version)
+        with self._lock:
+            self._pinned_versions[version] = model.shape_sig
+        self.predictor.pin(model.shape_sig)
+
+    def unpin_version(self, version: str) -> None:
+        with self._lock:
+            sig = self._pinned_versions.pop(version, None)
+            if sig is None:
+                return
+            still_pinned = sig in self._pinned_versions.values()
+        if not still_pinned:
+            self.predictor.unpin(sig)
+
+    def pinned_versions(self) -> List[str]:
+        with self._lock:
+            return sorted(self._pinned_versions)
 
     def versions(self) -> List[dict]:
         with self._lock:
             return [{"version": v,
                      "latest": v == self._latest,
+                     "pinned": v in self._pinned_versions,
+                     "device": m.device_key or None,
                      "num_trees": m.n_trees,
                      "num_features": m.num_features,
                      "num_class": m.num_class}

@@ -20,6 +20,11 @@ Endpoints:
 * ``GET  /healthz``  registry + batcher liveness: 200 with
   ``status=ok`` when routable, 503 with ``status=draining``/
   ``degraded`` during graceful shutdown or after a dead batcher worker
+* ``GET  /router``   canary router state (stable/canary/weight/history)
+* ``POST /router``   {"action": "stable"|"deploy"|"promote"|"demote"
+  [, "version", "weight", "shadow"]} — drive the canary state machine
+* ``POST /drain``    graceful drain for rolling restarts: stop
+  admitting, flush the queue, reply with the final health snapshot
 """
 from __future__ import annotations
 
@@ -29,6 +34,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..fleet.router import CanaryRouter
 from ..utils import log
 from .batcher import MicroBatcher, OverloadedError, RequestTimeout
 from .registry import ModelNotFound, ModelRegistry
@@ -40,43 +46,83 @@ class BadRequest(ValueError):
 
 
 class ServingApp:
-    """Transport-agnostic serving facade: registry + batcher + stats."""
+    """Transport-agnostic serving facade: registry + batcher + stats +
+    canary router. The router is idle (pass-through to `latest`) until a
+    stable version is installed via `POST /router {"action":
+    "stable"}` or `app.router.set_stable`."""
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  batcher: Optional[MicroBatcher] = None,
                  stats: Optional[ServingStats] = None,
+                 router: Optional[CanaryRouter] = None,
                  **batcher_kwargs):
         self.registry = registry or ModelRegistry()
         self.stats = stats or ServingStats()
         self.batcher = batcher or MicroBatcher(
             self.registry, stats=self.stats, **batcher_kwargs)
+        self.router = router or CanaryRouter(self.registry, self.stats)
 
     # ------------------------------------------------------------------
     def predict(self, payload: dict) -> dict:
         rows = payload.get("rows")
         if rows is None:
             raise BadRequest("missing 'rows'")
+        raw_score = bool(payload.get("raw_score", False))
+        version = payload.get("version")
+        # an explicit version tag bypasses the router (debugging, shadow
+        # replay); everything else is routed stable/canary per weight
+        routed = version is None and self.router.active
+        if routed:
+            version = self.router.route()
         t0 = time.monotonic()
         try:
-            out, version = self.batcher.submit(
-                rows,
-                version=payload.get("version"),
-                raw_score=bool(payload.get("raw_score", False)),
+            out, version_used = self.batcher.submit(
+                rows, version=version, raw_score=raw_score,
                 timeout_ms=payload.get("timeout_ms"))
         except Exception:
             # error series keyed by the *requested* tag — no answer
             # resolved one, and "which version is erroring" is exactly
             # the canary question these labels exist to answer
-            requested = payload.get("version") or self.registry.latest \
-                or "latest"
+            requested = version or self.registry.latest or "latest"
             self.stats.observe_version(requested, error=True)
+            if routed:
+                # errors drive the demotion gate — evaluate before the
+                # error propagates so a bleeding canary is cut promptly
+                self.router.evaluate()
             raise
         dt = time.monotonic() - t0
         self.stats.observe("serve_request", dt)
-        self.stats.observe_version(version, dt)
+        self.stats.observe_version(version_used, dt)
+        if routed:
+            shadow = self.router.shadow_target()
+            if shadow is not None:
+                self._mirror(rows, shadow, raw_score)
+            self.router.evaluate()
         preds = (out[:, 0] if out.ndim == 2 and out.shape[1] == 1 else out)
-        return {"predictions": preds.tolist(), "version": version,
+        return {"predictions": preds.tolist(), "version": version_used,
                 "num_rows": int(out.shape[0])}
+
+    def _mirror(self, rows, version: str, raw_score: bool) -> None:
+        """Shadow traffic: replay the request against `version` off the
+        response path. The caller never waits; results are discarded but
+        the canary's per-version counters accumulate, which is the whole
+        point — measurement without user exposure."""
+        self.stats.incr("serve_shadow_mirrored")
+
+        def _run():
+            t0 = time.monotonic()
+            try:
+                _, ver = self.batcher.submit(rows, version=version,
+                                             raw_score=raw_score)
+                self.stats.observe_version(ver, time.monotonic() - t0)
+            except Exception as exc:   # noqa: BLE001 — shadow never throws
+                self.stats.observe_version(version, error=True)
+                log.debug("serving: shadow mirror to %s failed: %s",
+                          version, exc)
+            self.router.evaluate()
+
+        threading.Thread(target=_run, daemon=True,
+                         name="lgbm-tpu-shadow").start()
 
     def load_model(self, payload: dict) -> dict:
         if "model_file" in payload:
@@ -97,7 +143,35 @@ class ServingApp:
         snap = self.stats.snapshot()
         snap["predictor_cache"] = self.registry.predictor.cache_info()
         snap["models"] = self.registry.versions()
+        snap["router"] = self.router.snapshot()
+        if self.registry.export_cache is not None:
+            snap["export_cache"] = self.registry.export_cache.info()
         return snap
+
+    # -- fleet control ---------------------------------------------------
+    def router_action(self, payload: dict) -> dict:
+        """POST /router — the canary state machine's control surface:
+        {"action": "stable"|"deploy"|"promote"|"demote", ...}."""
+        action = payload.get("action")
+        if action == "stable":
+            version = payload.get("version") or self.registry.latest
+            if version is None:
+                raise BadRequest("no version to make stable")
+            self.router.set_stable(version)
+        elif action == "deploy":
+            version = payload.get("version")
+            if not version:
+                raise BadRequest("deploy needs 'version'")
+            self.router.deploy(version,
+                               weight=float(payload.get("weight", 0.10)),
+                               shadow=bool(payload.get("shadow", False)))
+        elif action == "promote":
+            self.router.promote()
+        elif action == "demote":
+            self.router.demote(payload.get("reason", "manual"))
+        else:
+            raise BadRequest(f"unknown router action {action!r}")
+        return self.router.snapshot()
 
     def metrics_text(self) -> str:
         """Prometheus text format: serving counters/latency + process
@@ -196,6 +270,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(500, {"error": str(exc)})
         elif self.path == "/models":
             self._dispatch(self.app.models)
+        elif self.path == "/router":
+            self._dispatch(lambda: self.app.router.snapshot())
         elif self.path in ("/healthz", "/health"):
             # non-ok health is a 503 so load balancers stop routing
             # while drain/degradation is in progress
@@ -213,6 +289,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._dispatch(lambda: self.app.predict(self._payload()))
         elif self.path == "/models":
             self._dispatch(lambda: self.app.load_model(self._payload()))
+        elif self.path == "/router":
+            self._dispatch(lambda: self.app.router_action(self._payload()))
+        elif self.path == "/drain":
+            # rollout tooling: stop admitting, flush in-flight work,
+            # answer when the queue is empty — the caller then restarts
+            # this process knowing zero requests were dropped
+            def _drain():
+                payload = self._payload()
+                self.app.drain(float(payload.get("timeout_s", 5.0)))
+                return self.app.health()
+            self._dispatch(_drain)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 

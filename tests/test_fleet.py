@@ -1,0 +1,515 @@
+"""Fleet control-plane tests: persistent export cache (zero-compile
+restart), LRU pins, multi-model placement, canary router state machine,
+hot swap under routed traffic, and the rollout tooling's HTTP contract."""
+import json
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_binary
+from lightgbm_tpu.fleet import (CanaryRouter, ExportCache, PlacementPlan,
+                                cache_dir_for_model)
+from lightgbm_tpu.fleet.export_cache import env_fingerprint
+from lightgbm_tpu.fleet.placement import parse_placement_spec
+from lightgbm_tpu.resilience import faults
+from lightgbm_tpu.serving import ModelNotFound, ModelRegistry, ServingApp
+from lightgbm_tpu.serving.predictor import PredictorCache, PreparedModel
+from lightgbm_tpu.serving.stats import ServingStats
+from lightgbm_tpu.telemetry import counters as telem_counters
+from lightgbm_tpu.telemetry.counters import compile_events
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_COMPILE_EVENTS = compile_events()
+
+
+def _train(num_boost_round=6, seed=7, n=400, num_leaves=15):
+    x, y = make_binary(n=n, f=10, seed=seed)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": num_leaves, "verbosity": -1},
+        lgb.Dataset(x, y, free_raw_data=False),
+        num_boost_round=num_boost_round, verbose_eval=False)
+    return bst, x
+
+
+@pytest.fixture(scope="module")
+def booster():
+    return _train()
+
+
+# ---------------------------------------------------------------------------
+# export cache: the zero-compile restart property
+
+def test_export_cache_restart_zero_compiles(booster, tmp_path):
+    """Acceptance: a registry pointed at a populated export cache loads
+    and serves with ZERO XLA compilations — the ground-truth
+    compile_events listener records nothing across load + first
+    predict."""
+    bst, x = booster
+    cache = ExportCache(str(tmp_path / "xc"))
+    reg_a = ModelRegistry(warm_buckets=(1, 8), export_cache=cache)
+    reg_a.load(bst)
+    assert cache.info()["entries"] == 2
+    assert cache.last_restore == {"restored": 0, "rebuilt": 0, "missed": 2}
+
+    # "restart": a fresh predictor cache + fresh registry, same disk dir
+    hits_before = telem_counters.get("export_cache_hits")
+    events_before = len(_COMPILE_EVENTS)
+    reg_b = ModelRegistry(predictor=PredictorCache(), warm_buckets=(1, 8),
+                          export_cache=cache)
+    ver = reg_b.load(bst)
+    out = reg_b.predictor.predict(reg_b.get(ver), x[:5])
+    assert len(_COMPILE_EVENTS) == events_before, (
+        f"unexpected XLA activity: {_COMPILE_EVENTS[events_before:]}")
+    assert reg_b.predictor.compile_count == 0
+    assert reg_b.predictor.install_count == 2
+    assert cache.last_restore == {"restored": 2, "rebuilt": 0, "missed": 0}
+    assert telem_counters.get("export_cache_hits") == hits_before + 2
+    np.testing.assert_allclose(out[:, 0], bst.predict(x[:5]), atol=1e-6)
+
+
+def test_export_cache_env_mismatch_rebuilds_from_stablehlo(
+        booster, tmp_path, monkeypatch):
+    """The portable layer: a fingerprint mismatch (jaxlib upgrade, CPU
+    runtime change) skips the native executable and rebuilds from the
+    serialized StableHLO — one backend compile, no Python retrace, and
+    still zero `_compile` misses in the predictor."""
+    bst, x = booster
+    from lightgbm_tpu.fleet import export_cache as xc_mod
+    cache = ExportCache(str(tmp_path / "xc"))
+    pred_a = PredictorCache()
+    model = PreparedModel.from_booster(bst, "v1")
+    pred_a.warm(model, 8)
+    assert cache.save(model, pred_a) == 1
+
+    real_env = env_fingerprint(False)
+    monkeypatch.setattr(xc_mod, "env_fingerprint",
+                        lambda donate: dict(real_env, jaxlib="other"))
+    pred_b = PredictorCache()
+    stats = cache.restore(model, pred_b, buckets=(8,))
+    assert stats == {"restored": 0, "rebuilt": 1, "missed": 0}
+    assert pred_b.compile_count == 0 and pred_b.install_count == 1
+    out = pred_b.predict(model, x[:6])
+    np.testing.assert_allclose(out[:, 0], bst.predict(x[:6]), atol=1e-6)
+    assert pred_b.misses == 0            # rebuilt entry served the hit
+
+
+def test_export_cache_corrupt_entry_is_miss(booster, tmp_path):
+    """Torn/garbage entries degrade to misses — the warm loop compiles
+    the ordinary way, never crashes."""
+    bst, x = booster
+    cache = ExportCache(str(tmp_path / "xc"))
+    pred = PredictorCache()
+    model = PreparedModel.from_booster(bst, "v1")
+    pred.warm(model, 8)
+    cache.save(model, pred)
+    (entry,) = [f for f in os.listdir(cache.cache_dir)
+                if f.endswith(".xc")]
+    path = os.path.join(cache.cache_dir, entry)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as fh:         # torn write: half the payload
+        fh.write(blob[:len(blob) // 2])
+    assert cache.restore(model, PredictorCache(), (8,)) == {
+        "restored": 0, "rebuilt": 0, "missed": 1}
+    with open(path, "wb") as fh:         # wrong magic entirely
+        fh.write(b"not a cache entry")
+    assert cache.restore(model, PredictorCache(), (8,))["missed"] == 1
+
+
+def test_export_cache_entry_format_and_conventions(booster, tmp_path):
+    bst, _ = booster
+    assert cache_dir_for_model("/m/model.txt") == "/m/model.txt.xcache"
+    assert parse_placement_spec("a=0, b=3") == {"a": 0, "b": 3}
+    with pytest.raises(ValueError):
+        parse_placement_spec("nonsense")
+    cache = ExportCache(str(tmp_path / "xc"))
+    pred = PredictorCache()
+    model = PreparedModel.from_booster(bst, "v1")
+    pred.warm(model, 4)
+    cache.save(model, pred)
+    (entry,) = os.listdir(cache.cache_dir)
+    with open(os.path.join(cache.cache_dir, entry), "rb") as fh:
+        assert fh.read(11) == b"LGBMTPUXC1\n"
+        (hlen,) = struct.unpack(">I", fh.read(4))
+        header = json.loads(fh.read(hlen))
+    assert header["bucket"] == 4 and header["native_len"] > 0
+    # both layers present: pytree registration must not regress, or the
+    # portable StableHLO layer silently vanishes from every entry
+    assert header["hlo_len"] > 0
+    assert header["env"] == env_fingerprint(pred.donate_input)
+    # deterministic naming: same family + bucket -> same file
+    fam = pred.family(model, model.num_features, False)
+    assert entry == ExportCache.entry_name(fam, 4)
+
+
+# ---------------------------------------------------------------------------
+# LRU eviction + router pins
+
+def test_lru_eviction_never_drops_router_pinned():
+    """Satellite regression: under max_entries pressure from multi-model
+    load, the pinned (routed) version's executable survives and serves
+    with no recompile; the unpinned one is the victim."""
+    bst_a, x = _train(num_boost_round=4, seed=1)
+    bst_b, _ = _train(num_boost_round=8, seed=2)
+    bst_c, _ = _train(num_boost_round=16, seed=3)
+    predictor = PredictorCache(max_entries=2)
+    reg = ModelRegistry(predictor=predictor, warm_buckets=(8,))
+    v1 = reg.load(bst_a)
+    reg.pin_version(v1)
+    v2 = reg.load(bst_b)
+    assert predictor.evictions == 0      # 2 entries, fits
+    reg.load(bst_c)                      # 3rd entry: eviction pressure
+    assert predictor.evictions == 1
+
+    events_before = len(_COMPILE_EVENTS)
+    compiles = predictor.compile_count
+    out = predictor.predict(reg.get(v1), x[:5])   # pinned: still warm
+    assert predictor.compile_count == compiles
+    assert len(_COMPILE_EVENTS) == events_before
+    np.testing.assert_allclose(out[:, 0], bst_a.predict(x[:5]), atol=1e-6)
+    predictor.predict(reg.get(v2), x[:5])         # victim: recompiles
+    assert predictor.compile_count == compiles + 1
+    assert [r["pinned"] for r in reg.versions()] == [True, False, False]
+
+
+def test_lru_all_pinned_stays_over_budget():
+    """When every entry is pinned the cache refuses to evict (over
+    budget beats a compile stall on routed traffic)."""
+    bst_a, _ = _train(num_boost_round=4, seed=1)
+    bst_b, _ = _train(num_boost_round=8, seed=2)
+    predictor = PredictorCache(max_entries=1)
+    reg = ModelRegistry(predictor=predictor, warm_buckets=(8,))
+    va = reg.load(bst_a, warm=False)
+    vb = reg.load(bst_b, warm=False)
+    reg.pin_version(va)                  # pin BEFORE warming: the
+    reg.pin_version(vb)                  # router's deploy order
+    predictor.warm(reg.get(va), 8)
+    predictor.warm(reg.get(vb), 8)
+    assert predictor.cache_info()["entries"] == 2
+    assert predictor.evictions == 0
+
+
+def test_unpin_refcounts_shared_shape_signature():
+    """Two same-shape versions share executables; the signature stays
+    pinned until the LAST routed version releases it."""
+    bst_a, _ = _train(seed=1)
+    bst_b, _ = _train(seed=2)            # same params -> same shape sig
+    reg = ModelRegistry(warm_buckets=(1,))
+    va = reg.load(bst_a)
+    vb = reg.load(bst_b, warm=False)
+    reg.pin_version(va)
+    reg.pin_version(vb)
+    sig = reg.get(va).shape_sig
+    assert sig == reg.get(vb).shape_sig
+    reg.unpin_version(va)
+    assert sig in reg.predictor.pinned()          # vb still holds it
+    reg.unpin_version(vb)
+    assert sig not in reg.predictor.pinned()
+
+
+# ---------------------------------------------------------------------------
+# placement
+
+def test_placement_plan_assignment():
+    devices = ["d0", "d1", "d2", "d3"]
+    plan = PlacementPlan("stable=0,canary=1", devices=devices)
+    assert plan.assign("stable") == "d0"
+    assert plan.assign("canary") == "d1"
+    other = plan.assign("other")          # least-loaded: d2 or d3
+    assert other in ("d2", "d3")
+    assert plan.assign("other") == other  # sticky
+    assert plan.device_for("nope") is None
+    assert plan.snapshot()["stable"] == 0
+    plan.release("other")
+    assert "other" not in plan.snapshot()
+
+
+def test_registry_placement_distinct_devices(booster):
+    """Two versions under an auto placement plan land on different mesh
+    devices, carry them in the executable family (no cache collision),
+    and both serve with parity."""
+    bst, x = booster
+    bst2, _ = _train(seed=11)
+    reg = ModelRegistry(warm_buckets=(4,), placement=PlacementPlan(""))
+    v1, v2 = reg.load(bst), reg.load(bst2)
+    rows = {r["version"]: r for r in reg.versions()}
+    assert rows[v1]["device"] and rows[v2]["device"]
+    assert rows[v1]["device"] != rows[v2]["device"]
+    out1 = reg.predictor.predict(reg.get(v1), x[:5])
+    out2 = reg.predictor.predict(reg.get(v2), x[:5])
+    np.testing.assert_allclose(out1[:, 0], bst.predict(x[:5]), atol=1e-6)
+    np.testing.assert_allclose(out2[:, 0], bst2.predict(x[:5]), atol=1e-6)
+    reg.unload(v2)                        # release frees the slot
+    assert v2 not in reg.placement.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# canary router: state machine units
+
+def _router_stack(min_requests=8, **kw):
+    bst1, x = _train(seed=1)
+    bst2, _ = _train(seed=2)
+    reg = ModelRegistry(warm_buckets=(4,))
+    stats = ServingStats()
+    reg.load(bst1, version="stable")
+    reg.load(bst2, version="canary", warm=False)   # same shape: no compile
+    router = CanaryRouter(reg, stats, min_requests=min_requests, **kw)
+    return router, reg, stats, (bst1, bst2, x)
+
+
+def test_router_validation_and_deterministic_split():
+    router, reg, _, _ = _router_stack()
+    with pytest.raises(RuntimeError):     # no stable yet
+        router.deploy("canary")
+    router.set_stable("stable")
+    with pytest.raises(ValueError):
+        router.deploy("canary", weight=0.0)
+    with pytest.raises(ValueError):
+        router.deploy("canary", weight=1.5)
+    with pytest.raises(ModelNotFound):
+        router.deploy("no-such-version")
+    router.deploy("canary", weight=0.25)
+    with pytest.raises(RuntimeError):     # one canary at a time
+        router.deploy("canary")
+    picks = [router.route() for _ in range(100)]
+    assert picks.count("canary") == 25    # floor-split hits the weight
+    router.demote("test cleanup")
+    assert router.canary is None
+    assert all(router.route() == "stable" for _ in range(10))
+    assert reg.pinned_versions() == ["stable"]
+
+
+def test_router_shadow_mode_and_promote():
+    router, reg, _, _ = _router_stack()
+    router.set_stable("stable")
+    router.deploy("canary", shadow=True)
+    assert router.snapshot()["state"] == "shadow"
+    assert all(router.route() == "stable" for _ in range(20))
+    assert router.shadow_target() == "canary"
+    router.promote()
+    assert router.stable == "canary" and router.canary is None
+    assert router.shadow_target() is None
+    assert reg.pinned_versions() == ["canary"]
+    with pytest.raises(RuntimeError):
+        router.promote()                  # strict: nothing to promote
+    router.promote(missing_ok=True)       # auto path: lost race is a noop
+
+
+def test_router_demote_on_watchdog_fire():
+    router, _, _, _ = _router_stack()
+    router.set_stable("stable")
+    router.deploy("canary", weight=0.5)
+    assert router.evaluate() == "hold"    # healthy, below min_requests
+    telem_counters.incr("watchdog_fires")
+    assert router.evaluate() == "demoted"
+    assert router.canary is None
+    assert router.history[-1]["reason"] == "watchdog_fire"
+
+
+# ---------------------------------------------------------------------------
+# canary loop end to end through the serving app
+
+def test_canary_autopromote_e2e():
+    """Acceptance: deploy at a traffic split, drive requests, watch the
+    per-version counters clear the gate, auto-promote."""
+    router, reg, stats, (bst1, bst2, x) = _router_stack(
+        min_requests=8, p99_ratio=1000.0)
+    app = ServingApp(registry=reg, stats=stats, router=router,
+                     max_batch=8, max_delay_ms=1.0)
+    try:
+        router.set_stable("stable")
+        router.deploy("canary", weight=0.10)   # the 10% deploy
+        served = set()
+        for i in range(120):
+            res = app.predict({"rows": x[i % len(x)][None].tolist()})
+            served.add(res["version"])
+            if router.canary is None:
+                break
+        assert served == {"stable", "canary"}
+        assert router.stable == "canary" and router.canary is None
+        assert router.history[-1]["action"] == "promote"
+        assert telem_counters.get("router_promotions") >= 1
+        # post-promotion traffic is all on the new stable
+        res = app.predict({"rows": x[:2].tolist()})
+        assert res["version"] == "canary"
+        np.testing.assert_allclose(res["predictions"],
+                                   bst2.predict(x[:2]), atol=1e-6)
+    finally:
+        app.close()
+
+
+@pytest.mark.chaos
+def test_canary_demoted_on_injected_error_spike():
+    """Acceptance: a canary that starts failing requests
+    (fail_request@version fault) is cut on the absolute error burst —
+    before min_requests averaging could hide it — and stable keeps
+    serving."""
+    router, reg, stats, (bst1, _, x) = _router_stack(
+        min_requests=1000, demote_errors=3)
+    app = ServingApp(registry=reg, stats=stats, router=router,
+                     max_batch=8, max_delay_ms=1.0)
+    faults.install("fail_request@version=canary,n=10")
+    try:
+        router.set_stable("stable")
+        router.deploy("canary", weight=0.5)
+        errors = 0
+        for i in range(40):
+            try:
+                app.predict({"rows": x[i:i + 1].tolist()})
+            except Exception:
+                errors += 1
+            if router.canary is None:
+                break
+        assert errors >= 3
+        assert router.canary is None and router.stable == "stable"
+        assert router.history[-1]["action"] == "demote"
+        assert "error_spike" in router.history[-1]["reason"]
+        assert telem_counters.get("router_demotions") >= 1
+        # stable unaffected: traffic keeps flowing at zero new errors
+        res = app.predict({"rows": x[:2].tolist()})
+        assert res["version"] == "stable"
+        np.testing.assert_allclose(res["predictions"],
+                                   bst1.predict(x[:2]), atol=1e-6)
+    finally:
+        faults.clear()
+        app.close()
+
+
+def test_hot_swap_under_concurrent_router_traffic():
+    """Satellite: deploy + auto-promote while multiple client threads
+    are in flight. Every response must be internally consistent — all
+    rows scored by the version the response claims, never a mix."""
+    router, reg, stats, (bst1, bst2, x) = _router_stack(
+        min_requests=6, p99_ratio=1000.0)
+    exp = {"stable": bst1.predict(x), "canary": bst2.predict(x)}
+    app = ServingApp(registry=reg, stats=stats, router=router,
+                     max_batch=16, max_delay_ms=2.0)
+    router.set_stable("stable")
+    failures = []
+    lock = threading.Lock()
+
+    def client(ci: int) -> None:
+        for k in range(30):
+            i = (ci * 31 + k * 3) % (len(x) - 3)
+            try:
+                res = app.predict({"rows": x[i:i + 3].tolist(),
+                                   "timeout_ms": 10_000})
+            except Exception as e:       # noqa: BLE001
+                with lock:
+                    failures.append(f"request error: {e}")
+                continue
+            want = exp[res["version"]][i:i + 3]
+            if not np.allclose(res["predictions"], want, atol=1e-6):
+                with lock:
+                    failures.append(
+                        f"mixed-version response: claimed "
+                        f"{res['version']} rows {i}..{i + 3}")
+
+    try:
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)                 # traffic in flight...
+        router.deploy("canary", weight=0.5)   # ...hot swap begins
+        for t in threads:
+            t.join(timeout=30)
+        assert not failures, failures[:5]
+        assert router.stable == "canary"      # promoted mid-traffic
+        assert any(h["action"] == "promote" for h in router.history)
+    finally:
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# rollout tooling over the HTTP surface
+
+def _load_rollout():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "rollout", os.path.join(REPO, "tools", "rollout.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rollout_drain_restart_healthy_cycle(booster):
+    """tools/rollout.py against a live replica: healthy -> drain (503,
+    zero dropped) -> 'restart' -> healthy again, with per-phase
+    timings. The restart here swaps in a fresh app the way a process
+    bounce would."""
+    from lightgbm_tpu.serving.server import make_http_server
+    rollout = _load_rollout()
+    bst, x = booster
+    reg = ModelRegistry(warm_buckets=(4,))
+    reg.load(bst)
+    app = ServingApp(registry=reg, max_batch=8, max_delay_ms=1.0)
+    httpd = make_http_server(app, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    ep = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        assert rollout.healthz(ep)["status"] == "ok"
+        assert rollout.wait_healthy(ep, timeout_s=5) < 5
+        res = rollout._post_json(ep + "/predict",
+                                 {"rows": x[:2].tolist()})
+        assert res["num_rows"] == 2
+
+        restarted = []
+
+        def restart_fn(endpoint):
+            # same registry (the export cache's job in a real bounce),
+            # fresh batcher/app — swapped under the running server
+            httpd.app = ServingApp(registry=reg, max_batch=8,
+                                   max_delay_ms=1.0)
+            restarted.append(endpoint)
+
+        report = rollout.rolling_restart([ep], restart_fn,
+                                         healthy_timeout_s=10)
+        assert restarted == [ep]
+        (step,) = report["steps"]
+        assert step["drained"] == "draining"
+        assert step["queued_at_drain"] == 0
+        assert step["restart_s"] < 10
+        assert rollout.healthz(ep)["status"] == "ok"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        httpd.app.close()
+    assert rollout.healthz("http://127.0.0.1:9")["status"] == "unreachable"
+
+
+# ---------------------------------------------------------------------------
+# true cross-process restart (compile-heavy: two fresh interpreters)
+
+@pytest.mark.slow
+def test_cross_process_restart_serve_bench_cache_hit(tmp_path):
+    """The full fleet restart story through tools/serve_bench.py: run
+    twice against one cache dir in separate processes; the second run
+    must report export_cache_hit=true, zero post-warm compiles, and a
+    materially lower time-to-first-prediction."""
+    env = dict(os.environ, SERVE_BENCH_SECS="0.3", SERVE_BENCH_CLIENTS="2",
+               SERVE_BENCH_TRAIN_ROWS="800", SERVE_BENCH_TREES="3",
+               SERVE_BENCH_CACHE_DIR=str(tmp_path / "xc"))
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "serve_bench.py")],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    cold, warm = run(), run()
+    assert cold["export_cache_hit"] is False
+    assert warm["export_cache_hit"] is True
+    assert warm["export_cache_restore"]["restored"] >= 1
+    assert warm["compiles_after_warm"] == 0
+    assert warm["time_to_first_prediction_s"] < \
+        cold["time_to_first_prediction_s"] / 2

@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""Rolling fleet restart: drain -> restart -> healthy, zero errors.
+
+The fleet restart story has three pieces this tool composes:
+
+* ``POST /drain`` (PR 11) — the replica stops admitting, flushes its
+  queue, and answers with its final health snapshot; nothing in flight
+  is dropped.
+* the persistent export cache (fleet/export_cache.py) — the restarted
+  process restores its compiled predictors from disk, so "healthy"
+  arrives in ~model-load time instead of ~warm-up-compile time.
+* ``GET /healthz`` (PR 10) — the load balancer (here: the traffic
+  loop's failover) knows exactly when to route again.
+
+Library use::
+
+    from tools.rollout import rolling_restart
+    report = rolling_restart(["http://h0:8080", "http://h1:8080"],
+                             restart_fn=my_restarter)
+
+`restart_fn(endpoint)` does whatever "restart" means in the deployment
+(systemctl, kubectl, container bounce); this module only sequences
+drain -> restart -> wait-healthy one replica at a time and times each
+phase.
+
+CLI demo (self-contained, no deps)::
+
+    python tools/rollout.py --demo 2 --secs 6
+
+trains a tiny model, spawns N ``task=serve`` replicas sharing one
+export cache, drives closed-loop traffic with per-request failover
+across replicas, rolls the whole fleet, and prints ONE JSON line:
+``errors`` is the number of requests that got no answer from any
+replica — the demo's acceptance number is 0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DRAIN_TIMEOUT_S = 10.0
+HEALTHY_TIMEOUT_S = 120.0
+
+
+def _get_json(url: str, timeout: float = 2.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _post_json(url: str, payload: dict, timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def healthz(endpoint: str) -> dict:
+    """Health snapshot, or {"status": "unreachable"} — a down replica is
+    a state, not an exception, during a rollout."""
+    try:
+        return _get_json(endpoint.rstrip("/") + "/healthz")
+    except urllib.error.HTTPError as exc:      # 503 carries a body
+        try:
+            return json.loads(exc.read())
+        except Exception:                      # noqa: BLE001
+            return {"status": f"http_{exc.code}"}
+    except Exception:                          # noqa: BLE001
+        return {"status": "unreachable"}
+
+
+def wait_healthy(endpoint: str,
+                 timeout_s: float = HEALTHY_TIMEOUT_S) -> float:
+    """Poll /healthz until status=ok; returns seconds waited."""
+    t0 = time.monotonic()
+    deadline = t0 + timeout_s
+    while time.monotonic() < deadline:
+        if healthz(endpoint).get("status") == "ok":
+            return time.monotonic() - t0
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"{endpoint} not healthy after {timeout_s:.0f}s "
+        f"(last: {healthz(endpoint)})")
+
+
+def drain(endpoint: str, timeout_s: float = DRAIN_TIMEOUT_S) -> dict:
+    """POST /drain and wait for the final health snapshot."""
+    return _post_json(endpoint.rstrip("/") + "/drain",
+                      {"timeout_s": timeout_s}, timeout=timeout_s + 10.0)
+
+
+def rolling_restart(endpoints, restart_fn,
+                    drain_timeout_s: float = DRAIN_TIMEOUT_S,
+                    healthy_timeout_s: float = HEALTHY_TIMEOUT_S) -> dict:
+    """Drain, restart, and re-verify each replica IN SEQUENCE — at most
+    one replica is out of rotation at any moment, which is what keeps a
+    correctly-failing-over client at zero errors. Returns per-replica
+    phase timings."""
+    steps = []
+    for endpoint in endpoints:
+        step = {"endpoint": endpoint}
+        t0 = time.monotonic()
+        try:
+            final = drain(endpoint, drain_timeout_s)
+            step["drained"] = final.get("status", "?")
+            step["queued_at_drain"] = final.get("queued_rows", 0)
+        except Exception as exc:               # noqa: BLE001
+            # a replica that died before draining still gets restarted
+            step["drained"] = f"error: {exc}"
+        step["drain_s"] = round(time.monotonic() - t0, 3)
+        t0 = time.monotonic()
+        restart_fn(endpoint)
+        step["healthy_wait_s"] = round(
+            wait_healthy(endpoint, healthy_timeout_s), 3)
+        step["restart_s"] = round(time.monotonic() - t0, 3)
+        steps.append(step)
+    return {"replicas": len(steps), "steps": steps}
+
+
+# ---------------------------------------------------------------------------
+# self-contained demo fleet
+# ---------------------------------------------------------------------------
+
+def _train_demo_model(path: str) -> None:
+    import numpy as np
+    import lightgbm_tpu as lgb
+    r = np.random.RandomState(0)
+    x = r.randn(2000, 16).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "max_bin": 63},
+                    lgb.Dataset(x, y, free_raw_data=False),
+                    num_boost_round=5, verbose_eval=False)
+    bst.save_model(path)
+
+
+def _spawn_replica(model: str, port: int, cache_dir: str,
+                   log_path: str) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "lightgbm_tpu", "task=serve",
+           f"input_model={model}", "serve_host=127.0.0.1",
+           f"serve_port={port}", f"serve_export_cache={cache_dir}",
+           "serve_warm_buckets=1,16"]
+    logf = open(log_path, "ab")
+    return subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf,
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+
+
+def _demo(n_replicas: int, secs: float) -> None:
+    import tempfile
+    workdir = tempfile.mkdtemp(prefix="lgbm_rollout_")
+    model = os.path.join(workdir, "model.txt")
+    cache_dir = os.path.join(workdir, "xcache")
+    _train_demo_model(model)
+
+    base_port = int(os.environ.get("ROLLOUT_BASE_PORT", 18480))
+    ports = [base_port + i for i in range(n_replicas)]
+    endpoints = [f"http://127.0.0.1:{p}" for p in ports]
+    procs = {}
+    for ep, port in zip(endpoints, ports):
+        procs[ep] = _spawn_replica(model, port, cache_dir,
+                                   os.path.join(workdir, f"r{port}.log"))
+    t_first = time.monotonic()
+    for ep in endpoints:
+        wait_healthy(ep)
+    cold_start_s = time.monotonic() - t_first
+
+    # closed-loop traffic with failover: a request only counts as an
+    # error when EVERY replica refuses it — the number a user would see
+    stop = threading.Event()
+    ok = [0]
+    errors = [0]
+    lock = threading.Lock()
+
+    def client(ci: int) -> None:
+        import numpy as np
+        rs = np.random.RandomState(ci)
+        while not stop.is_set():
+            row = rs.randn(16).tolist()
+            answered = False
+            for k in range(len(endpoints)):
+                ep = endpoints[(ci + k) % len(endpoints)]
+                try:
+                    _post_json(ep + "/predict", {"rows": [row]},
+                               timeout=5.0)
+                    answered = True
+                    break
+                except Exception:              # noqa: BLE001
+                    continue
+            with lock:
+                (ok if answered else errors)[0] += 1
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(max(1.0, secs / 3))             # steady state first
+
+    def restart_fn(endpoint: str) -> None:
+        proc = procs[endpoint]
+        proc.terminate()
+        proc.wait(timeout=30)
+        port = int(endpoint.rsplit(":", 1)[1])
+        procs[endpoint] = _spawn_replica(
+            model, port, cache_dir,
+            os.path.join(workdir, f"r{port}.log"))
+
+    t0 = time.monotonic()
+    report = rolling_restart(endpoints, restart_fn)
+    rollout_s = time.monotonic() - t0
+    time.sleep(max(1.0, secs / 3))             # steady state after
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    for proc in procs.values():
+        proc.terminate()
+
+    warm_waits = [s["healthy_wait_s"] for s in report["steps"]]
+    print(json.dumps({
+        "metric": "rollout_errors",
+        "value": errors[0],
+        "unit": "failed_requests",
+        "vs_baseline": None,
+        "requests": ok[0] + errors[0],
+        "replicas": n_replicas,
+        "rollout_s": round(rollout_s, 3),
+        "cold_start_healthy_s": round(cold_start_s, 3),
+        "restart_healthy_s": warm_waits,
+        "steps": report["steps"],
+        "workdir": workdir,
+    }))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--endpoints", default="",
+                    help="comma-separated replica base URLs")
+    ap.add_argument("--restart-cmd", default="",
+                    help="shell command template; {endpoint} and {port} "
+                         "are substituted")
+    ap.add_argument("--demo", type=int, default=0, metavar="N",
+                    help="spawn an N-replica local demo fleet instead")
+    ap.add_argument("--secs", type=float, default=6.0,
+                    help="demo traffic duration")
+    args = ap.parse_args()
+    if args.demo:
+        _demo(args.demo, args.secs)
+        return
+    endpoints = [e for e in args.endpoints.split(",") if e]
+    if not endpoints or not args.restart_cmd:
+        ap.error("need --endpoints and --restart-cmd (or --demo N)")
+
+    def restart_fn(endpoint: str) -> None:
+        port = endpoint.rsplit(":", 1)[-1].strip("/")
+        subprocess.run(
+            args.restart_cmd.format(endpoint=endpoint, port=port),
+            shell=True, check=True)
+
+    print(json.dumps(rolling_restart(endpoints, restart_fn)))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,15 @@ SERVE_BENCH_LEAVES (31), SERVE_BENCH_TREES (10) — raise the last three
 on a real accelerator for a production-shaped ensemble; the defaults
 keep a cold-CPU run inside a CI budget (serving latency is dominated by
 dispatch + batch shape, not ensemble size, once compiled).
+
+Cold-start measurement (the fleet restart story): SERVE_BENCH_CACHE_DIR
+points the registry at a persistent export cache
+(fleet/export_cache.py). The JSON line then carries
+`time_to_first_prediction_s` (model load -> first answered request) and
+`export_cache_hit` (true when the warm-up restored serialized
+executables instead of compiling). Run twice with the same dir: the
+first run populates, the second demonstrates the zero-compile restart.
+`LGBM_TPU_SERVE_NO_STAGING=1` A/Bs the staged-buffer flush path.
 """
 import json
 import os
@@ -25,6 +34,16 @@ import threading
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ.get("SERVE_BENCH_CACHE_DIR"):
+    # cross-process executable reuse on XLA:CPU needs the legacy runtime
+    # (the thunk runtime JIT-resolves kernel symbols in-memory, so its
+    # serialized executables only reload in the process that built
+    # them); must be set before jax initializes. TPU/GPU executables
+    # are self-contained and need no flag.
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_use_thunk_runtime" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_cpu_use_thunk_runtime=false").strip()
 
 import numpy as np
 
@@ -56,14 +75,27 @@ def main() -> None:
         lgb.Dataset(x, y.astype(np.float64), free_raw_data=False),
         num_boost_round=N_TREES, verbose_eval=False)
 
+    cache_dir = os.environ.get("SERVE_BENCH_CACHE_DIR", "")
+    export_cache = None
+    if cache_dir:
+        from lightgbm_tpu.fleet import ExportCache
+        export_cache = ExportCache(cache_dir)
     registry = ModelRegistry(
-        warm_buckets=(ROWS_PER_REQ, MAX_BATCH))
+        warm_buckets=(ROWS_PER_REQ, MAX_BATCH), export_cache=export_cache)
     app = ServingApp(registry, max_batch=MAX_BATCH, max_delay_ms=DELAY_MS,
                      max_queue_rows=MAX_BATCH * 16)
     t0 = time.perf_counter()
     registry.load(bst)
     warm_secs = time.perf_counter() - t0
     compiles_warm = registry.predictor.compile_count
+    # time-to-first-prediction: load + warm-up + one real answered
+    # request — the cold-start number a restarting replica cares about
+    app.batcher.submit(x[:ROWS_PER_REQ], timeout_ms=10_000)
+    ttfp_secs = time.perf_counter() - t0
+    export_cache_hit = bool(
+        export_cache is not None
+        and export_cache.last_restore.get("restored", 0) > 0
+        and compiles_warm == 0)
 
     hist = LatencyHistogram()
     hist_lock = threading.Lock()
@@ -116,8 +148,13 @@ def main() -> None:
         "max_batch": MAX_BATCH,
         "max_delay_ms": DELAY_MS,
         "warmup_secs": round(warm_secs, 3),
+        "time_to_first_prediction_s": round(ttfp_secs, 3),
+        "export_cache_hit": export_cache_hit,
+        "export_cache_restore": (dict(export_cache.last_restore)
+                                 if export_cache is not None else None),
         "compiles_after_warm":
             registry.predictor.compile_count - compiles_warm,
+        "staging": not bool(os.environ.get("LGBM_TPU_SERVE_NO_STAGING")),
         "batches": app.stats.get("serve_batches"),
         "backend": jax.default_backend(),
     }))
