@@ -336,7 +336,7 @@ def main():
               "BENCH_CAT_FEATURES", "BENCH_QUANTIZED",
               "BENCH_GRAD_BITS", "BENCH_STRATEGY",
               "BENCH_TELEMETRY", "BENCH_STREAM",
-              "BENCH_CHUNK_ROWS") if k in os.environ}
+              "BENCH_CHUNK_ROWS", "BENCH_DIST_SHARD") if k in os.environ}
     sys.stderr.write(f"rows={N_ROWS} iters={N_ITERS} knobs={knobs}\n")
 
     # any capped run (explicit CPU or fallback) is not comparable to the
@@ -383,8 +383,21 @@ def main():
     # the breakdown is emitted as the `phase_breakdown` JSON field
     if os.environ.get("BENCH_TELEMETRY"):
         params.update(telemetry=os.environ["BENCH_TELEMETRY"])
+    # row-sharded ingest lever: BENCH_DIST_SHARD=rows|replicated routes
+    # dataset construction through distributed ingest (single-process
+    # that is plain local construction, byte-identical to Dataset(x, y);
+    # under a multi-process bootstrap each host keeps only its rows when
+    # =rows) and reports the stored host bytes in the JSON line
+    dist_shard = os.environ.get("BENCH_DIST_SHARD", "")
+    if dist_shard:
+        params.update(dist_shard_mode=dist_shard)
     cat_cols = list(range(N_FEATURES - N_CAT, N_FEATURES)) if N_CAT else []
-    ds = lgb.Dataset(x, y, categorical_feature=cat_cols or None)
+    if dist_shard:
+        from lightgbm_tpu.distributed import ingest
+        ds = ingest.wrap_train_set(ingest.load_sharded(
+            x, label=y, params=params, categorical=cat_cols or None))
+    else:
+        ds = lgb.Dataset(x, y, categorical_feature=cat_cols or None)
     ds.construct()
     sys.stderr.write(f"setup {time.time()-t_setup:.1f}s\n")
 
@@ -527,6 +540,15 @@ def main():
         # null): transfer_overlap_fraction is 1 - stream_wait/stream
         # wall from the shard's own counters
         "stream_mode": stream_mode,
+        # distributed-ingest diagnostics (BENCH_DIST_SHARD lever; null
+        # otherwise): peak_host_bytes is this rank's stored binned
+        # matrix + label/weight — the number rows-sharding shrinks
+        "shard_mode": dist_shard or None,
+        "peak_host_bytes": (
+            int(getattr(ds._inner, "_ingest_host_bytes", 0)) or
+            (int(ds._inner.binned.nbytes) + int(np.asarray(y).nbytes))
+            if dist_shard and getattr(ds, "_inner", None) is not None
+            and getattr(ds._inner, "binned", None) is not None else None),
         "chunk_rows": (int(shard.chunk_rows) if shard is not None
                        else stream_chunk_rows),
         "transfer_overlap_fraction": (round(overlap, 4)

@@ -178,7 +178,7 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
         # and finish the boosting budget (docs/Reliability.md)
         del exc
         del booster
-        supervisor.shrink_after_failure(rf)
+        new_world = supervisor.shrink_after_failure(rf)
         train_set = Dataset(cfg.data, params=params)
         train_set.construct()
         booster = Booster(params=params, train_set=train_set)
@@ -190,8 +190,8 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
         restore_for_resume(booster, ckpt_dir)
         mgr = DistributedCheckpointManager(ckpt_dir,
                                            keep_last=cfg.snapshot_keep)
-        log.warning("recovered: resuming at iteration %d single-host",
-                    booster.current_iteration())
+        log.warning("recovered: resuming at iteration %d with %d "
+                    "process(es)", booster.current_iteration(), new_world)
         _boost_loop(booster, mgr)
     log.info("Finished training in %.3f seconds", time.time() - t0)
     from . import telemetry

@@ -242,6 +242,18 @@ class Config:
         if self.on_rank_failure not in ("raise", "shrink"):
             log.fatal("on_rank_failure must be one of raise/shrink, "
                       "got %s", self.on_rank_failure)
+        if self.dist_shard_mode not in ("replicated", "rows"):
+            log.fatal("dist_shard_mode must be one of replicated/rows, "
+                      "got %s", self.dist_shard_mode)
+        if self.dist_shard_mode == "rows" and self.tree_learner in (
+                "feature", "voting"):
+            log.fatal(
+                "dist_shard_mode=rows keeps each host only its own row "
+                "block, which only the data-parallel learner can train "
+                "on (histograms are the cross-host exchange); "
+                "tree_learner=%s needs every rank to hold all rows. Use "
+                "tree_learner=data or dist_shard_mode=replicated",
+                self.tree_learner)
 
     # -- helpers used by the trainer -------------------------------------
     @property

@@ -41,11 +41,21 @@ from ..utils import log
 from . import bootstrap
 
 
-def shard_row_block(num_total_rows: int, rank: int, num_processes: int
-                    ) -> Tuple[int, int]:
+def shard_row_block(num_total_rows: int, rank: int, num_processes: int,
+                    granularity: int = 1) -> Tuple[int, int]:
     """Ceil-sized contiguous block, matching the device learner's row
-    sharding (last rank may run short; the learner pads)."""
-    local_n = -(-num_total_rows // num_processes)
+    sharding (last rank may run short; the learner pads).
+
+    `granularity` is the per-process device count: the device learner
+    shards rows over ALL devices as `ceil(n / (num_processes *
+    granularity))` rows per device, so a rank's block must start on a
+    multiple of that per-device block for its local rows to land
+    exactly on its own devices (`dist_shard_mode=rows`). With
+    `granularity=1` (replicated ingest, or one device per process) this
+    is the plain ceil split, unchanged."""
+    g = max(1, int(granularity))
+    per_device = -(-num_total_rows // (num_processes * g))
+    local_n = per_device * g
     begin = min(rank * local_n, num_total_rows)
     return begin, min(begin + local_n, num_total_rows)
 
@@ -65,23 +75,84 @@ def _bin_block(local_data: np.ndarray, mappers: List[BinMapper]
     return out
 
 
+def _stored_bytes(binned: np.ndarray, label, weight) -> int:
+    """Host footprint the loader is responsible for: the binned code
+    matrix plus the stored label/weight vectors. The caller's raw float
+    matrix (a loader INPUT it may or may not retain) and the tiny
+    mapper list are excluded — this is the number `tools/dist_smoke.py`
+    pins as `peak_host_bytes_per_rank`."""
+    total = int(binned.nbytes)
+    for a in (label, weight):
+        if a is not None:
+            total += int(np.asarray(a).nbytes)
+    return total
+
+
 def load_partition(local_data: np.ndarray, config: Optional[Config] = None,
                    label_local=None, weight_local=None,
                    categorical: Optional[Sequence[int]] = None,
-                   params=None, feature_names=None):
+                   params=None, feature_names=None,
+                   shard_mode: Optional[str] = None,
+                   row_begin: Optional[int] = None,
+                   num_total_rows: Optional[int] = None):
     """Each host holds ONLY its row partition (``pre_partition`` mode).
 
-    Cooperative bin finding over all partitions, local binning, then an
-    all-gather of the compact binned blocks (+ per-rank label/weight)
-    reconstructs the identical full `Dataset` on every host. Rank order
-    of the gather defines global row order, so partitions must be
-    handed over in rank order (shard_row_block slices do this)."""
+    Cooperative bin finding over all partitions, then local binning.
+    What crosses the wire after that depends on ``shard_mode``:
+
+    * ``replicated`` (default) — all-gather the compact binned blocks
+      (+ per-rank label/weight) so every host reconstructs the
+      identical full `Dataset`. Rank order of the gather defines global
+      row order, so partitions must be handed over in rank order
+      (shard_row_block slices do this).
+    * ``rows`` — each host KEEPS its binned block; only the per-rank
+      labels/weights and row counts are gathered (metrics, objectives
+      and scores span all rows and need them). The code matrix never
+      leaves the host: per-leaf histograms are the only cross-host
+      bytes during training. The returned Dataset is row-sharded
+      (`Dataset.row_shard`), which the device data-parallel learner
+      consumes directly. ``row_begin``/``num_total_rows`` may pin the
+      block's global placement (device-granularity-aligned slices from
+      `load_sharded`); left None, rank-order cumulative counts define
+      it.
+    """
     cfg = config or Config(params or {})
+    mode = shard_mode or getattr(cfg, "dist_shard_mode", "replicated")
     local_data = np.ascontiguousarray(local_data, dtype=np.float64)
     if local_data.ndim == 1:
         local_data = local_data.reshape(-1, 1)
     mappers = distributed_find_bins(local_data, cfg, categorical)
     binned_local = _bin_block(local_data, mappers)
+    from ..io.dataset import Dataset
+    if mode == "rows":
+        payload = pickle.dumps(
+            {"n": int(binned_local.shape[0]),
+             "label": (None if label_local is None
+                       else np.asarray(label_local)),
+             "weight": (None if weight_local is None
+                        else np.asarray(weight_local))},
+            protocol=4)
+        blocks = [pickle.loads(c) for c in _allgather_host_bytes(payload)]
+        counts = [b["n"] for b in blocks]
+        label = (np.concatenate([b["label"] for b in blocks])
+                 if blocks[0]["label"] is not None else None)
+        weight = (np.concatenate([b["weight"] for b in blocks])
+                  if blocks[0]["weight"] is not None else None)
+        rank = bootstrap.rank()
+        begin = (int(row_begin) if row_begin is not None
+                 else int(sum(counts[:rank])))
+        total = (int(num_total_rows) if num_total_rows is not None
+                 else int(sum(counts)))
+        ds = Dataset.from_binned(binned_local, mappers, cfg, label=label,
+                                 weight=weight,
+                                 feature_names=feature_names,
+                                 row_shard=(begin, total))
+        ds._ingest_host_bytes = _stored_bytes(binned_local, label, weight)
+        log.info("distributed ingest (rows): rank %d keeps rows %d:%d of "
+                 "%d (%.1f MB binned local; codes never cross the wire)",
+                 rank, begin, begin + binned_local.shape[0], total,
+                 binned_local.nbytes / 1e6)
+        return ds
     payload = pickle.dumps(
         {"binned": binned_local,
          "label": (None if label_local is None
@@ -95,9 +166,9 @@ def load_partition(local_data: np.ndarray, config: Optional[Config] = None,
              if blocks[0]["label"] is not None else None)
     weight = (np.concatenate([b["weight"] for b in blocks])
               if blocks[0]["weight"] is not None else None)
-    from ..io.dataset import Dataset
     ds = Dataset.from_binned(binned, mappers, cfg, label=label,
                              weight=weight, feature_names=feature_names)
+    ds._ingest_host_bytes = _stored_bytes(binned, label, weight)
     log.info("distributed ingest: %d rows reassembled from %d partitions"
              " (%d local)", ds.num_data, bootstrap.process_count(),
              local_data.shape[0])
@@ -135,13 +206,23 @@ def load_sharded(data: np.ndarray, config: Optional[Config] = None,
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
-    lo, hi = shard_row_block(arr.shape[0], bootstrap.rank(), nproc)
+    mode = getattr(cfg, "dist_shard_mode", "replicated")
+    # rows mode: blocks must start on per-DEVICE boundaries so each
+    # host's rows land exactly on its own mesh positions (the device
+    # learner shards over all devices, not all hosts)
+    granularity = 1
+    if mode == "rows":
+        import jax
+        granularity = jax.local_device_count()
+    lo, hi = shard_row_block(arr.shape[0], bootstrap.rank(), nproc,
+                             granularity)
     ds = load_partition(
         arr[lo:hi], cfg,
         label_local=None if label is None else np.asarray(label)[lo:hi],
         weight_local=None if weight is None else np.asarray(weight)[lo:hi],
         categorical=categorical, params=params,
-        feature_names=feature_names)
+        feature_names=feature_names, shard_mode=mode,
+        row_begin=lo, num_total_rows=arr.shape[0])
     # remember the construction inputs so a post-shrink `reshard` can
     # rebuild for the new world size (multi-process only: the raw
     # matrix is already resident here, so this is a reference, not a

@@ -400,19 +400,27 @@ def classify_failure(exc: BaseException,
 
 # -- shrink-and-resume ---------------------------------------------------
 def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
-    """Tear down the dead process group and degrade to single-host.
+    """Tear down the dead process group and continue with the survivors.
 
-    Policy: this in-process shrink is implemented for the
-    exactly-one-survivor case (the common 2-host topology, and the only
-    one a 1-core CI host can exercise). With >1 survivors a coordinated
-    re-bootstrap across the surviving machines is required — the
-    survivors cannot agree on a new coordinator through a dead KV store
-    — so this raises with restart guidance instead of guessing.
+    One survivor degrades to single-host (the common 2-host topology).
+    With N > 1 survivors the group RE-FORMS in-process: every survivor
+    runs the identical teardown, then rejoins a fresh coordination
+    service on a deterministically derived address — new rank = index
+    in the sorted survivor list, new coordinator = first survivor's
+    heartbeat host, new port = old port + number of dead ranks (the old
+    immortalized service keeps the old port bound, so the offset also
+    avoids a bind collision). No cross-host agreement protocol is
+    needed because every input to that derivation (old world, dead set,
+    old coordinator, peer hosts) is already identical on every survivor
+    when the shrink starts.
 
-    Returns the new world size (always 1). The caller must drop its own
-    references to boosters/datasets built on the old backend before
-    dispatching new work; ``failure.__traceback__`` is cleared here so
-    the dead iteration's frames do not pin them.
+    Returns the new world size. The caller must drop its own references
+    to boosters/datasets built on the old backend before dispatching
+    new work; ``failure.__traceback__`` is cleared here so the dead
+    iteration's frames do not pin them. Callers re-entering training
+    re-arm supervision and the collective deadline themselves
+    (engine.train does); this function leaves the deadline off so the
+    rendezvous cannot be killed by a stale timeout.
     """
     import gc
 
@@ -427,13 +435,33 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
         return 1
     dead = list(failure.ranks) if failure is not None else []
     survivors = world - len(dead) if dead else 1
+    # capture everything the re-bootstrap derives its addresses from
+    # BEFORE teardown wipes jax's global state and the supervisor
+    old_rank = int(getattr(_jd.global_state, "process_id", 0) or 0)
+    old_coord = str(getattr(_jd.global_state, "coordinator_address", "")
+                    or "")
+    surviving = [r for r in range(world) if r not in set(dead)]
+    new_coord = ""
     if survivors > 1:
-        log.fatal(
-            "rank(s) %s died in a %d-process group: %d survivors cannot "
-            "re-form a mesh in-process (the coordinator KV store died "
-            "with the group). Restart the job on the surviving machines "
-            "with num_machines=%d and resume_from the last checkpoint.",
-            dead, world, survivors, survivors)
+        sup = _active
+        peer_hosts = dict(sup._peers) if sup is not None else {}
+        lead = surviving[0]
+        if lead == old_rank:
+            lead_host = _advertise_host()
+        elif lead in peer_hosts:
+            lead_host = peer_hosts[lead][0]
+        elif lead == 0 and old_coord:
+            lead_host = old_coord.rsplit(":", 1)[0]
+        else:
+            log.fatal(
+                "cannot re-form a %d-survivor group: no dialable "
+                "address for the new coordinator (rank %d) — heartbeat "
+                "supervision (dist_heartbeat_ms > 0) is required for "
+                "multi-survivor shrink", survivors, lead)
+        if not old_coord:
+            log.fatal("cannot re-form: old coordinator address unknown")
+        new_port = int(old_coord.rsplit(":", 1)[1]) + len(dead)
+        new_coord = f"{lead_host}:{new_port}"
 
     stop_supervision()
     telem_counters.incr("shrinks")
@@ -441,10 +469,10 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
     # dist_kill subtracts the victim's observed exit time)
     telem_counters.set_gauge("last_shrink_unix", time.time())
     telem_events.emit("shrink", dead_ranks=dead, old_world=world,
-                      new_world=1,
+                      new_world=survivors,
                       reason=failure.reason if failure else "requested")
-    log.warning("shrinking process group %d -> 1 (dead ranks: %s)",
-                world, dead or "unknown")
+    log.warning("shrinking process group %d -> %d (dead ranks: %s)",
+                world, survivors, dead or "unknown")
     if failure is not None:
         failure.__traceback__ = None
 
@@ -453,7 +481,8 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
     #    the dead topology through the bootstrap cache
     bootstrap._state.update({"initialized": False, "num_processes": 1,
                              "rank": 0, "mesh": None, "mesh_axis": None})
-    # 2. next backend must come up WITHOUT gloo (single-host CPU)
+    # 2. next backend must come up WITHOUT gloo first (the re-forming
+    #    path re-selects gloo right before rejoining)
     try:
         jax.config.update("jax_cpu_collectives_implementation", "none")
     except Exception:  # pragma: no cover - flag absent on this backend
@@ -469,27 +498,51 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
     except Exception:  # pragma: no cover - jax internals moved
         pass
     jax.clear_caches()
-    # 5. detach the coordination client/service from jax's global state
-    #    WITHOUT destroying them: their destructors (and jax's atexit
-    #    shutdown) join heartbeat/error-polling threads blocked on dead
-    #    peer sockets and abort the process. Immortalize via an extra
+    # 5. detach the coordination client/service (and the preemption
+    #    sync manager — jax.distributed.initialize refuses to run again
+    #    while one is attached) from jax's global state WITHOUT
+    #    destroying them: their destructors (and jax's atexit shutdown)
+    #    join heartbeat/error-polling threads blocked on dead peer
+    #    sockets and abort the process. Immortalize via an extra
     #    refcount and let the OS reclaim the sockets at exit.
     import ctypes
     for obj in (getattr(_jd.global_state, "client", None),
-                getattr(_jd.global_state, "service", None)):
+                getattr(_jd.global_state, "service", None),
+                getattr(_jd.global_state, "preemption_sync_manager",
+                        None)):
         if obj is not None:
             ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
     _jd.global_state.client = None
     _jd.global_state.service = None
+    try:
+        _jd.global_state.preemption_sync_manager = None
+    except Exception:  # pragma: no cover - field absent on this jax
+        pass
     _jd.global_state.num_processes = 1
     _jd.global_state.process_id = 0
     _jd.global_state.coordinator_address = None
     gc.collect()
 
-    # single-process from here: deadline off, gauges truthful
+    # deadline off either way: single-host needs none, and the
+    # multi-survivor rendezvous must not be killed by a stale timeout
+    # (train() re-arms it from config on re-entry)
     faults.set_collective_timeout_ms(0)
-    telem_counters.set_gauge("dist_process_count", 1)
-    telem_counters.set_gauge("dist_rank", 0)
-    log.warning("shrink complete: continuing single-host on %d device(s)",
-                len(jax.devices()))
-    return 1
+
+    if survivors <= 1:
+        telem_counters.set_gauge("dist_process_count", 1)
+        telem_counters.set_gauge("dist_rank", 0)
+        log.warning("shrink complete: continuing single-host on %d "
+                    "device(s)", len(jax.devices()))
+        return 1
+
+    # --- multi-survivor: re-form the group on a fresh port -------------
+    new_rank = surviving.index(old_rank)
+    log.warning("re-forming process group: rank %d -> rank %d of %d "
+                "(coordinator %s)", old_rank, new_rank, survivors,
+                new_coord)
+    bootstrap.initialize(new_coord, survivors, new_rank, supervise=True)
+    telem_events.emit("regroup", old_rank=old_rank, new_rank=new_rank,
+                      new_world=survivors, coordinator=new_coord)
+    log.warning("shrink complete: continuing with %d process(es) on %d "
+                "device(s)", survivors, len(jax.devices()))
+    return survivors

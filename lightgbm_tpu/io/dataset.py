@@ -11,7 +11,7 @@ storage, which EFB-style bundling can reclaim later).
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -113,6 +113,7 @@ class Dataset:
         self.feature_names = (list(feature_names) if feature_names
                               else [f"Column_{i}" for i in range(self.num_total_features)])
         self.reference = reference
+        self.row_shard: Optional[Tuple[int, int]] = None
 
         if reference is not None:
             self.bin_mappers = reference.bin_mappers
@@ -157,15 +158,33 @@ class Dataset:
     @classmethod
     def from_binned(cls, binned: np.ndarray, bin_mappers, config,
                     label=None, weight=None, group=None, init_score=None,
-                    feature_names=None) -> "Dataset":
+                    feature_names=None, row_shard=None) -> "Dataset":
         """Construct from an already-binned code matrix + its mappers —
         the two-round loader's entry (io/two_round.py round 2 bins
         chunks straight into `binned`; the float matrix never existed,
         reference dataset_loader.cpp:168 two_round role). `binned` holds
-        the NON-trivial features' columns, in mapper order."""
+        the NON-trivial features' columns, in mapper order.
+
+        `row_shard=(begin, num_total_rows)` marks a rank-partitioned
+        dataset (distributed/ingest.py `dist_shard_mode=rows`): `binned`
+        then holds only this host's contiguous row block starting at
+        global row `begin`, while `num_data`, labels and weights stay
+        GLOBAL — metrics, objectives and scores span all rows, only the
+        code matrix is partitioned. EFB bundling is skipped (the bundle
+        plan is data-dependent and would diverge across ranks) and
+        `device_binned()` is unavailable."""
         self = cls.__new__(cls)
         self.config = config
-        self.num_data = int(binned.shape[0])
+        if row_shard is not None:
+            begin, total = int(row_shard[0]), int(row_shard[1])
+            log.check(0 <= begin <= total
+                      and begin + binned.shape[0] <= total,
+                      "row_shard block out of range")
+            self.row_shard = (begin, begin + int(binned.shape[0]))
+            self.num_data = total
+        else:
+            self.row_shard = None
+            self.num_data = int(binned.shape[0])
         self.num_total_features = len(bin_mappers)
         self.metadata = Metadata(self.num_data)
         if label is not None:
@@ -316,6 +335,12 @@ class Dataset:
         if (not cfg.enable_bundle or self.num_features <= 1
                 or self.num_data == 0):
             return None
+        if getattr(self, "row_shard", None) is not None:
+            # rank-partitioned block: the bundle plan samples the DATA,
+            # so each rank would plan different columns and the shards
+            # would stop vstacking into one logical matrix — train on
+            # the unbundled per-feature view instead
+            return None
         sample = min(self.num_data, 50_000)
         rows = (np.linspace(0, self.num_data - 1, sample).astype(np.int64)
                 if sample < self.num_data else np.arange(self.num_data))
@@ -389,6 +414,14 @@ class Dataset:
 
     def device_binned(self):
         import jax.numpy as jnp
+        if getattr(self, "row_shard", None) is not None:
+            log.fatal(
+                "device_binned: dataset is row-sharded "
+                "(dist_shard_mode=rows holds rows %d:%d of %d on this "
+                "host); the full code matrix exists on no single host. "
+                "Consumers must run on the partitioned view or use "
+                "dist_shard_mode=replicated", self.row_shard[0],
+                self.row_shard[1], self.num_data)
         if "binned" not in self._device_cache:
             self._device_cache["binned"] = jnp.asarray(self.binned)
         return self._device_cache["binned"]
@@ -457,6 +490,7 @@ class Dataset:
         if len(z["init_score"]):
             obj.metadata.init_score = z["init_score"]
         obj.reference = None
+        obj.row_shard = None
         obj.columns = obj._plan_bundles()
         obj.bundled = obj._encode_bundles() if obj.columns else None
         obj._device_cache = {}

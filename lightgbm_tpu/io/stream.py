@@ -125,7 +125,8 @@ class DeviceDataShard:
 
     # -- the double-buffered pipeline ----------------------------------
     def iter_chunks(self, row_ids: Optional[np.ndarray] = None,
-                    emit_phase: bool = True
+                    emit_phase: bool = True,
+                    device=None
                     ) -> Iterator[Tuple[int, int, jax.Array]]:
         """Yield (start, count, device_chunk) over the wire rows (or the
         given row-id subset), next chunk's H2D dispatched before the
@@ -133,7 +134,9 @@ class DeviceDataShard:
         `chunk_rows` rows. `emit_phase=False` skips the `stream_wait`
         recorder phase (for streaming nested inside another recorded
         phase — recorder phases must not nest); bytes and wait seconds
-        are still counted."""
+        are still counted. `device` pins the H2D target (the streamed
+        data-parallel learner assembles one working buffer per local
+        mesh device); None keeps the default-device placement."""
         if row_ids is not None:
             row_ids = np.asarray(row_ids, dtype=np.int64)
         n = self.num_rows if row_ids is None else int(row_ids.size)
@@ -149,7 +152,7 @@ class DeviceDataShard:
                 arr = self.wire[s:e]
             else:
                 arr = np.ascontiguousarray(self.wire[row_ids[s:e]])
-            return s, e - s, int(arr.nbytes), jax.device_put(arr)
+            return s, e - s, int(arr.nbytes), jax.device_put(arr, device)
 
         self.track_buffer(
             "stream_inflight", 2 * sc * self.code_words * 4)

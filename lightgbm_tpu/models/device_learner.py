@@ -1491,9 +1491,12 @@ def grow_tree_chunk_core(
     cat_b = num_bins if has_cat else 1
     quant = quant_bits > 0
     if data_prebuilt:
-        assert axis_name is None and feature_shards <= 1 \
-            and scatter_cols <= 1 and voting_k <= 0, \
-            "data_prebuilt streaming runs the serial chunk core only"
+        # serial streaming, or streamed data-parallel over the plain
+        # psum lane (each shard's buffer holds its own rows; per-leaf
+        # histograms are the only cross-shard exchange)
+        assert feature_shards <= 1 and scatter_cols <= 1 \
+            and voting_k <= 0, \
+            "data_prebuilt runs the serial or plain-psum DP chunk core"
         cw = codes_pack.shape[1] - ((1 if trivial_weights else 2)
                                     if quant else 3) - 1
         assert codes_pack.shape[0] == n + CH, \
@@ -1662,6 +1665,8 @@ def grow_tree_chunk_core(
         hist0 = jax.lax.fori_loop(
             0, maxch, root_chunk,
             jnp.zeros((hist_w, col_bins, 3), jnp.int32))
+        if axis_name is not None:
+            hist0 = jax.lax.psum(hist0, axis_name)
         totals = q_dequant(hist0[0].sum(axis=0), r0_g, r0_h)
         hist0_scan = q_dequant(hist0, r0_g, r0_h)
     elif data_prebuilt:
@@ -1676,6 +1681,7 @@ def grow_tree_chunk_core(
             jax.lax.bitcast_convert_type(data0[:n, cw:cw + 3],
                                          jnp.float32),
             col_bins, use_pallas=use_pallas)
+        hist0 = reduce_hist(hist0)
         totals = hist0[0].sum(axis=0)
     elif quant:
         r0_g, r0_h = q_ratios(root_max)
@@ -2338,7 +2344,12 @@ class DeviceTreeLearner:
                     axis=1)
             self.hist_idx = jnp.asarray(hi2.astype(np.int32))
         else:
-            if stream_on:
+            if stream_on or getattr(dataset, "row_shard", None) is not None:
+                # streaming holds no resident codes; a row-sharded
+                # (dist_shard_mode=rows) dataset has only its local block
+                # host-side and always runs the compact/chunk strategy,
+                # which reads codes_pack/codes_row — the (F, N) masked-
+                # strategy view would need the full matrix
                 self.codes_t = None
             else:
                 binned = dataset.device_binned()

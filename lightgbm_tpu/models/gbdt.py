@@ -133,10 +133,14 @@ class ScoreUpdater:
         """Host f64 copy of the scores, cached per score version: multi-
         metric / multi-valid eval of one iteration fetches the device
         array ONCE instead of a fresh device_get + f64 convert per
-        metric. Callers treat the returned array as read-only."""
+        metric. Routed through `_host_global` because a multi-process
+        data-parallel run row-shards the score across hosts — the gather
+        is a collective there, so every rank evaluates metrics in the
+        same order (they already do: eval runs lock-step per iteration).
+        Callers treat the returned array as read-only."""
         if self._host_cache is None:
             self._host_cache = np.asarray(
-                jax.device_get(self._score), dtype=np.float64)
+                _host_global(self._score), dtype=np.float64)
             if telem_counters.is_active():
                 telem_counters.incr("transfer_d2h_bytes",
                                     self._score.size * 4)

@@ -56,6 +56,8 @@ from ..models.tree import Tree
 from ..ops import histogram as hist_ops
 from ..ops import split as split_ops
 from ..resilience import faults
+from ..telemetry import counters as telem_counters
+from ..telemetry import recorder as telem
 from ..telemetry import spans as telem_spans
 from ..utils import log
 from ..utils.envs import dp_reduce_mode_env
@@ -127,6 +129,15 @@ class DataParallelTreeLearner(SerialTreeLearner):
         self.mesh = mesh or make_mesh(axis_name="data")
         self.shards = int(self.mesh.devices.size)
         n = dataset.num_data
+        if getattr(dataset, "row_shard", None) is not None:
+            log.fatal(
+                "the host-loop data-parallel learner needs the full "
+                "binned matrix on every rank, but this dataset is row-"
+                "sharded (dist_shard_mode=rows, rows %d:%d of %d). Only "
+                "the device data-parallel learner trains on row-sharded "
+                "ingest; fix the config it fell back for, or use "
+                "dist_shard_mode=replicated",
+                dataset.row_shard[0], dataset.row_shard[1], n)
         self.local_n = -(-n // self.shards)
         pad = self.local_n * self.shards - n
         binned_np = dataset.binned
@@ -329,8 +340,17 @@ class DataParallelTreeLearner(SerialTreeLearner):
             begins = self._leaf_begin[leaf_id]
             cnts = self._leaf_count[leaf_id]
             bucket = _bucket(max(int(cnts.max()), 1), self.max_local_bucket)
-            with telem_spans.span("dp_hist", leaf=int(leaf_id),
-                                  bucket=bucket):
+            # forensic counter (unconditional, once per leaf): the
+            # reduced histogram's payload — the role the reference's
+            # ReduceScatter buffer plays; quantized ships 2 int32 lanes,
+            # float 3 f32 lanes (4 bytes each either way)
+            f = int(self.binned.shape[-1])
+            lanes = 2 if self._quant_bits else 3
+            telem_counters.incr("dist_reduce_scatter_bytes",
+                                f * self.device_bins * lanes * 4)
+            with telem.phase("dist_hist_exchange"), \
+                    telem_spans.span("dp_hist", leaf=int(leaf_id),
+                                     bucket=bucket):
                 if self._quant_bits:
                     fn = self._get_hist_fn_q(bucket)
                     return faults.run_collective(
@@ -619,7 +639,15 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         cnts = self._leaf_count[st.leaf_id]
         bucket = _bucket(max(int(cnts.max()), 1), self.max_local_bucket)
         fmask = self._node_feature_mask(base_mask, rng) & (self.f_categorical == 0)
-        with telem_spans.span("vote_hist", bucket=bucket):
+        # forensic counter: votes (one f32 lane per feature) + the
+        # elected 2k features' int32 histogram triples — the PV-Tree
+        # O(2k*B) wire payload
+        f = int(self.binned.shape[-1])
+        k2 = min(2 * max(1, int(self.config.top_k)), f)
+        telem_counters.incr("dist_reduce_scatter_bytes",
+                            f * 4 + k2 * self.device_bins * 3 * 4)
+        with telem.phase("dist_hist_exchange"), \
+                telem_spans.span("vote_hist", bucket=bucket):
             if self._quant_bits:
                 from ..ops.quantize import dequant_scale3
                 fn = self._get_vote_fn_q(bucket)
@@ -714,21 +742,102 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         self.local_n = -(-n // self.shards)
         self.n_pad = self.local_n * self.shards
 
-        # place the packed buffers row-sharded and padded (the base class
-        # kept them host-side); pad rows carry zero codes and are fenced
-        # off by w == 0 inside the step
-        pad = self.n_pad - n
-        rsh = NamedSharding(self.mesh, P("data", None))
-        cp, cr = self.codes_pack, self.codes_row
-        if pad:
-            cp = np.pad(cp, ((0, pad), (0, 0)))
-            cr = np.pad(cr, ((0, pad), (0, 0)))
-        self.codes_pack = jax.device_put(jnp.asarray(cp), rsh)
-        self.codes_row = jax.device_put(jnp.asarray(cr), rsh)
+        if self._shard is not None:
+            # streamed: no resident codes — train() assembles one
+            # working buffer per local mesh device from the host wire
+            # store (_train_streamed)
+            pass
+        elif getattr(dataset, "row_shard", None) is not None:
+            # rows-mode ingest: this host's arrays hold ONLY its row
+            # block; lift them onto the global mesh with zero cross-host
+            # traffic (every device receives exactly its own rows)
+            self.codes_pack = self._global_from_local(self.codes_pack)
+            self.codes_row = self._global_from_local(self.codes_row)
+        else:
+            # place the packed buffers row-sharded and padded (the base
+            # class kept them host-side); pad rows carry zero codes and
+            # are fenced off by w == 0 inside the step
+            pad = self.n_pad - n
+            rsh = NamedSharding(self.mesh, P("data", None))
+            cp, cr = self.codes_pack, self.codes_row
+            if pad:
+                cp = np.pad(cp, ((0, pad), (0, 0)))
+                cr = np.pad(cr, ((0, pad), (0, 0)))
+            self.codes_pack = jax.device_put(jnp.asarray(cp), rsh)
+            self.codes_row = jax.device_put(jnp.asarray(cr), rsh)
         self._meta = (self.f_numbins, self.f_missing, self.f_default,
                       self.f_monotone, self.f_penalty, self.f_categorical,
                       self.f_col, self.f_base, self.f_elide, self.hist_idx)
         self._tree_w_fn = None
+
+    # -- row-sharded ingest (dist_shard_mode=rows) ---------------------
+    def _local_mesh_positions(self):
+        """(mesh position, device) pairs of this process's devices along
+        the 'data' axis — position p owns global rows [p*local_n,
+        (p+1)*local_n)."""
+        me = jax.process_index()
+        return [(p, d) for p, d in enumerate(self.mesh.devices.flat)
+                if d.process_index == me]
+
+    def _global_from_local(self, block) -> jax.Array:
+        """Lift this host's (local rows, C) ingest block onto the global
+        'data' mesh: each locally-owned mesh position takes its own
+        local_n-row slice (zero-padded at the global tail) and
+        `make_array_from_single_device_arrays` stitches the per-device
+        pieces into one row-sharded global array — no collective, the
+        code matrix never crosses the wire. Requires the block to start
+        on a local_n boundary and to cover every position this
+        process's devices own (`ingest.load_sharded` aligns blocks to
+        the local device count, so both hold by construction)."""
+        from ..utils.log import LightGBMError
+        begin, end = self.dataset.row_shard
+        n = self.dataset.num_data
+        local_n = self.local_n
+        if begin % local_n:
+            raise LightGBMError(
+                f"row-sharded ingest block starts at row {begin}, not a "
+                f"multiple of the per-device block ({local_n} rows = "
+                f"ceil({n} rows / {self.shards} devices)); re-ingest "
+                "with ingest.load_sharded so blocks align to device "
+                "boundaries")
+        block = np.asarray(block)
+        bufs = []
+        for p, dev in self._local_mesh_positions():
+            lo = p * local_n - begin
+            if lo < 0 or (lo >= block.shape[0] and p * local_n < n):
+                raise LightGBMError(
+                    f"row-sharded ingest block {begin}:{end} does not "
+                    f"cover mesh position {p} (rows {p * local_n}:"
+                    f"{(p + 1) * local_n}) owned by this process — the "
+                    "ingest world and the training mesh disagree; "
+                    "re-ingest (ingest.reshard) after any world-size "
+                    "change")
+            sl = block[max(lo, 0):lo + local_n]
+            if sl.shape[0] < local_n:
+                sl = np.pad(sl, ((0, local_n - sl.shape[0]), (0, 0)))
+            bufs.append(jax.device_put(jnp.asarray(sl), dev))
+        return jax.make_array_from_single_device_arrays(
+            (self.n_pad, int(block.shape[1])),
+            NamedSharding(self.mesh, P("data", None)), bufs)
+
+    def _count_hist_wire(self, n_splits: int) -> None:
+        """Analytic reduce-scatter byte accounting for the in-program
+        per-leaf histogram exchange (the collective lives inside the
+        jitted tree program, so unlike the host-loop learners there is
+        no host boundary to count at): root + one smaller-child
+        histogram per split, (C, B, 3) lanes of 4 bytes (int32 when
+        quantized, f32 otherwise)."""
+        telem_counters.incr(
+            "dist_reduce_scatter_bytes",
+            (int(n_splits) + 1) * int(self.c_cols)
+            * int(self.device_bins) * 3 * 4)
+
+    def replay_tree(self, rec_h, k: int, rec_cat_h=None):
+        # every grown tree passes through here (generic, fused and
+        # streamed paths), so this is the one host point that sees the
+        # split count the wire accounting needs
+        self._count_hist_wire(int(k))
+        return super().replay_tree(rec_h, k, rec_cat_h)
 
     # ------------------------------------------------------------------
     def _grow_statics(self):
@@ -871,6 +980,8 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
             (cfg.feature_fraction_seed + iter_seed) % (2**31 - 1))
         base_mask = jnp.asarray(self._feature_mask(rng))
         key = jax.random.PRNGKey(iter_seed)
+        if self._shard is not None:
+            return self._train_streamed(grad, hess, wv, base_mask, key)
         if self._tree_w_fn is None:
             fn = self._sharded_tree_fn(with_bag_key=False)
             nn, npad = n, self.n_pad
@@ -885,6 +996,170 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
         rec, rec_cat, leaf_id, n_splits, _ = self._tree_w_fn(
             self.codes_pack, self.codes_row, grad, hess, jnp.asarray(wv),
             base_mask, key)
+        self.last_leaf_id = leaf_id
+        self._leaf_id_host = None
+        if self._has_cat:
+            rec_h, rec_cat_h, k = jax.device_get((rec, rec_cat, n_splits))
+        else:
+            rec_h, k = jax.device_get((rec, n_splits))
+            rec_cat_h = None
+        k = int(k)
+        if k == 0:
+            log.warning("No further splits with positive gain")
+        return self.replay_tree(rec_h, k, rec_cat_h)
+
+    # -- streamed (out-of-core) data-parallel path ---------------------
+    def _host_rows(self, arr, lo: int, hi: int) -> np.ndarray:
+        """np.float32 rows [lo:hi) of an (N,) row vector that is either
+        process-local or a global row-sharded jax array (the score-
+        derived gradients after the first distributed iteration). A
+        sharded slice must be covered by ONE addressable shard — true by
+        construction: the device at mesh position p holds exactly the
+        rows position p's working buffer needs."""
+        if isinstance(arr, np.ndarray):
+            return np.asarray(arr[lo:hi], dtype=np.float32)
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(jax.device_get(arr))[lo:hi].astype(
+                np.float32, copy=False)
+        for s in arr.addressable_shards:
+            sl = s.index[0]
+            start = sl.start or 0
+            stop = arr.shape[0] if sl.stop is None else sl.stop
+            if start <= lo and hi <= stop:
+                return np.asarray(jax.device_get(s.data))[
+                    lo - start:hi - start].astype(np.float32, copy=False)
+        from ..utils.log import LightGBMError
+        raise LightGBMError(
+            f"streamed data-parallel assembly: rows {lo}:{hi} are not "
+            "addressable on this process (the gradient sharding does "
+            "not match the 'data' mesh row blocks)")
+
+    def _dp_stream_init(self, local_n: int, d_cols: int, cw: int):
+        """Per-device jit building one (local_n + CH, d_cols) u32
+        working buffer: gh words [g*w, h*w, w] + LOCAL row ids at column
+        cw, code section zeroed (chunk writes fill it). Float layout
+        only — create_tree_learner rejects quant x stream x data."""
+        jkey = ("dp_init", local_n, d_cols, cw)
+        fn = self._stream_jits.get(jkey)
+        if fn is None:
+            CH = int(self.chunk_rows)
+
+            def init(g, h, w):
+                gh_u = jax.lax.bitcast_convert_type(
+                    jnp.stack([g * w, h * w, w], axis=1), jnp.uint32)
+                ids = jnp.arange(local_n, dtype=jnp.uint32)[:, None]
+                tail = jnp.concatenate([gh_u, ids], axis=1)
+                buf = jnp.zeros((local_n + CH, d_cols), jnp.uint32)
+                return jax.lax.dynamic_update_slice(
+                    buf, tail, (jnp.int32(0), jnp.int32(cw)))
+
+            fn = jax.jit(init)
+            self._stream_jits[jkey] = fn
+        return fn
+
+    def _streamed_tree_fn(self):
+        """jitted shard_map'd prebuilt chunk-core program: each shard's
+        buffer already holds its own rows (codes + gh words), per-leaf
+        histogram psums over 'data' are the only cross-shard exchange."""
+        fn = getattr(self, "_stream_dp_fn", None)
+        if fn is not None:
+            return fn
+        from ..models.device_learner import grow_tree_chunk_core
+        statics = dict(self._grow_statics())
+        statics["scatter_cols"] = 0   # prebuilt runs the plain psum lane
+        statics["data_prebuilt"] = True
+        meta = self._meta
+        nn = self.dataset.num_data
+
+        def local(buf_l, g_l, h_l, w_l, base_mask, key):
+            dummy_row = jnp.zeros((1, 1), jnp.uint8)
+            rec, rec_cat, leaf_id, ks, tot = grow_tree_chunk_core(
+                buf_l, dummy_row, g_l, h_l, w_l, base_mask, *meta, key,
+                axis_name="data", **statics)
+            if rec_cat is None:
+                rec_cat = jnp.zeros((rec.shape[0], 1), jnp.float32)
+            return rec, rec_cat, leaf_id, ks, tot
+
+        smapped = shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P("data", None), P("data"), P("data"), P("data"),
+                      P(), P()),
+            out_specs=(P(), P(), P("data"), P(), P()), check_vma=False)
+
+        @jax.jit
+        def run(data0, g, h, w, mask, k):
+            rec, rec_cat, leaf_id, ks, tot = smapped(
+                data0, g, h, w, mask, k)
+            return rec, rec_cat, leaf_id[:nn], ks, tot
+
+        self._stream_dp_fn = run
+        return run
+
+    def _train_streamed(self, grad, hess, wv, base_mask, key):
+        """stream_mode=chunked x data-parallel: every local mesh device
+        gets its own (local_n + CH, d_cols) working buffer assembled
+        from the host wire store (with dist_shard_mode=rows the local
+        block IS everything this host stores), the per-device buffers
+        join into one row-sharded global array, and the chunk core runs
+        prebuilt under shard_map. The code matrix and the float rows
+        never cross hosts — per-leaf histogram psums are the only
+        cross-host bytes."""
+        from ..utils.log import LightGBMError
+        shard = self._shard
+        n = self.dataset.num_data
+        local_n = self.local_n
+        CH = int(self.chunk_rows)
+        cw = int(shard.code_words)
+        d_cols = cw + 3 + 1           # codes | g*w, h*w, w | row id
+        row_shard = getattr(self.dataset, "row_shard", None)
+        shard_begin = int(row_shard[0]) if row_shard is not None else 0
+        mine = self._local_mesh_positions()
+        shard.track_buffer("data0",
+                           len(mine) * (local_n + CH) * d_cols * 4)
+        bufs, g_parts, h_parts, w_parts = [], [], [], []
+        for p, dev in mine:
+            lo = p * local_n
+            hi = min(lo + local_n, n)
+            rows = max(hi - lo, 0)
+            gp = np.zeros(local_n, np.float32)
+            hp = np.zeros(local_n, np.float32)
+            if rows:
+                gp[:rows] = self._host_rows(grad, lo, hi)
+                hp[:rows] = self._host_rows(hess, lo, hi)
+            wp = np.asarray(wv[lo:lo + local_n], dtype=np.float32)
+            gj = jax.device_put(jnp.asarray(gp), dev)
+            hj = jax.device_put(jnp.asarray(hp), dev)
+            wj = jax.device_put(jnp.asarray(wp), dev)
+            buf = self._dp_stream_init(local_n, d_cols, cw)(gj, hj, wj)
+            if rows:
+                wire_lo = lo - shard_begin
+                if wire_lo < 0 or wire_lo + rows > shard.num_rows:
+                    raise LightGBMError(
+                        f"streamed assembly: mesh position {p} needs "
+                        f"global rows {lo}:{hi} but this host's wire "
+                        f"store holds rows {shard_begin}:"
+                        f"{shard_begin + shard.num_rows} — re-ingest "
+                        "(ingest.reshard) after any world-size change")
+                for s, cnt, dv in shard.iter_chunks(
+                        row_ids=np.arange(wire_lo, wire_lo + rows),
+                        device=dev):
+                    buf = self._stream_write(buf, dv, s)
+            bufs.append(buf)
+            g_parts.append(gj)
+            h_parts.append(hj)
+            w_parts.append(wj)
+        rsh = NamedSharding(self.mesh, P("data", None))
+        vsh = NamedSharding(self.mesh, P("data"))
+        mk = jax.make_array_from_single_device_arrays
+        data0 = mk((self.shards * (local_n + CH), d_cols), rsh, bufs)
+        gg = mk((self.n_pad,), vsh, g_parts)
+        hh = mk((self.n_pad,), vsh, h_parts)
+        ww = mk((self.n_pad,), vsh, w_parts)
+        try:
+            rec, rec_cat, leaf_id, n_splits, _ = self._streamed_tree_fn()(
+                data0, gg, hh, ww, base_mask, key)
+        finally:
+            shard.release_buffer("data0")
         self.last_leaf_id = leaf_id
         self._leaf_id_host = None
         if self._has_cat:
@@ -1131,15 +1406,65 @@ def create_tree_learner(config: Config, dataset: Dataset,
     host_only = os.environ.get("LGBM_TPU_HOST_LEARNER", "0") == "1"
     name = config.tree_learner
     stream = str(getattr(config, "stream_mode", "off") or "off")
+    rows_sharded = getattr(dataset, "row_shard", None) is not None
+    stream_matrix = (
+        "supported combinations: stream_mode=chunked|goss with "
+        "tree_learner=serial (any quant_bits), and stream_mode=chunked "
+        "with tree_learner=data (float path, quant_bits=0)")
+    if rows_sharded and name not in ("data", "data_parallel"):
+        raise LightGBMError(
+            "this dataset is row-sharded (dist_shard_mode=rows): each "
+            "host holds only its own row block, which only tree_learner"
+            "=data can train on (per-leaf histograms are the cross-host "
+            f"exchange); tree_learner={name} would silently train on a "
+            "fraction of the data — use tree_learner=data or "
+            "dist_shard_mode=replicated")
+    if rows_sharded and host_only:
+        raise LightGBMError(
+            "dist_shard_mode=rows is incompatible with "
+            "LGBM_TPU_HOST_LEARNER=1: the host-loop data-parallel "
+            "learner needs the full binned matrix on every rank")
     if stream != "off":
-        # streaming exists only in the serial device chunk learner; a
+        # streaming exists in the serial device chunk learner and (for
+        # the float chunked mode) the device data-parallel learner; a
         # silent fallback to a resident learner would defeat the whole
         # point of the mode, so misconfigurations fail loudly
+        if name in ("data", "data_parallel"):
+            if stream != "chunked":
+                raise LightGBMError(
+                    f"stream_mode={stream} with tree_learner={name} is "
+                    "not supported: the GOSS working-set compaction is "
+                    "a single-program optimisation with no sharded "
+                    f"counterpart; {stream_matrix}")
+            if config.quant_bits:
+                raise LightGBMError(
+                    f"quant_bits={config.quant_bits} with stream_mode="
+                    f"{stream} and tree_learner={name} is not "
+                    "supported: the streamed assembly derives "
+                    "quantization scales from local gradient maxima "
+                    "while the distributed resident core psums them "
+                    "globally, so the two would grow different trees; "
+                    f"set quant_bits=0 or stream_mode=off; "
+                    f"{stream_matrix}")
+            if host_only:
+                raise LightGBMError(
+                    f"stream_mode={stream} is incompatible with "
+                    "LGBM_TPU_HOST_LEARNER=1 (the host-loop learners "
+                    "have no streaming path)")
+            if not DeviceTreeLearner.supports(config, dataset,
+                                              strategy="chunk"):
+                raise LightGBMError(
+                    f"stream_mode={stream} with tree_learner={name} "
+                    "needs the device chunk learner but this config is "
+                    "unsupported by it (forced splits / CEGB / pool "
+                    "budget); fix the config or set stream_mode=off")
+            return DeviceDataParallelTreeLearner(config, dataset, mesh)
         if name not in ("serial",):
             raise LightGBMError(
-                f"stream_mode={stream} runs on the serial device "
-                f"learner only; tree_learner={name} has no streaming "
-                "path (drop stream_mode or use tree_learner=serial)")
+                f"stream_mode={stream} with tree_learner={name} has no "
+                "streaming path (the feature/voting learners shard or "
+                "elect by feature and need resident codes); "
+                f"{stream_matrix}")
         if host_only:
             raise LightGBMError(
                 f"stream_mode={stream} is incompatible with "
@@ -1175,6 +1500,12 @@ def create_tree_learner(config: Config, dataset: Dataset,
         if not host_only and DeviceTreeLearner.supports(
                 config, dataset, strategy="compact"):
             return DeviceDataParallelTreeLearner(config, dataset, mesh)
+        if rows_sharded:
+            raise LightGBMError(
+                "dist_shard_mode=rows needs the device data-parallel "
+                "learner, but this config is unsupported by it (forced "
+                "splits / CEGB / pool budget); fix the config or use "
+                "dist_shard_mode=replicated")
         return DataParallelTreeLearner(config, dataset, mesh)
     if name in ("voting", "voting_parallel"):
         # device PV-Tree needs the identity mapping and a feature count

@@ -13,9 +13,13 @@ Canonical phase names, so breakdowns from different paths diff cleanly:
     boost_avg   gradient   quantize   bagging    hist      split
     partition   grow_dispatch         host_sync  tree_replay
     score_update            sentry    collective eval      stream_wait
+    dist_hist_exchange
 
 `stream_wait` is the out-of-core pipeline's blocking H2D residue
 (io/stream.py): near-zero means the double buffer hid the transfers.
+`dist_hist_exchange` brackets the host-loop data-parallel/voting
+histogram allreduce — in row-sharded pods it is the ONLY cross-host
+traffic inside an iteration, so its share of wall is the network bill.
 
 One program can fuse several (the device learners grow the whole tree in
 one dispatch — that is `grow_dispatch`, and the blocking record fetch is

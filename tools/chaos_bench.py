@@ -106,19 +106,23 @@ def measure_overhead(x, y, k=None):
 
 
 # -- dist_kill scenario -------------------------------------------------
-# two-process elastic-training probe; rank semantics in the worker:
-#   0 / 1  — the supervised pair (rank 1 installs kill_rank@iter=3)
-#   -1     — the single-host baseline resuming from the same checkpoint
+# elastic-training kill probe; rank semantics in the worker:
+#   0 .. world-1 — the supervised group (the LAST rank installs
+#                  kill_rank@iter=kill_iter)
+#   -1           — the baseline resuming from the same checkpoint on a
+#                  virtual mesh sized like the post-shrink group (the
+#                  caller sets --xla_force_host_platform_device_count)
 _KILL_WORKER = r"""
 import json, os, sys, time
 import numpy as np
 rank = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
 ckpt_dir = sys.argv[4]; kill_iter = int(sys.argv[5])
 N, F, ITERS, LEAVES = (int(v) for v in sys.argv[6:10])
+world = int(sys.argv[10]); shard_mode = sys.argv[11]
 import jax
 from lightgbm_tpu.distributed import bootstrap, ingest, supervisor
 if rank >= 0:
-    bootstrap.initialize(f"127.0.0.1:{port}", 2, rank, supervise=True)
+    bootstrap.initialize(f"127.0.0.1:{port}", world, rank, supervise=True)
     supervisor.start_supervision(heartbeat_ms=100,
                                  collective_timeout_ms=30000)
 import lightgbm_tpu as lgb
@@ -132,15 +136,18 @@ x = r.randn(N, F)
 y = (1.5 * x[:, 0] - x[:, 1] + r.randn(N) * 0.5 > 0).astype(np.float64)
 params = {"objective": "binary", "num_leaves": LEAVES, "verbosity": -1,
           "max_bin": 63, "min_data_in_leaf": 20, "tree_learner": "data",
-          "metric": "none", "on_rank_failure": "shrink"}
+          "metric": "none", "on_rank_failure": "shrink",
+          "dist_shard_mode": shard_mode}
 if rank < 0:
-    # baseline: fresh single-host train resumed from the SAME checkpoint
-    src = os.path.join(ckpt_dir, sys.argv[10])
+    # baseline: fresh train resumed from the SAME checkpoint on a
+    # virtual mesh with as many devices as the post-shrink group has —
+    # same mesh shape => bit-identical continuation
+    src = os.path.join(ckpt_dir, sys.argv[12])
     bst = engine.train(dict(params), lgb.Dataset(x, y),
                        num_boost_round=ITERS, verbose_eval=False,
                        resume_from=src)
 else:
-    if rank == 1:
+    if rank == world - 1:
         faults.install(f"kill_rank@iter={kill_iter}")
     ds = ingest.wrap_train_set(ingest.load_sharded(x, label=y,
                                                    params=params))
@@ -150,6 +157,7 @@ else:
                                              checkpoint_freq=2)])
 payload = {"model": bst.model_to_string(),
            "shrinks": counters.get("shrinks"),
+           "world_after": bootstrap.process_count(),
            "rank_failures": counters.get("rank_failures"),
            "heartbeat_probes": counters.get("heartbeat_probes"),
            "shrink_unix": counters.get("last_shrink_unix")}
@@ -158,8 +166,12 @@ with open(out, "w") as fh:
 """
 
 
-def dist_kill_main():
-    """Two-process kill scenario; emits one `dist_kill` JSON line."""
+def _kill_scenario(world, shard_mode):
+    """One kill-and-continue measurement: `world` supervised processes,
+    the last rank dies mid-run, the survivors shrink to world-1 and
+    finish the boosting budget; the baseline resumes the same
+    checkpoint on a (world-1)-device virtual mesh. Returns the JSON
+    payload dict."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import subprocess
     import dist_smoke                           # noqa: E402 — plumbing
@@ -179,49 +191,74 @@ def dist_kill_main():
         env["PYTHONPATH"] = (dist_smoke.REPO + os.pathsep
                              + env.get("PYTHONPATH", ""))
         env["XLA_FLAGS"] = ""            # 1 device per process
-        outs = [os.path.join(tmp, f"r{i}.json") for i in range(2)]
-        args = [ckpt_dir, kill_iter, n, f, iters, leaves]
+        outs = [os.path.join(tmp, f"r{i}.json") for i in range(world)]
+        args = [ckpt_dir, kill_iter, n, f, iters, leaves, world,
+                shard_mode]
         procs = [subprocess.Popen(
             [sys.executable, script, str(r), str(port), outs[r]]
             + [str(a) for a in args],
             env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
-            text=True) for r in range(2)]
+            text=True) for r in range(world)]
         # the victim's observed exit stamps t_kill for detection latency
+        victim = procs[world - 1]
         t_kill = None
         while t_kill is None:
-            if procs[1].poll() is not None:
+            if victim.poll() is not None:
                 t_kill = time.time()
             else:
                 time.sleep(0.002)
-        _, err0 = procs[0].communicate(timeout=600)
-        procs[1].communicate(timeout=60)
-        if procs[0].returncode != 0:
-            raise RuntimeError(f"survivor failed:\n{err0[-3000:]}")
-        kill_code = procs[1].returncode
+        errs = []
+        for p in procs[:-1]:
+            _, err = p.communicate(timeout=600)
+            errs.append(err)
+        victim.communicate(timeout=60)
+        for i, p in enumerate(procs[:-1]):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"survivor {i} failed:\n{errs[i][-3000:]}")
+        kill_code = victim.returncode
         with open(outs[0]) as fh:
             r0 = json.load(fh)
-        # baseline: resume single-host from the checkpoint the recovery
-        # used — the newest one at kill time (kill at iteration
-        # `kill_iter`, freq 2 => iteration kill_iter - 1)
+        # baseline: resume from the checkpoint the recovery used — the
+        # newest one at kill time (kill at iteration `kill_iter`,
+        # freq 2 => iteration kill_iter - 1) — on world-1 devices
         ckpt_name = f"ckpt_iter_{kill_iter - 1:07d}.ckpt"
+        envb = dict(env)
+        if world > 2:
+            envb["XLA_FLAGS"] = ("--xla_force_host_platform_device_count"
+                                 f"={world - 1}")
         vout = os.path.join(tmp, "baseline.json")
-        dist_smoke._run(script, [-1, 0, vout] + args + [ckpt_name], env)
+        dist_smoke._run(script, [-1, 0, vout] + args + [ckpt_name], envb)
         with open(vout) as fh:
             base = json.load(fh)
     detect_ms = (None if not r0.get("shrink_unix") else
                  round((r0["shrink_unix"] - t_kill) * 1e3, 1))
-    print(json.dumps({
-        "dist_kill": {
-            "rows": n, "features": f, "iters": iters,
-            "kill_iter": kill_iter, "kill_code": kill_code,
-            "detection_ms": detect_ms,
-            "recovered": bool(r0.get("shrinks") == 1 and r0["model"]),
-            "rank_failures": int(r0.get("rank_failures", 0)),
-            "heartbeat_probes": int(r0.get("heartbeat_probes", 0)),
-            "parity_vs_single_host_resume":
-                bool(r0["model"] == base["model"]),
-            "wall_secs": round(time.time() - t0, 1),
-        }}))
+    return {
+        "rows": n, "features": f, "iters": iters,
+        "world": world, "survivors": world - 1,
+        "shard_mode": shard_mode,
+        "kill_iter": kill_iter, "kill_code": kill_code,
+        "detection_ms": detect_ms,
+        "recovered": bool(r0.get("shrinks") == 1 and r0["model"]
+                          and int(r0.get("world_after", 0)) == world - 1),
+        "rank_failures": int(r0.get("rank_failures", 0)),
+        "heartbeat_probes": int(r0.get("heartbeat_probes", 0)),
+        "parity_vs_resume": bool(r0["model"] == base["model"]),
+        "wall_secs": round(time.time() - t0, 1),
+    }
+
+
+def dist_kill_main():
+    """Kill scenarios, one JSON line each: the 2-process shrink-to-
+    single-host path (`dist_kill`) and the 3-process rows-sharded
+    N-1 path (`dist_kill_n1`: survivors re-form a 2-process group
+    in-process and `ingest.reshard` redistributes the dead rank's
+    rows). CHAOS_DIST_WORLDS=2 skips the 3-process scenario."""
+    two = _kill_scenario(2, "replicated")
+    two["parity_vs_single_host_resume"] = two.pop("parity_vs_resume")
+    print(json.dumps({"dist_kill": two}))
+    if os.environ.get("CHAOS_DIST_WORLDS", "3") != "2":
+        print(json.dumps({"dist_kill_n1": _kill_scenario(3, "rows")}))
 
 
 def main():
