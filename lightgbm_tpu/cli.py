@@ -211,9 +211,23 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
             telemetry.dump_trace(trace_path)
             log.info("telemetry trace written to %s", trace_path)
     from .distributed import bootstrap as dist
+    # drift baseline: computed on every rank (the score fetch may be a
+    # collective on a sharded mesh), written by rank 0 as a sidecar so
+    # serving can judge served traffic against the training data
+    baseline = None
+    try:
+        baseline = booster._gbdt.drift_baseline()
+    except Exception as exc:   # noqa: BLE001 — baseline is best-effort
+        log.warning("drift baseline capture failed: %s", exc)
     if dist.rank() == 0:
         booster.save_model(cfg.output_model)
         log.info("Model saved to %s", cfg.output_model)
+        if baseline:
+            from .serving.drift import save_baseline
+            sidecar = save_baseline(baseline,
+                                    cfg.output_model + ".drift.json")
+            log.info("Drift baseline saved to %s (%d features)",
+                     sidecar, len(baseline.get("features", [])))
     else:
         log.info("rank %d: model output is rank-0 work", dist.rank())
 
@@ -287,7 +301,12 @@ def _serve(params: Dict[str, str], block: bool = True):
     serve_export_cache (bool or explicit dir — persist compiled
     executables next to the model for zero-compile restarts),
     serve_placement (``auto`` or ``version=ordinal,...`` device pins),
-    serve_predictor_cache_entries (LRU bound, 0 = unbounded).
+    serve_predictor_cache_entries (LRU bound, 0 = unbounded),
+    serve_slo_p99_ms / serve_slo_error_rate (burn-rate SLOs — either
+    non-zero arms the monitor), serve_trace_sample (request-trace
+    sampling rate; env LGBM_TPU_TRACE_SAMPLE wins when set),
+    drift_psi_threshold (PSI alarm level when the model ships a
+    ``.drift.json`` baseline sidecar).
     """
     from .serving import ModelRegistry, PredictorCache, ServingApp, \
         run_http_server
@@ -314,8 +333,20 @@ def _serve(params: Dict[str, str], block: bool = True):
     registry = ModelRegistry(
         predictor=PredictorCache(max_entries=max_entries),
         warm_buckets=warm, export_cache=export_cache, placement=placement)
+    slo = None
+    slo_p99 = float(params.get("serve_slo_p99_ms", 0.0) or 0.0)
+    slo_err = float(params.get("serve_slo_error_rate", 0.0) or 0.0)
+    if slo_p99 > 0.0 or slo_err > 0.0:
+        from .serving.slo import SloMonitor
+        slo = SloMonitor(p99_ms=slo_p99, error_rate=slo_err)
+    from .serving import trace as serve_trace
+    if os.environ.get("LGBM_TPU_TRACE_SAMPLE", "").strip():
+        serve_trace.configure()           # env wins over the param
+    elif "serve_trace_sample" in params:
+        serve_trace.configure(float(params["serve_trace_sample"]))
     app = ServingApp(
         registry,
+        slo=slo,
         max_batch=int(params.get("serve_max_batch", 256)),
         max_delay_ms=float(params.get("serve_max_delay_ms", 2.0)),
         max_queue_rows=int(params.get("serve_queue_rows", 4096)),
@@ -323,6 +354,14 @@ def _serve(params: Dict[str, str], block: bool = True):
     t0 = time.time()
     version = registry.load(model_file)
     app.router.set_stable(version)
+    baseline = registry.drift_baselines.get(version)
+    if baseline is not None:
+        from .serving.drift import DriftMonitor
+        thr = params.get("drift_psi_threshold")
+        app.drift = DriftMonitor(
+            baseline, threshold=(float(thr) if thr is not None else None))
+        log.info("Drift monitor armed (threshold %.3f, %d features)",
+                 app.drift.threshold, len(baseline.get("features", [])))
     log.info("Loaded + warmed model %s in %.3f seconds (buckets %s%s)",
              version, time.time() - t0, warm,
              ", export cache on" if export_cache else "")

@@ -29,8 +29,15 @@ Promotion gate (evaluated per request, O(dict reads)):
 * no watchdog fire since deploy (`telemetry.counters` watchdog_fires).
 
 Demotion fires immediately — before min_requests — on an absolute
-error burst (`demote_errors`) or a watchdog fire: a bleeding canary is
-cut, not averaged out.
+error burst (`demote_errors`), a watchdog fire, or (when an SLO
+monitor is attached via `slo=`) a fast-window SLO burn on the canary's
+own latency/error series: a bleeding canary is cut, not averaged out.
+
+Every transition (stable/deploy/promote/demote) is recorded in a
+bounded audit log together with the exact gate snapshot — the counter
+deltas and thresholds the decision was made on — queryable via
+`audit_snapshot()` (`GET /router/audit` over HTTP) and attached to the
+router_promote/router_demote events for `tools/run_report.py`.
 
 Both routed versions are pinned in the predictor cache for as long as
 they hold a slot (ModelRegistry.pin_version), so LRU eviction under
@@ -59,15 +66,18 @@ class RouterState:
 class CanaryRouter:
     """Per-request version routing over a ModelRegistry + ServingStats."""
 
+    AUDIT_MAX = 200
+
     def __init__(self, registry, stats, min_requests: int = 50,
                  max_error_rate: float = 0.02, p99_ratio: float = 3.0,
-                 demote_errors: int = 3):
+                 demote_errors: int = 3, slo=None):
         self.registry = registry
         self.stats = stats
         self.min_requests = int(min_requests)
         self.max_error_rate = float(max_error_rate)
         self.p99_ratio = float(p99_ratio)
         self.demote_errors = int(demote_errors)
+        self.slo = slo                      # optional serving.slo.SloMonitor
         self._lock = threading.Lock()
         self._stable: Optional[str] = None
         self._canary: Optional[str] = None
@@ -77,6 +87,8 @@ class CanaryRouter:
         self._canary_routed = 0
         self._baseline: Dict[str, float] = {}
         self.history: List[dict] = []
+        self.audit: List[dict] = []
+        self._last_eval: Optional[dict] = None
 
     # -- configuration ---------------------------------------------------
     def set_stable(self, version: str) -> None:
@@ -84,6 +96,7 @@ class CanaryRouter:
         with self._lock:
             previous = self._stable
             self._stable = version
+            self._audit_locked("stable", version, previous=previous)
         self.registry.pin_version(version)
         if previous and previous != version:
             self.registry.unpin_version(previous)
@@ -113,6 +126,8 @@ class CanaryRouter:
             self._baseline = self._counters_for(version)
             self._baseline["watchdog_fires"] = telem_counters.get(
                 "watchdog_fires")
+            self._audit_locked("deploy", version, weight=weight,
+                               shadow=shadow)
         self.registry.pin_version(version)
         telem_counters.set_gauge("router_canary_weight",
                                  0.0 if shadow else weight)
@@ -168,48 +183,90 @@ class CanaryRouter:
         lat = snap.get("latency") or {}
         return float(lat.get("p99_ms", 0.0))
 
+    def _gate_snapshot(self, canary: str, stable: Optional[str],
+                       baseline: dict) -> dict:
+        """The exact evidence one evaluate() decides on: counter deltas
+        since deploy, both p99s, the SLO verdict, and the thresholds in
+        force. One snapshot per evaluation — the audit log and the
+        router_* events carry it verbatim."""
+        now = self._counters_for(canary)
+        requests = now["requests"] - baseline.get("requests", 0)
+        errors = now["errors"] - baseline.get("errors", 0)
+        gate = {"canary": canary, "stable": stable,
+                "requests": int(requests), "errors": int(errors),
+                "error_rate": (round(errors / requests, 6)
+                               if requests > 0 else 0.0),
+                "canary_p99_ms": round(self._p99_ms(canary), 3),
+                "stable_p99_ms": (round(self._p99_ms(stable), 3)
+                                  if stable else 0.0),
+                "watchdog_fires": int(
+                    telem_counters.get("watchdog_fires")
+                    - baseline.get("watchdog_fires", 0)),
+                "thresholds": {"min_requests": self.min_requests,
+                               "max_error_rate": self.max_error_rate,
+                               "p99_ratio": self.p99_ratio,
+                               "demote_errors": self.demote_errors}}
+        if self.slo is not None:
+            gate["slo_violation"] = self.slo.version_violation(canary)
+        return gate
+
     def evaluate(self) -> str:
         """Apply the state machine once: returns "promoted", "demoted",
         or "hold". Called per request by the serving app (cheap) or on a
         timer by embedders."""
         with self._lock:
             canary = self._canary
+            stable = self._stable
             baseline = dict(self._baseline)
         if canary is None:
             return "hold"
-        if telem_counters.get("watchdog_fires") > \
-                baseline.get("watchdog_fires", 0):
-            self.demote("watchdog_fire", missing_ok=True)
+        gate = self._gate_snapshot(canary, stable, baseline)
+
+        def _hold() -> str:
+            with self._lock:
+                self._last_eval = {"result": "hold", "t": time.time(),
+                                   "gate": gate}
+            return "hold"
+
+        if gate["watchdog_fires"] > 0:
+            self.demote("watchdog_fire", missing_ok=True, gate=gate)
             return "demoted"
-        now = self._counters_for(canary)
-        requests = now["requests"] - baseline["requests"]
-        errors = now["errors"] - baseline["errors"]
+        requests, errors = gate["requests"], gate["errors"]
         if errors >= self.demote_errors:
             self.demote(f"error_spike ({int(errors)} errors in "
-                        f"{int(requests)} requests)", missing_ok=True)
+                        f"{int(requests)} requests)", missing_ok=True,
+                        gate=gate)
+            return "demoted"
+        slo_reason = gate.get("slo_violation")
+        if slo_reason:
+            self.demote(f"slo_burn ({slo_reason})", missing_ok=True,
+                        gate=gate)
             return "demoted"
         if requests < self.min_requests:
-            return "hold"
+            return _hold()
         if requests > 0 and errors / requests > self.max_error_rate:
             self.demote(f"error_rate {errors / requests:.3f}",
-                        missing_ok=True)
+                        missing_ok=True, gate=gate)
             return "demoted"
-        stable_p99 = self._p99_ms(self.stable) if self.stable else 0.0
-        canary_p99 = self._p99_ms(canary)
+        stable_p99 = gate["stable_p99_ms"]
+        canary_p99 = gate["canary_p99_ms"]
         if stable_p99 > 0 and canary_p99 > self.p99_ratio * stable_p99:
             self.demote(f"p99 {canary_p99:.1f}ms > {self.p99_ratio:g}x "
-                        f"stable {stable_p99:.1f}ms", missing_ok=True)
+                        f"stable {stable_p99:.1f}ms", missing_ok=True,
+                        gate=gate)
             return "demoted"
-        self.promote(missing_ok=True)
+        self.promote(missing_ok=True, gate=gate)
         return "promoted"
 
     # -- transitions -----------------------------------------------------
-    def promote(self, missing_ok: bool = False) -> None:
+    def promote(self, missing_ok: bool = False,
+                gate: Optional[dict] = None) -> None:
         """Canary becomes stable; the old stable is unpinned (it stays
         loaded in the registry for instant rollback until unload).
         `missing_ok` is the auto-transition path: concurrent evaluate()
         calls may race to the same verdict, and the loser finds the slot
-        already empty — a no-op, not an error."""
+        already empty — a no-op, not an error. `gate` is the evaluation
+        snapshot that justified an auto-promotion (None = forced)."""
         with self._lock:
             canary, old_stable = self._canary, self._stable
             if canary is None:
@@ -219,16 +276,18 @@ class CanaryRouter:
             self._stable, self._canary = canary, None
             self._weight, self._shadow = 0.0, False
             self._record_locked("promote", canary, old=old_stable)
+            self._audit_locked("promote", canary, old=old_stable,
+                               gate=gate)
         if old_stable and old_stable != canary:
             self.registry.unpin_version(old_stable)
         telem_counters.incr("router_promotions")
         telem_counters.set_gauge("router_canary_weight", 0.0)
         telem_events.emit("router_promote", version=canary,
-                          previous=old_stable)
+                          previous=old_stable, gate=gate)
         log.info("router: promoted %s (was %s)", canary, old_stable)
 
-    def demote(self, reason: str = "manual",
-               missing_ok: bool = False) -> None:
+    def demote(self, reason: str = "manual", missing_ok: bool = False,
+               gate: Optional[dict] = None) -> None:
         """Cut the canary: all traffic back to stable, pin released."""
         with self._lock:
             canary = self._canary
@@ -239,15 +298,23 @@ class CanaryRouter:
             self._canary = None
             self._weight, self._shadow = 0.0, False
             self._record_locked("demote", canary, reason=reason)
+            self._audit_locked("demote", canary, reason=reason, gate=gate)
         self.registry.unpin_version(canary)
         telem_counters.incr("router_demotions")
         telem_counters.set_gauge("router_canary_weight", 0.0)
-        telem_events.emit("router_demote", version=canary, reason=reason)
+        telem_events.emit("router_demote", version=canary, reason=reason,
+                          gate=gate)
         log.warning("router: demoted %s (%s)", canary, reason)
 
     def _record_locked(self, action: str, version: str, **detail) -> None:
         self.history.append({"action": action, "version": version,
                              "t": time.time(), **detail})
+
+    def _audit_locked(self, action: str, version: str, **detail) -> None:
+        self.audit.append({"action": action, "version": version,
+                           "t": time.time(), **detail})
+        if len(self.audit) > self.AUDIT_MAX:
+            del self.audit[:len(self.audit) - self.AUDIT_MAX]
 
     # -- introspection ---------------------------------------------------
     def snapshot(self) -> dict:
@@ -263,3 +330,13 @@ class CanaryRouter:
                     "max_error_rate": self.max_error_rate,
                     "p99_ratio": self.p99_ratio,
                     "history": list(self.history[-20:])}
+
+    def audit_snapshot(self, limit: int = 100) -> dict:
+        """The decision log (GET /router/audit): every recorded
+        transition with the gate snapshot it was decided on, plus the
+        most recent "hold" evaluation so a stuck canary is explainable
+        before any transition happens."""
+        with self._lock:
+            last = dict(self._last_eval) if self._last_eval else None
+            return {"decisions": list(self.audit[-int(limit):]),
+                    "last_evaluation": last}

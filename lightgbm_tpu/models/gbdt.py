@@ -986,6 +986,31 @@ class GBDT:
                     leaf, decay_rate * old
                     + (1.0 - decay_rate) * out * self.shrinkage_rate)
 
+    # -- serving drift baseline (serving/drift.py) ---------------------
+    def drift_baseline(self) -> Optional[Dict[str, Any]]:
+        """Training-time drift baseline for serving: per-feature bin
+        occupancy over the train set plus the *converted* train-score
+        distribution (the same objective transform serving applies by
+        default, so served predictions are directly comparable).
+        Cached after the first call; None for model-only boosters (no
+        train_set to baseline). The model text never changes — the CLI
+        writes this to a ``<model>.drift.json`` sidecar."""
+        if getattr(self, "train_set", None) is None \
+                or getattr(self, "score_updater", None) is None:
+            return None
+        cached = getattr(self, "_drift_baseline", None)
+        if cached is not None:
+            return cached
+        from ..serving import drift as serve_drift
+        raw = _host_global(self.score_updater.score)   # (num_class, n)
+        scores = raw
+        if raw is not None and self.objective is not None:
+            scores = np.asarray(jax.device_get(
+                self.objective.convert_output(jnp.asarray(raw))))
+        self._drift_baseline = serve_drift.compute_baseline(
+            self.train_set, scores=scores)
+        return self._drift_baseline
+
     # -- training-state capture/restore (resilience/checkpoint.py) -----
     def capture_state(self) -> Dict[str, Any]:
         """Live training state beyond the model text: everything a
@@ -1018,6 +1043,11 @@ class GBDT:
         stream = getattr(self.learner, "stream_state", lambda: None)()
         if stream is not None:
             st["stream"] = stream
+        # serving drift baseline rides the checkpoint once computed
+        # (cheap: it is a small dict of occupancy vectors) — a restore
+        # can hand it straight to the serving registry
+        if getattr(self, "_drift_baseline", None) is not None:
+            st["drift_baseline"] = self._drift_baseline
         return st
 
     def restore_state(self, st: Dict[str, Any]) -> None:
@@ -1065,6 +1095,8 @@ class GBDT:
         if st.get("stream") is not None and hasattr(
                 self.learner, "load_stream_state"):
             self.learner.load_stream_state(st["stream"])
+        if isinstance(st.get("drift_baseline"), dict):
+            self._drift_baseline = st["drift_baseline"]
         self._last_leaf_ids.clear()
         self._last_leaf_ids_iter = -1
         self.invalidate_ensemble_cache()

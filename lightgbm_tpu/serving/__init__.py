@@ -10,6 +10,9 @@ Layers (each usable on its own):
 * `server` — in-process API + stdlib JSON-over-HTTP front end, with
   the fleet canary router on the un-versioned request path
 * `stats` — request counters and latency histograms
+* `trace` — sampled per-request span traces (X-Request-Id propagation)
+* `slo` — dual-window p99/error-rate burn-rate monitor
+* `drift` — training-baseline vs served-traffic PSI drift monitor
 
 The fleet control plane (persistent compiled-predictor cache,
 multi-model placement, canary/shadow router) lives in
@@ -25,13 +28,16 @@ Quick start::
 or over HTTP: ``python -m lightgbm_tpu task=serve input_model=model.txt``.
 """
 from .batcher import MicroBatcher, OverloadedError, RequestTimeout
+from .drift import DriftMonitor
 from .predictor import PredictorCache, PreparedModel
 from .registry import ModelNotFound, ModelRegistry
 from .server import ServingApp, make_http_server, run_http_server
+from .slo import SloMonitor
 from .stats import LatencyHistogram, ServingStats
 
 __all__ = [
     "MicroBatcher", "OverloadedError", "RequestTimeout",
+    "DriftMonitor", "SloMonitor",
     "PredictorCache", "PreparedModel",
     "ModelNotFound", "ModelRegistry",
     "ServingApp", "make_http_server", "run_http_server",

@@ -46,9 +46,9 @@ class _Pending:
     """One queued request; waiters block on `event`."""
 
     __slots__ = ("x", "n", "version", "raw_score", "t_enqueue", "deadline",
-                 "event", "result", "result_version", "error")
+                 "event", "result", "result_version", "error", "trace")
 
-    def __init__(self, x, version, raw_score, timeout_s):
+    def __init__(self, x, version, raw_score, timeout_s, trace=None):
         now = time.monotonic()
         self.x = x
         self.n = x.shape[0]
@@ -60,6 +60,10 @@ class _Pending:
         self.result = None
         self.result_version = None
         self.error = None
+        # sampled request timeline (serving.trace.Trace | None): rides
+        # the item because the flush worker emits the batcher/predictor
+        # spans from its own thread
+        self.trace = trace
 
     def finish(self, result=None, version=None, error=None):
         self.result = result
@@ -107,11 +111,12 @@ class MicroBatcher:
     # -- client side ----------------------------------------------------
     def submit(self, rows, version: Optional[str] = None,
                raw_score: bool = False,
-               timeout_ms: Optional[float] = None
-               ) -> Tuple[np.ndarray, str]:
+               timeout_ms: Optional[float] = None,
+               trace=None) -> Tuple[np.ndarray, str]:
         """Blocking predict through the batch queue. Returns
         (scores (N, num_class), model version used)."""
-        handles = self.submit_async(rows, version, raw_score, timeout_ms)
+        handles = self.submit_async(rows, version, raw_score, timeout_ms,
+                                    trace=trace)
         timeout_s = (self.default_timeout_s if timeout_ms is None
                      else timeout_ms / 1e3)
         # grace on top of the request deadline: expiry is reported by the
@@ -125,7 +130,8 @@ class MicroBatcher:
 
     def submit_async(self, rows, version: Optional[str] = None,
                      raw_score: bool = False,
-                     timeout_ms: Optional[float] = None) -> List[_Pending]:
+                     timeout_ms: Optional[float] = None,
+                     trace=None) -> List[_Pending]:
         """Enqueue without blocking for the result; returns the pending
         handles (one per <=max_batch chunk, in row order)."""
         x = np.ascontiguousarray(np.asarray(rows, dtype=np.float32))
@@ -156,7 +162,8 @@ class MicroBatcher:
                     f"queue full ({self._queued_rows} rows queued, "
                     f"cap {self.max_queue_rows})")
             for chunk in chunks:
-                item = _Pending(chunk, concrete, raw_score, timeout_s)
+                item = _Pending(chunk, concrete, raw_score, timeout_s,
+                                trace=trace)
                 self._queue.append(item)
                 self._queued_rows += chunk.shape[0]
                 handles.append(item)
@@ -227,7 +234,8 @@ class MicroBatcher:
             faults.request_point(version)
             model = self.registry.get(version)
             out = self.registry.predictor.predict(model, x, raw_score)
-            self.stats.observe("serve_batch_exec", time.monotonic() - t0)
+            exec_s = time.monotonic() - t0
+            self.stats.observe("serve_batch_exec", exec_s)
             self.stats.incr("serve_batches")
             self.stats.incr("serve_rows", x.shape[0])
         except Exception as exc:   # noqa: BLE001 — propagate to waiters
@@ -239,6 +247,16 @@ class MicroBatcher:
             return x.shape[0]
         off = 0
         for item in live:
+            if item.trace is not None:
+                # batcher span = queue wait; predictor span = this
+                # item's share of the device execute (whole-batch time,
+                # batch context attached so amortization is visible)
+                item.trace.span("batcher", now - item.t_enqueue,
+                                rows=item.n, batch_requests=len(live),
+                                version=version)
+                item.trace.span("predictor", exec_s,
+                                rows=item.n, batch_rows=x.shape[0],
+                                version=version)
             item.finish(result=out[off:off + item.n], version=version)
             off += item.n
         return x.shape[0]

@@ -46,6 +46,10 @@ class ModelRegistry:
         self._latest: Optional[str] = None
         self._pinned_versions: Dict[str, tuple] = {}
         self._version_counter = itertools.count(1)
+        # version -> training-time drift baseline (serving.drift),
+        # auto-discovered from a <model>.drift.json sidecar or the
+        # booster's cached baseline at load()
+        self.drift_baselines: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     def load(self, source, version: Optional[str] = None,
@@ -92,14 +96,31 @@ class ModelRegistry:
                     warm_s=round(time.monotonic() - t0, 6))
             if self.export_cache is not None:
                 self.export_cache.save(prepared, self.predictor)
+        baseline = self._discover_drift_baseline(source)
         with self._lock:
             previous = self._latest
             self._models[ver] = prepared
             self._latest = ver
+            if baseline is not None:
+                self.drift_baselines[ver] = baseline
         telem_events.emit("serve_swap", version=ver, previous=previous)
         log.info("serving: loaded model %s (%d trees, %d features)",
                  ver, prepared.n_trees, prepared.num_features)
         return ver
+
+    def _discover_drift_baseline(self, source) -> Optional[dict]:
+        """Find the training-time drift baseline that rode along with
+        `source`: a ``<path>.drift.json`` sidecar when loading from a
+        model file, or the baseline cached on a live Booster/GBDT."""
+        import os
+        from . import drift as serve_drift
+        if isinstance(source, str) and "\n" not in source \
+                and "Tree=" not in source and os.path.exists(
+                    source + ".drift.json"):
+            return serve_drift.load_baseline(source + ".drift.json")
+        gbdt = (source._gbdt if hasattr(source, "_gbdt") else source)
+        cached = getattr(gbdt, "_drift_baseline", None)
+        return cached if isinstance(cached, dict) else None
 
     def _to_gbdt(self, source):
         if hasattr(source, "_gbdt"):           # Booster
@@ -131,6 +152,7 @@ class ModelRegistry:
             if version not in self._models:
                 raise ModelNotFound(f"unknown model version {version!r}")
             del self._models[version]
+            self.drift_baselines.pop(version, None)
             if self._latest == version:
                 self._latest = (max(self._models) if self._models else None)
         self.unpin_version(version)

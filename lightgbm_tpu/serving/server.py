@@ -8,7 +8,10 @@ Endpoints:
 
 * ``POST /predict``  {"rows": [[...], ...], "raw_score": false,
   "version": "v1" | "latest", "timeout_ms": 100} ->
-  {"predictions": [...], "version": "v1", "num_rows": N}
+  {"predictions": [...], "version": "v1", "num_rows": N}; an incoming
+  ``X-Request-Id`` header is honored (else generated) and always
+  echoed back — sampled requests additionally emit a linked
+  trace_span timeline (serving/trace.py)
 * ``GET  /stats``    counters + latency histograms (p50/p95/p99) +
   compiled-predictor cache info
 * ``GET  /metrics``  the same counters in Prometheus text format, plus
@@ -21,6 +24,8 @@ Endpoints:
   ``status=ok`` when routable, 503 with ``status=draining``/
   ``degraded`` during graceful shutdown or after a dead batcher worker
 * ``GET  /router``   canary router state (stable/canary/weight/history)
+* ``GET  /router/audit``  the router decision log: every transition
+  with the exact gate snapshot that justified it
 * ``POST /router``   {"action": "stable"|"deploy"|"promote"|"demote"
   [, "version", "weight", "shadow"]} — drive the canary state machine
 * ``POST /drain``    graceful drain for rolling restarts: stop
@@ -36,6 +41,7 @@ from typing import Optional
 
 from ..fleet.router import CanaryRouter
 from ..utils import log
+from . import trace as serve_trace
 from .batcher import MicroBatcher, OverloadedError, RequestTimeout
 from .registry import ModelNotFound, ModelRegistry
 from .stats import ServingStats
@@ -49,42 +55,68 @@ class ServingApp:
     """Transport-agnostic serving facade: registry + batcher + stats +
     canary router. The router is idle (pass-through to `latest`) until a
     stable version is installed via `POST /router {"action":
-    "stable"}` or `app.router.set_stable`."""
+    "stable"}` or `app.router.set_stable`.
+
+    Optional observability attachments: `slo` (serving.slo.SloMonitor —
+    folds into /healthz, /metrics and the router's demotion gate) and
+    `drift` (serving.drift.DriftMonitor — windows served traffic
+    against the model's training baseline)."""
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  batcher: Optional[MicroBatcher] = None,
                  stats: Optional[ServingStats] = None,
                  router: Optional[CanaryRouter] = None,
+                 slo=None, drift=None,
                  **batcher_kwargs):
         self.registry = registry or ModelRegistry()
         self.stats = stats or ServingStats()
         self.batcher = batcher or MicroBatcher(
             self.registry, stats=self.stats, **batcher_kwargs)
-        self.router = router or CanaryRouter(self.registry, self.stats)
+        self.slo = slo
+        self.drift = drift
+        self.router = router or CanaryRouter(self.registry, self.stats,
+                                             slo=slo)
+        if slo is not None and getattr(self.router, "slo", None) is None:
+            self.router.slo = slo
 
     # ------------------------------------------------------------------
-    def predict(self, payload: dict) -> dict:
+    def predict(self, payload: dict,
+                request_id: Optional[str] = None) -> dict:
         rows = payload.get("rows")
         if rows is None:
             raise BadRequest("missing 'rows'")
         raw_score = bool(payload.get("raw_score", False))
         version = payload.get("version")
+        # sampled per-request timeline (None when sampled out / tracing
+        # off); the request id itself is handled by the HTTP layer so
+        # the response header exists whether or not this is sampled
+        trace = serve_trace.start(request_id or payload.get("request_id"))
         # an explicit version tag bypasses the router (debugging, shadow
         # replay); everything else is routed stable/canary per weight
         routed = version is None and self.router.active
         if routed:
+            t_route = time.monotonic()
             version = self.router.route()
+            if trace is not None:
+                trace.span("router", time.monotonic() - t_route,
+                           version=version)
         t0 = time.monotonic()
         try:
             out, version_used = self.batcher.submit(
                 rows, version=version, raw_score=raw_score,
-                timeout_ms=payload.get("timeout_ms"))
-        except Exception:
+                timeout_ms=payload.get("timeout_ms"), trace=trace)
+        except Exception as exc:
             # error series keyed by the *requested* tag — no answer
             # resolved one, and "which version is erroring" is exactly
             # the canary question these labels exist to answer
             requested = version or self.registry.latest or "latest"
+            dt = time.monotonic() - t0
             self.stats.observe_version(requested, error=True)
+            if self.slo is not None:
+                self.slo.observe(requested, dt, error=True)
+            if trace is not None:
+                trace.span("server", dt, version=requested,
+                           status="error", error=type(exc).__name__)
             if routed:
                 # errors drive the demotion gate — evaluate before the
                 # error propagates so a bleeding canary is cut promptly
@@ -93,12 +125,19 @@ class ServingApp:
         dt = time.monotonic() - t0
         self.stats.observe("serve_request", dt)
         self.stats.observe_version(version_used, dt)
+        if self.slo is not None:
+            self.slo.observe(version_used, dt)
+        if self.drift is not None:
+            self.drift.observe(rows, out, version=version_used)
         if routed:
             shadow = self.router.shadow_target()
             if shadow is not None:
                 self._mirror(rows, shadow, raw_score)
             self.router.evaluate()
         preds = (out[:, 0] if out.ndim == 2 and out.shape[1] == 1 else out)
+        if trace is not None:
+            trace.span("server", dt, version=version_used,
+                       rows=int(out.shape[0]), status="ok")
         return {"predictions": preds.tolist(), "version": version_used,
                 "num_rows": int(out.shape[0])}
 
@@ -146,6 +185,10 @@ class ServingApp:
         snap["router"] = self.router.snapshot()
         if self.registry.export_cache is not None:
             snap["export_cache"] = self.registry.export_cache.info()
+        if self.slo is not None:
+            snap["slo"] = self.slo.snapshot()
+        if self.drift is not None:
+            snap["drift"] = self.drift.snapshot()
         return snap
 
     # -- fleet control ---------------------------------------------------
@@ -178,23 +221,34 @@ class ServingApp:
         telemetry counters (served at GET /metrics, next to /stats)."""
         from .. import telemetry
         return telemetry.prometheus_text(
-            self.stats.snapshot(), self.registry.predictor.cache_info())
+            self.stats.snapshot(), self.registry.predictor.cache_info(),
+            slo=self.slo.snapshot() if self.slo is not None else None,
+            drift=self.drift.snapshot() if self.drift is not None
+            else None)
 
     def health(self) -> dict:
-        """Liveness for load balancers: registry + batcher state.
-        ``status`` is ``ok`` (routable), ``draining`` (shutdown in
-        progress — stop routing, in-flight work still completes) or
-        ``degraded`` (batcher worker dead/closed — not servable). The
-        HTTP layer maps non-``ok`` to 503."""
+        """Liveness for load balancers: registry + batcher state, plus
+        the SLO fast window when a monitor is attached. ``status`` is
+        ``ok`` (routable), ``draining`` (shutdown in progress — stop
+        routing, in-flight work still completes) or ``degraded``
+        (batcher worker dead/closed, or the fast SLO window is burning
+        — servable but violating its objectives). The HTTP layer maps
+        non-``ok`` to 503."""
         batcher_alive = self.batcher.alive()
         draining = self.batcher.draining
         status = ("draining" if draining
                   else "ok" if batcher_alive else "degraded")
-        return {"status": status,
+        body = {"status": status,
                 "model_loaded": self.registry.latest is not None,
                 "batcher_alive": batcher_alive,
                 "draining": draining,
                 "queued_rows": self.batcher.queued_rows}
+        if self.slo is not None:
+            snap = self.slo.snapshot()
+            body["slo"] = snap
+            if status == "ok" and snap["fast"].get("burning"):
+                body["status"] = "degraded"
+        return body
 
     def drain(self, timeout_s: float = 5.0) -> None:
         """Graceful shutdown: stop admitting, flush in-flight batches,
@@ -203,6 +257,8 @@ class ServingApp:
 
     def close(self) -> None:
         self.batcher.close()
+        if self.drift is not None:
+            self.drift.close()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -216,11 +272,14 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):   # route to our logger, not stderr
         log.debug("http: " + fmt, *args)
 
-    def _reply(self, code: int, body: dict) -> None:
+    def _reply(self, code: int, body: dict,
+               headers: Optional[dict] = None) -> None:
         data = json.dumps(body).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(data)
 
@@ -242,22 +301,22 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as exc:
             raise BadRequest(f"invalid JSON body: {exc}") from exc
 
-    def _dispatch(self, fn) -> None:
+    def _dispatch(self, fn, headers: Optional[dict] = None) -> None:
         try:
-            self._reply(200, fn())
+            self._reply(200, fn(), headers)
         except BadRequest as exc:
-            self._reply(400, {"error": str(exc)})
+            self._reply(400, {"error": str(exc)}, headers)
         except ModelNotFound as exc:
-            self._reply(404, {"error": str(exc)})
+            self._reply(404, {"error": str(exc)}, headers)
         except OverloadedError as exc:
-            self._reply(429, {"error": str(exc)})
+            self._reply(429, {"error": str(exc)}, headers)
         except RequestTimeout as exc:
-            self._reply(504, {"error": str(exc)})
+            self._reply(504, {"error": str(exc)}, headers)
         except ValueError as exc:
-            self._reply(400, {"error": str(exc)})
+            self._reply(400, {"error": str(exc)}, headers)
         except Exception as exc:   # noqa: BLE001 — JSON 500, keep serving
             log.warning("serving: internal error: %s", exc)
-            self._reply(500, {"error": str(exc)})
+            self._reply(500, {"error": str(exc)}, headers)
 
     def do_GET(self):
         if self.path == "/stats":
@@ -272,6 +331,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._dispatch(self.app.models)
         elif self.path == "/router":
             self._dispatch(lambda: self.app.router.snapshot())
+        elif self.path == "/router/audit":
+            # the decision log: every stable/deploy/promote/demote with
+            # the gate snapshot (counter deltas + thresholds) it was
+            # decided on, plus the latest "hold" evaluation
+            self._dispatch(lambda: self.app.router.audit_snapshot())
         elif self.path in ("/healthz", "/health"):
             # non-ok health is a 503 so load balancers stop routing
             # while drain/degradation is in progress
@@ -286,7 +350,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         if self.path == "/predict":
-            self._dispatch(lambda: self.app.predict(self._payload()))
+            # every request gets an id (incoming X-Request-Id honored)
+            # and the id always comes back in the response header —
+            # whether or not this request was sampled for a full trace
+            rid = ((self.headers.get("X-Request-Id") or "").strip()
+                   or serve_trace.new_request_id())
+            self._dispatch(
+                lambda: self.app.predict(self._payload(), request_id=rid),
+                headers={"X-Request-Id": rid})
         elif self.path == "/models":
             self._dispatch(lambda: self.app.load_model(self._payload()))
         elif self.path == "/router":

@@ -166,12 +166,15 @@ def phase_breakdown() -> dict:
     return recorder.phase_breakdown()
 
 
-def prometheus_text(serving_snapshot=None, cache_info=None) -> str:
+def prometheus_text(serving_snapshot=None, cache_info=None,
+                    slo=None, drift=None) -> str:
     """Prometheus text for the serving `/metrics` endpoint: process
     counters + compile events + the serving stack's counters/latency
     histograms (per-version series labeled `{version="..."}`) +
-    compiled-predictor cache gauges + (on rank 0, once an aggregation
-    tick landed) the fleet-merged counters and per-rank skew gauges."""
+    compiled-predictor cache gauges + SLO burn-rate gauges (fast/slow
+    window p99, error rate, burning flags) + drift-monitor gauges +
+    (on rank 0, once an aggregation tick landed) the fleet-merged
+    counters and per-rank skew gauges."""
     extra_counters, latency, extra_gauges = {}, {}, {}
     if serving_snapshot:
         extra_counters.update(serving_snapshot.get("counters") or {})
@@ -187,6 +190,25 @@ def prometheus_text(serving_snapshot=None, cache_info=None) -> str:
     if cache_info:
         extra_gauges.update({f"predictor_cache_{k}": v
                              for k, v in cache_info.items()})
+    if slo:
+        extra_gauges["serve_slo_p99_ms"] = slo.get("slo_p99_ms", 0.0)
+        extra_gauges["serve_slo_error_rate"] = \
+            slo.get("slo_error_rate", 0.0)
+        for win in ("fast", "slow"):
+            ws = slo.get(win) or {}
+            label = f'{{window="{win}"}}'
+            extra_gauges[f"serve_slo_window_p99_ms{label}"] = \
+                ws.get("p99_ms", 0.0)
+            extra_gauges[f"serve_slo_window_error_rate{label}"] = \
+                ws.get("error_rate", 0.0)
+            extra_gauges[f"serve_slo_window_burning{label}"] = \
+                1.0 if ws.get("burning") else 0.0
+    if drift:
+        extra_gauges["serve_drift_fires"] = drift.get("fires", 0)
+        worst = max(drift.get("psi", {}).values(), default=0.0)
+        extra_gauges["serve_drift_psi_worst"] = worst
+        extra_gauges["serve_drift_psi_threshold"] = \
+            drift.get("threshold", 0.0)
     fleet_counters, fleet_gauges = aggregate.prometheus_extras()
     extra_counters.update(fleet_counters)
     extra_gauges.update(fleet_gauges)

@@ -25,6 +25,10 @@ overrides the 4096 default, newest win) always collects while enabled;
 a JSONL file sink is added when `LGBM_TPU_EVENTS=<path>` is set (or
 `set_sink(path)` is called) — one JSON object per line, append-mode,
 flushed per record so a killed run keeps everything already emitted.
+`LGBM_TPU_EVENTS_MAX_MB` bounds the sink: when the file would exceed
+the cap it is rotated to `<path>.1` (one generation kept, older
+overwritten) at a record boundary, so long serving runs cannot fill
+the disk and every line in both files stays intact.
 
 Off (the default — the recorder follows the telemetry mode) every hook
 returns after one module-global read, the same shared no-op discipline
@@ -60,7 +64,20 @@ _counts: Dict[str, int] = {}        # kind -> records emitted (ring-independent)
 _seq = 0
 _sink = None                        # open file object (JSONL)
 _sink_path: Optional[str] = None
+_sink_bytes = 0                     # bytes written to the current sink file
 _pending_iter: Optional[dict] = None
+
+
+def _max_sink_bytes() -> int:
+    """Size cap for the JSONL sink (0 = unbounded). Read per rotation
+    check so tests can flip the env without reopening the sink."""
+    raw = os.environ.get("LGBM_TPU_EVENTS_MAX_MB", "").strip()
+    if not raw:
+        return 0
+    try:
+        return max(0, int(float(raw) * 1024 * 1024))
+    except ValueError:
+        return 0
 
 
 def enable(flag: bool = True) -> None:
@@ -96,18 +113,40 @@ def _maybe_open_env_sink() -> None:
 def set_sink(path: Optional[str]) -> Optional[str]:
     """Point the JSONL sink at `path` (append mode; None closes it).
     Returns the active sink path."""
-    global _sink, _sink_path
+    global _sink, _sink_path, _sink_bytes
     with _lock:
         if _sink is not None:
             try:
                 _sink.close()
             except OSError:  # pragma: no cover
                 pass
-            _sink, _sink_path = None, None
+            _sink, _sink_path, _sink_bytes = None, None, 0
         if path:
             _sink = open(path, "a", encoding="utf-8")
             _sink_path = path
+            try:
+                _sink_bytes = os.path.getsize(path)
+            except OSError:  # pragma: no cover
+                _sink_bytes = 0
         return _sink_path
+
+
+def _rotate_sink_locked() -> None:
+    """Move the full sink file aside to `<path>.1` and reopen fresh.
+    Runs at a record boundary (after a flushed line) so neither file
+    ever holds a torn line."""
+    global _sink, _sink_bytes
+    path = _sink_path
+    try:
+        _sink.close()
+    except OSError:  # pragma: no cover
+        pass
+    try:
+        os.replace(path, path + ".1")
+    except OSError:  # pragma: no cover
+        pass
+    _sink = open(path, "a", encoding="utf-8")
+    _sink_bytes = 0
 
 
 def sink_path() -> Optional[str]:
@@ -124,9 +163,15 @@ def _write(record: dict) -> None:
         _counts[record["kind"]] = _counts.get(record["kind"], 0) + 1
         _ring.append(record)
         if _sink is not None:
-            _sink.write(json.dumps(record, sort_keys=True,
-                                   default=_json_default) + "\n")
+            line = json.dumps(record, sort_keys=True,
+                              default=_json_default) + "\n"
+            _sink.write(line)
             _sink.flush()
+            global _sink_bytes
+            _sink_bytes += len(line)
+            cap = _max_sink_bytes()
+            if cap and _sink_bytes >= cap:
+                _rotate_sink_locked()
 
 
 def _json_default(obj):

@@ -11,6 +11,14 @@ from its own trailing baseline:
 * **grad_spike** — gradient L2 norm > `grad_spike` x trailing median
   (generic-path runs only; the fused step keeps gradients in-program).
 
+A fourth, serving-side monitor rides the same fire path: **drift_psi**
+— `serving/drift.DriftMonitor` computes PSI between served-traffic
+windows and the training baseline and calls `fire_drift` when a
+feature or the score distribution exceeds the `drift_psi` threshold
+(default 0.2, overridable like the factors above). Routing drift
+through the watchdog layer means the canary router's existing
+watchdog-fire demotion gate sees it for free.
+
 Baselines are medians over a bounded trailing window; nothing fires
 until `MIN_SAMPLES` healthy iterations exist, so warmup/compile
 iterations never alarm. Every fire lands in the event stream AND in the
@@ -35,10 +43,11 @@ from typing import Dict, Optional
 
 from . import counters, events
 
-__all__ = ["configure", "observe", "fired", "loss_guard_requested",
-           "reset"]
+__all__ = ["configure", "observe", "fired", "fire_drift",
+           "drift_threshold", "loss_guard_requested", "reset"]
 
-DEFAULTS = {"slow_iter": 3.0, "overlap": 0.5, "grad_spike": 10.0}
+DEFAULTS = {"slow_iter": 3.0, "overlap": 0.5, "grad_spike": 10.0,
+            "drift_psi": 0.2}
 WINDOW = 32
 MIN_SAMPLES = 5
 
@@ -97,6 +106,35 @@ def _fire(monitor: str, iteration, value: float, baseline: float,
     events.emit("watchdog", monitor=monitor, iteration=iteration,
                 value=round(float(value), 6),
                 baseline=round(float(baseline), 6), factor=factor)
+
+
+def drift_threshold() -> float:
+    """The PSI threshold serving's DriftMonitor defaults to (the
+    `drift_psi` knob; the `drift_psi_threshold` param overrides it
+    per-monitor)."""
+    cfg = _config()
+    if cfg.get("off"):
+        return DEFAULTS["drift_psi"]
+    return float(cfg.get("drift_psi", DEFAULTS["drift_psi"]))
+
+
+def fire_drift(where: str, value: float, threshold: float,
+               version=None) -> bool:
+    """Serving-side drift fire (DriftMonitor calls this when a PSI
+    crosses the threshold). Lands in `watchdog_fires` + a watchdog
+    event like the training monitors — which is exactly what the
+    canary router's demotion gate watches. Returns False (no fire)
+    while watchdogs are configured off."""
+    cfg = _config()
+    if cfg.get("off"):
+        return False
+    _fired["drift_psi"] = _fired.get("drift_psi", 0) + 1
+    counters.incr("watchdog_fires")
+    events.emit("watchdog", monitor="drift_psi", where=where,
+                version=version, value=round(float(value), 6),
+                baseline=round(float(threshold), 6),
+                factor=1.0)
+    return True
 
 
 def observe(rec: dict) -> None:

@@ -429,6 +429,59 @@ def test_hot_swap_under_concurrent_router_traffic():
         app.close()
 
 
+def test_per_version_counters_exact_under_hot_swap():
+    """Per-version serving counters stay attribution-exact under a
+    hot swap: with concurrent routed traffic racing a deploy+promote,
+    every success is counted against the version that ANSWERED it
+    (the batcher's resolved version), never the one that was merely
+    routed to — the client-side tally per claimed version must match
+    the stats snapshot exactly."""
+    router, reg, stats, (bst1, bst2, x) = _router_stack(
+        min_requests=6, p99_ratio=1000.0)
+    app = ServingApp(registry=reg, stats=stats, router=router,
+                     max_batch=16, max_delay_ms=2.0)
+    router.set_stable("stable")
+    tallies = {}
+    errors = []
+    lock = threading.Lock()
+
+    def client(ci: int) -> None:
+        for k in range(25):
+            i = (ci * 17 + k * 5) % (len(x) - 2)
+            try:
+                res = app.predict({"rows": x[i:i + 2].tolist(),
+                                   "timeout_ms": 10_000})
+            except Exception as e:       # noqa: BLE001
+                with lock:
+                    errors.append(str(e))
+                continue
+            with lock:
+                tallies[res["version"]] = tallies.get(res["version"], 0) + 1
+
+    try:
+        threads = [threading.Thread(target=client, args=(ci,))
+                   for ci in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.03)                 # traffic in flight...
+        router.deploy("canary", weight=0.5)   # ...swap mid-traffic
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:5]
+        snap = stats.snapshot()["versions"]
+        assert set(tallies) <= set(snap)
+        for version, count in tallies.items():
+            ent = snap[version]
+            assert ent["errors"] == 0
+            assert ent["requests"] == count, (
+                f"{version}: counted {ent['requests']}, clients saw "
+                f"{count} — a success was attributed to a version that "
+                f"didn't answer it")
+        assert sum(tallies.values()) == 100
+    finally:
+        app.close()
+
+
 # ---------------------------------------------------------------------------
 # rollout tooling over the HTTP surface
 

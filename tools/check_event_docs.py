@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Lint: flight-recorder event kinds in code <-> docs/Observability.md.
+
+Same contract as check_phase_docs.py, for the discrete event stream: an
+event emitted in code but missing from the docs' event-kind table is a
+record nobody knows to query, and a documented kind no code emits is a
+schema lying about coverage. This check extracts
+
+* every literal ``*.emit("kind", ...)`` call under ``lightgbm_tpu/``
+  (the pattern tolerates the call spanning lines), and
+* every backticked name in the FIRST column of the event table in
+  ``docs/Observability.md`` (header row ``| kind | emitted by |``),
+
+and fails (exit 1) on any difference, in either direction. The
+``iteration`` record is emitted through a dedicated helper rather than
+a literal ``emit("iteration")`` call, so it is exempt on both sides.
+Run directly or via tests/test_tools.py (tier-1, fast — pure text).
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO, "lightgbm_tpu")
+DOCS_PATH = os.path.join(REPO, "docs", "Observability.md")
+
+# matches events.emit("kind" / telem_events.emit(\n    "kind" — the
+# serve_warmup emit spans lines, so \s* must cross newlines (it does:
+# findall over whole-file text, \s matches \n)
+_EMIT_CALL = re.compile(r"\.emit\(\s*[\"']([a-z0-9_]+)[\"']")
+
+# emitted via events.iteration_record(), not a literal emit() call
+_EXEMPT = {"iteration"}
+
+
+def code_kinds(pkg_dir: str = PKG_DIR) -> Set[str]:
+    """All literal event kinds emitted anywhere in the package."""
+    names: Set[str] = set()
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                names.update(_EMIT_CALL.findall(f.read()))
+    return names - _EXEMPT
+
+
+def doc_kinds(docs_path: str = DOCS_PATH) -> Set[str]:
+    """Backticked names from the first column of the event-kind table
+    (the table whose header row is ``| kind | emitted by |``)."""
+    names: Set[str] = set()
+    in_table = False
+    with open(docs_path) as f:
+        for line in f:
+            stripped = line.strip()
+            if re.match(r"^\|\s*kind\s*\|\s*emitted by\s*\|", stripped):
+                in_table = True
+                continue
+            if in_table:
+                if not stripped.startswith("|"):
+                    break                      # table ended
+                first_col = stripped.split("|")[1]
+                names.update(re.findall(r"`([a-z0-9_]+)`", first_col))
+    return names - _EXEMPT
+
+
+def check() -> Tuple[Set[str], Set[str]]:
+    """-> (undocumented, phantom): code-not-docs and docs-not-code."""
+    code = code_kinds()
+    docs = doc_kinds()
+    return code - docs, docs - code
+
+
+def main() -> int:
+    undocumented, phantom = check()
+    ok = True
+    if undocumented:
+        ok = False
+        print("event kind(s) emitted in code but missing from the "
+              "docs/Observability.md event table: "
+              + ", ".join(sorted(undocumented)))
+    if phantom:
+        ok = False
+        print("event kind(s) documented in docs/Observability.md but "
+              "never emitted by any .emit(...) call: "
+              + ", ".join(sorted(phantom)))
+    if ok:
+        print(f"event docs in sync ({len(code_kinds())} kinds)")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,7 +11,14 @@ markdown document:
 * phase waterfall — per-phase seconds with ASCII bars
 * metric curve — per train/valid metric: first/best/last + sparkline
 * per-rank skew table — from the newest ``fleet`` aggregation event
+* serving section (when the stream came from a serving process):
+  per-version traffic from sampled ``trace_span`` server spans, the
+  drift-fire timeline, and the router decision log with the counter
+  snapshot that justified each promote/demote
 * event timeline — every non-iteration event, time-offset ordered
+
+Rotation (``LGBM_TPU_EVENTS_MAX_MB``) is handled: a ``<path>.1``
+generation, when present, is read before the live file.
 
 Usage::
 
@@ -24,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List, Optional
 
@@ -33,19 +41,24 @@ SPARK = "▁▂▃▄▅▆▇█"
 
 def load_events(path: str) -> List[dict]:
     """Parse the JSONL stream; malformed lines (torn final write of a
-    killed run) are skipped, not fatal."""
+    killed run) are skipped, not fatal. When size rotation
+    (``LGBM_TPU_EVENTS_MAX_MB``) left a ``<path>.1`` generation behind,
+    it is read first — those are the older records."""
     out: List[dict] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if isinstance(rec, dict) and "kind" in rec:
-                out.append(rec)
+    for p in (path + ".1", path):
+        if p.endswith(".1") and not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "kind" in rec:
+                    out.append(rec)
     return out
 
 
@@ -94,6 +107,24 @@ def summarize(path: str) -> dict:
             skew_table = e["skew_table"]
             break
 
+    # serving-path digest (empty for pure training runs): per-version
+    # traffic reassembled from sampled end-to-end server spans, drift
+    # fires, and the router decision log with its gate snapshots
+    serve_versions: Dict[str, dict] = {}
+    for e in others:
+        if e["kind"] == "trace_span" and e.get("span") == "server":
+            v = str(e.get("version"))
+            row = serve_versions.setdefault(
+                v, {"sampled": 0, "rows": 0, "errors": 0, "dur_ms": []})
+            row["sampled"] += 1
+            row["rows"] += int(e.get("rows") or 0)
+            if e.get("status") == "error":
+                row["errors"] += 1
+            row["dur_ms"].append(float(e.get("dur_ms") or 0.0))
+    drift_fires = [e for e in others if e["kind"] == "drift"]
+    router_log = [e for e in others
+                  if e["kind"].startswith("router_")]
+
     return {
         "path": path,
         "events": len(events),
@@ -107,6 +138,9 @@ def summarize(path: str) -> dict:
         "skew_table": skew_table,
         "stragglers": counts.get("straggler", 0),
         "watchdog_fires": counts.get("watchdog", 0),
+        "serve_versions": serve_versions,
+        "drift_fires": drift_fires,
+        "router_log": router_log,
         "timeline": others,
     }
 
@@ -175,6 +209,52 @@ def render(summary: dict) -> str:
               f"| {'YES' if row.get('straggler') else ''} |")
         w("")
 
+    if summary["serve_versions"] or summary["drift_fires"] \
+            or summary["router_log"]:
+        w("## Serving")
+        w("")
+        if summary["serve_versions"]:
+            w("### Per-version traffic (sampled server spans)")
+            w("")
+            w("| version | sampled reqs | rows | errors | mean ms "
+              "| max ms |")
+            w("|---|---|---|---|---|---|")
+            for v in sorted(summary["serve_versions"]):
+                row = summary["serve_versions"][v]
+                durs = row["dur_ms"] or [0.0]
+                w(f"| {v} | {row['sampled']} | {row['rows']} "
+                  f"| {row['errors']} "
+                  f"| {sum(durs) / len(durs):.3f} | {max(durs):.3f} |")
+            w("")
+        if summary["drift_fires"]:
+            w("### Drift fires")
+            w("")
+            t0 = min(e.get("ts", 0.0) for e in summary["drift_fires"])
+            w("| t+s | version | worst feature | psi | threshold | rows |")
+            w("|---|---|---|---|---|---|")
+            for e in summary["drift_fires"]:
+                w(f"| {e.get('ts', t0) - t0:+.3f} | {e.get('version')} "
+                  f"| {e.get('worst')} | {e.get('psi', 0):.4f} "
+                  f"| {e.get('threshold', 0):g} | {e.get('rows')} |")
+            w("")
+        if summary["router_log"]:
+            w("### Router decisions")
+            w("")
+            t0 = min(e.get("ts", 0.0) for e in summary["router_log"])
+            w("| t+s | decision | version | evidence |")
+            w("|---|---|---|---|")
+            for e in summary["router_log"]:
+                gate = e.get("gate") or {}
+                bits = [f"{k}={v}" for k, v in sorted(gate.items())
+                        if v not in (None, "")]
+                for k in ("reason", "weight", "shadow", "previous"):
+                    if e.get(k) not in (None, ""):
+                        bits.insert(0, f"{k}={e[k]}")
+                w(f"| {e.get('ts', t0) - t0:+.3f} "
+                  f"| {e['kind'][len('router_'):]} | {e.get('version')} "
+                  f"| {', '.join(bits)} |")
+            w("")
+
     timeline = summary["timeline"]
     if timeline:
         w("## Event timeline")
@@ -185,7 +265,8 @@ def render(summary: dict) -> str:
         for e in timeline:
             detail = ", ".join(
                 f"{k}={v}" for k, v in sorted(e.items())
-                if k not in ("kind", "ts", "seq", "skew_table"))
+                if k not in ("kind", "ts", "seq", "skew_table",
+                             "gate", "psis"))
             w(f"| {e.get('ts', t0) - t0:+.3f} | {e['kind']} | {detail} |")
         w("")
     return "\n".join(lines) + "\n"
