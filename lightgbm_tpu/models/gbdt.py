@@ -53,7 +53,10 @@ def _host_global(arr) -> Optional[np.ndarray]:
     if getattr(arr, "is_fully_addressable", True):
         return np.asarray(jax.device_get(arr))
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(arr))
+    gathered = faults.run_collective(
+        lambda: multihost_utils.process_allgather(arr),
+        site="host_global")
+    return np.asarray(gathered)
 
 
 def _threshold_l1_np(s: float, l1: float) -> float:

@@ -152,6 +152,10 @@ class PredictorCache:
         self._buckets: Dict[Tuple, list] = {}
         self._pinned_sigs: set = set()
         self._lock = threading.Lock()
+        # key -> Event for a compile in flight; lets _compile run XLA
+        # outside _lock (seconds-long) while duplicate requests for the
+        # SAME key wait instead of compiling twice
+        self._inflight: Dict[Tuple, threading.Event] = {}
         self._staging: Dict[Tuple[int, int], list] = {}
         self._staging_off = bool(os.environ.get("LGBM_TPU_SERVE_NO_STAGING"))
         self.compile_count = 0
@@ -241,11 +245,25 @@ class PredictorCache:
 
     def _compile(self, family, bucket, model: PreparedModel,
                  x_dev, raw_score: bool) -> object:
+        """XLA lowering+compile takes seconds; holding the cache lock
+        across it would stall every cache-hit request behind a cold
+        bucket. So: claim the key under the lock, compile UNLOCKED,
+        install under the lock. A second thread asking for the same key
+        waits on the claimant's event; threads asking for other keys
+        sail through."""
         key = self._key(family, bucket)
-        with self._lock:
-            compiled = self._exec.get(key)
-            if compiled is not None:
-                return compiled
+        while True:
+            with self._lock:
+                compiled = self._exec.get(key)
+                if compiled is not None:
+                    return compiled
+                waiter = self._inflight.get(key)
+                if waiter is None:
+                    self._inflight[key] = threading.Event()
+                    break
+            waiter.wait()
+
+        try:
             t0 = time.perf_counter()
             with timer("serve_compile"), \
                     telem_spans.span("serve_compile", bucket=bucket):
@@ -259,13 +277,19 @@ class PredictorCache:
             telem_counters.incr("serve_compiles")
             telem_counters.add_seconds("serve_compile_seconds",
                                        time.perf_counter() - t0)
-            self._exec[key] = compiled
-            self._buckets.setdefault(family, []).append(bucket)
-            self._buckets[family].sort()
-            self.compile_count += 1
-            self._evict_locked()
+            with self._lock:
+                self._exec[key] = compiled
+                self._buckets.setdefault(family, []).append(bucket)
+                self._buckets[family].sort()
+                self.compile_count += 1
+                self._evict_locked()
             log.debug("serving: compiled predictor bucket=%d", bucket)
             return compiled
+        finally:
+            with self._lock:
+                ev = self._inflight.pop(key, None)
+            if ev is not None:
+                ev.set()
 
     def install(self, family: Tuple, bucket: int, compiled) -> None:
         """Register an executable that did NOT come from `_compile` —

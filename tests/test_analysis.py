@@ -1,0 +1,391 @@
+"""graft-lint framework tests: one known-bad + one known-good fixture
+per checker, suppression syntax, baseline round-trip, and the tier-1
+gate — zero unsuppressed, unbaselined findings on the real tree.
+
+Pure stdlib + ast: no jax import anywhere on these paths, so the whole
+module stays in the fast tier-1 band.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.analysis import docs_tables as dt              # noqa: E402
+from tools.analysis.__main__ import _report, main         # noqa: E402
+from tools.analysis.core import (Project, SourceFile,     # noqa: E402
+                                 load_baseline, run, save_baseline,
+                                 update_baseline)
+
+
+def _run_src(text: str, rule: str, path: str = "lightgbm_tpu/x.py",
+             repo_root: str = REPO):
+    src = SourceFile(path, textwrap.dedent(text))
+    return run(Project([src], repo_root=repo_root), rules=[rule],
+               baseline=[])
+
+
+# ---------------------------------------------------------------------------
+# trace-safety
+
+_TRACE_BAD = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def f(x, n):
+        if x > 0:
+            return x
+        return float(x)
+"""
+
+_TRACE_GOOD = """
+    import functools
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("n", "w"))
+    def f(x, n, w=None):
+        if n > 2:                       # static arg: trace-time branch OK
+            x = x * 2
+        if w is None:                   # None-ness is a trace-time fact
+            w = jnp.ones_like(x)
+        k = x.shape[0]                  # .shape is static metadata
+        if k > 4:
+            x = x[:4]
+        return jnp.where(x > 0, x, 0.) * w
+"""
+
+
+def test_trace_safety_flags_traced_branch_and_cast():
+    r = _run_src(_TRACE_BAD, "trace-safety")
+    msgs = [f.message for f in r.active]
+    assert any("`if` on a traced value" in m for m in msgs), msgs
+    assert any("`float()` cast" in m for m in msgs), msgs
+
+
+def test_trace_safety_static_and_metadata_branches_clean():
+    r = _run_src(_TRACE_GOOD, "trace-safety")
+    assert r.active == [], [f.render() for f in r.active]
+
+
+# ---------------------------------------------------------------------------
+# collective-discipline
+
+_COLL_BAD = """
+    from jax.experimental import multihost_utils
+
+    def fetch(payload):
+        return multihost_utils.process_allgather(payload)
+"""
+
+# wrapper guards its inner function: the fixpoint must prove _inner safe
+# because its ONLY call site is the run_collective lambda
+_COLL_GOOD = """
+    from jax.experimental import multihost_utils
+    from ..resilience import faults
+
+    def _inner(payload):
+        return multihost_utils.process_allgather(payload)
+
+    def fetch(payload):
+        return faults.run_collective(lambda: _inner(payload), site="x")
+"""
+
+
+def test_collective_flags_raw_dispatch():
+    r = _run_src(_COLL_BAD, "collective-discipline")
+    assert len(r.active) == 1
+    assert "process_allgather" in r.active[0].message
+    assert "`fetch`" in r.active[0].message
+
+
+def test_collective_transitive_guard_fixpoint():
+    r = _run_src(_COLL_GOOD, "collective-discipline")
+    assert r.active == [], [f.render() for f in r.active]
+
+
+def test_collective_unguarded_second_caller_still_flagged():
+    # same wrapper, but one extra RAW caller of _inner: no longer safe
+    r = _run_src(_COLL_GOOD
+                 + "\n    def sneak(p):\n        return _inner(p)\n",
+                 "collective-discipline")
+    assert len(r.active) == 1 and "_inner" in r.active[0].message
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+
+_LOCK_BAD_CYCLE = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def one(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def two(self):
+            with self.b:
+                with self.a:
+                    pass
+"""
+
+_LOCK_BAD_BLOCKING = """
+    import threading
+    import time
+
+    _LK = threading.Lock()
+
+    def f():
+        with _LK:
+            time.sleep(0.1)
+"""
+
+_LOCK_GOOD = """
+    import threading
+    import time
+
+    class Q:
+        def __init__(self):
+            self._cv = threading.Condition()
+
+        def get(self):
+            with self._cv:
+                self._cv.wait()          # releases the lock: by design
+
+    def f(q):
+        time.sleep(0.1)                  # not under any lock
+        with q:                          # q is not a learned lock
+            time.sleep(0.1)
+"""
+
+
+def test_lock_order_cycle_detected():
+    r = _run_src(_LOCK_BAD_CYCLE, "lock-order")
+    assert any("lock-order cycle" in f.message for f in r.active), \
+        [f.render() for f in r.active]
+
+
+def test_lock_order_blocking_call_under_lock():
+    r = _run_src(_LOCK_BAD_BLOCKING, "lock-order")
+    assert len(r.active) == 1 and "sleep" in r.active[0].message
+
+
+def test_lock_order_condition_wait_and_unknown_contexts_clean():
+    r = _run_src(_LOCK_GOOD, "lock-order")
+    assert r.active == [], [f.render() for f in r.active]
+
+
+# ---------------------------------------------------------------------------
+# determinism
+
+_DET_BAD = """
+    import time
+    from ..resilience import faults
+
+    def order(out):
+        s = {"a", "b"}
+        for x in s:
+            out.append(x)
+
+    def ship(send, payload):
+        stamp = time.time()
+        return faults.run_collective(lambda: send(payload, stamp),
+                                     site="x")
+"""
+
+_DET_GOOD = """
+    import time
+    import numpy as np
+    from ..resilience import faults
+
+    def order(out, cbs):
+        s = {"a", "b"}
+        for x in sorted(s):
+            out.append(x)
+        return any(c for c in s)         # order-insensitive reduction
+
+    def ship(send, payload, seed):
+        rng = np.random.RandomState(seed)     # seeded: deterministic
+        pick = rng.randint(4)
+        return faults.run_collective(lambda: send(payload, pick),
+                                     site="x")
+"""
+
+_DET_SUM_BAD = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, static_argnames=())
+    def total(x):
+        return sum(x)
+"""
+
+
+def test_determinism_set_iteration_and_clock_payload():
+    r = _run_src(_DET_BAD, "determinism")
+    msgs = [f.message for f in r.active]
+    assert any("iteration over a set" in m for m in msgs), msgs
+    assert any("rank-divergent value `stamp`" in m for m in msgs), msgs
+
+
+def test_determinism_sorted_seeded_and_any_clean():
+    r = _run_src(_DET_GOOD, "determinism")
+    assert r.active == [], [f.render() for f in r.active]
+
+
+def test_determinism_python_sum_in_jit():
+    r = _run_src(_DET_SUM_BAD, "determinism")
+    assert len(r.active) == 1 and "`sum()`" in r.active[0].message
+
+
+# ---------------------------------------------------------------------------
+# registry-sync
+
+_OBS_DOC = textwrap.dedent("""\
+    # Observability
+
+    | Phase | Where |
+    |---|---|
+    | `boost` | models |
+
+    | kind | emitted by |
+    |---|---|
+    | `spill` | io |
+
+    | counter / gauge | meaning |
+    |---|---|
+    | `hits` | cache hits |
+    | `peak_rss_bytes` | implicit gauge |
+""")
+
+_OBS_CODE = """
+    def work(telem, events, counters):
+        with telem.phase("boost"):
+            events.emit("spill", n=1)
+            counters.incr("hits")
+"""
+
+
+def _registry_run(tmp_path, code: str, doc: str):
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "Observability.md").write_text(doc)
+    return _run_src(code, "registry-sync", repo_root=str(tmp_path))
+
+
+def test_registry_sync_in_sync(tmp_path):
+    r = _registry_run(tmp_path, _OBS_CODE, _OBS_DOC)
+    assert r.active == [], [f.render() for f in r.active]
+
+
+def test_registry_sync_flags_both_directions(tmp_path):
+    code = _OBS_CODE + '\n        counters.incr("misses")\n'
+    doc = _OBS_DOC + "| `ghost` | never produced |\n"
+    r = _registry_run(tmp_path, code, doc)
+    msgs = " ".join(f.message for f in r.active)
+    assert "`misses`" in msgs and "missing from" in msgs
+    assert "`ghost`" in msgs and "never produced" in msgs
+
+
+def test_doc_first_column_stops_at_table_end():
+    got = dt.doc_first_column(_OBS_DOC + "\nprose `not_a_counter`\n",
+                              dt.COUNTER_HEADER)
+    assert got == {"hits", "peak_rss_bytes"}
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+def test_suppression_inline_and_line_above():
+    r = _run_src("""
+        s = {1, 2}
+        for x in s:  # deliberate: test fixture. lint: disable=determinism
+            pass
+        # order irrelevant here. lint: disable=determinism
+        for y in s:
+            pass
+        for z in s:
+            pass
+    """, "determinism")
+    assert len(r.suppressed) == 2
+    assert len(r.active) == 1           # the unsuppressed loop still fails
+
+
+def test_suppression_requires_comment_line_above():
+    # marker buried in a code line above does NOT cover the next line
+    r = _run_src("""
+        s = {1, 2}
+        t = "# lint: disable=determinism"
+        for x in s:
+            pass
+    """, "determinism")
+    assert len(r.active) == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+
+def test_baseline_round_trip_and_staleness(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    first = _run_src(_COLL_BAD, "collective-discipline")
+    assert len(first.active) == 1
+
+    entries = update_baseline(first, "2026-08-05", old=[])
+    save_baseline(entries, path)
+    loaded = load_baseline(path)
+    assert loaded == entries and loaded[0]["added"] == "2026-08-05"
+
+    src = SourceFile("lightgbm_tpu/x.py", textwrap.dedent(_COLL_BAD))
+    again = run(Project([src], repo_root=REPO),
+                rules=["collective-discipline"], baseline=loaded)
+    assert again.ok and len(again.baselined) == 1
+
+    # a later update keeps the original added date
+    entries2 = update_baseline(again, "2027-01-01", old=loaded)
+    assert entries2[0]["added"] == "2026-08-05"
+
+    # fixing the violation makes the entry stale, not an error
+    clean = run(Project([SourceFile("lightgbm_tpu/x.py", "x = 1\n")],
+                        repo_root=REPO),
+                rules=["collective-discipline"], baseline=loaded)
+    assert clean.ok and len(clean.stale_baseline) == 1
+
+
+def test_report_orders_oldest_first():
+    text = _report([
+        {"rule": "lock-order", "path": "b.py", "message": "m2",
+         "added": "2026-07-01"},
+        {"rule": "lock-order", "path": "a.py", "message": "m1",
+         "added": "2026-01-01"},
+    ])
+    assert "lock-order" in text and text.index("a.py") < text.index("b.py")
+    assert _report([]).count("empty") == 1
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the real tree is clean
+
+def test_tree_has_no_unsuppressed_unbaselined_findings(capsys):
+    # exercises the real CLI path end to end (scan + all five rules +
+    # committed baseline); this is the gate that keeps the tree lint-clean
+    assert main(["--format=json"]) == 0, capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert '"ok": true' in out
+
+
+def test_cli_list_rules_and_unknown_rule(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("trace-safety", "collective-discipline", "lock-order",
+                 "determinism", "registry-sync"):
+        assert rule in out
+    assert main(["--rules", "nosuch"]) == 2

@@ -1,0 +1,4 @@
+"""Repo tooling namespace — exists so ``python -m tools.analysis`` (the
+static-analysis entry point) resolves regardless of the interpreter's
+namespace-package behavior. The standalone scripts in this directory do
+not import through the package and are unaffected."""

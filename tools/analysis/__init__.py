@@ -1,0 +1,34 @@
+"""graft-lint: stdlib-only AST static analysis for this repo's
+correctness invariants.
+
+Five rules (see docs/Analysis.md for the catalog and rationale):
+
+* ``trace-safety`` — Python control flow on traced values in
+  jit/shard_map functions.
+* ``collective-discipline`` — every cross-rank dispatch routes through
+  ``faults.run_collective`` (deadline + retry + counters).
+* ``lock-order`` — lock-acquisition cycles and blocking calls made
+  while holding a serving/fleet lock.
+* ``determinism`` — set-iteration order, wall-clock/rng values flowing
+  into collective payloads, python ``sum()`` over traced values.
+* ``registry-sync`` — recorder phases / event kinds / telemetry
+  counters vs their docs/Observability.md tables.
+
+Entry points::
+
+    python -m tools.analysis                # human output, exit 1 on findings
+    python -m tools.analysis --format=json  # machine output
+    python -m tools.analysis --baseline-update
+    python -m tools.analysis --report       # baseline burn-down report
+
+Per-line suppression: ``# lint: disable=<rule>[,<rule2>]`` on the line
+(or a comment-only line directly above). Grandfathered findings live in
+``tools/analysis/baseline.json``.
+"""
+from .core import (BASELINE_PATH, Finding, Project, RunResult,        # noqa: F401
+                   checker_docs, checkers, load_baseline, run,
+                   save_baseline, update_baseline)
+
+__all__ = ["BASELINE_PATH", "Finding", "Project", "RunResult",
+           "checker_docs", "checkers", "load_baseline", "run",
+           "save_baseline", "update_baseline"]

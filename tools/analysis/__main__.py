@@ -1,0 +1,106 @@
+"""CLI: ``python -m tools.analysis`` — run the sweep, manage the
+baseline, print the burn-down report. Exit 0 iff every finding is
+suppressed or baselined."""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+
+from .core import (BASELINE_PATH, Project, checker_docs, load_baseline,
+                   run, save_baseline, update_baseline)
+
+
+def _report(baseline) -> str:
+    lines = ["graft-lint baseline burn-down", ""]
+    by_rule = {}
+    for e in baseline:
+        by_rule.setdefault(e["rule"], []).append(e)
+    if not baseline:
+        lines.append("baseline is empty — nothing grandfathered. Keep it "
+                     "that way.")
+        return "\n".join(lines)
+    lines.append(f"{'rule':<24} {'count':>5}")
+    for rule in sorted(by_rule):
+        lines.append(f"{rule:<24} {len(by_rule[rule]):>5}")
+    lines.append("")
+    lines.append("oldest grandfathered findings (chip at these first):")
+    oldest = sorted(baseline, key=lambda e: (e.get("added", ""),
+                                             e["path"]))[:10]
+    for e in oldest:
+        lines.append(f"  {e.get('added', '?'):<12} {e['path']} "
+                     f"[{e['rule']}] {e['message'][:80]}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="graft-lint: AST static analysis for trace-safety, "
+                    "collective-discipline, lock-order, determinism and "
+                    "registry-sync invariants")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", help="comma-separated subset of rules")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "(existing entries keep their added date)")
+    ap.add_argument("--report", action="store_true",
+                    help="print per-rule baseline counts and the oldest "
+                         "grandfathered findings")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show everything)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, doc in sorted(checker_docs().items()):
+            print(f"{rule:<24} {doc.splitlines()[0] if doc else ''}")
+        return 0
+    if args.report:
+        print(_report(load_baseline()))
+        return 0
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    baseline = [] if args.no_baseline else None
+    try:
+        result = run(Project.scan(), rules=rules, baseline=baseline)
+    except KeyError as exc:
+        print(f"error: {exc.args[0]} (see --list-rules)", file=sys.stderr)
+        return 2
+
+    if args.baseline_update:
+        today = datetime.date.today().isoformat()
+        entries = update_baseline(result, today)
+        save_baseline(entries)
+        print(f"baseline updated: {len(entries)} entr"
+              f"{'y' if len(entries) == 1 else 'ies'} "
+              f"-> {BASELINE_PATH}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "ok": result.ok,
+            "findings": [f.__dict__ for f in result.active],
+            "suppressed": len(result.suppressed),
+            "baselined": len(result.baselined),
+            "stale_baseline": len(result.stale_baseline),
+        }, indent=1))
+        return 0 if result.ok else 1
+
+    for f in result.active:
+        print(f.render())
+    tail = (f"{len(result.active)} finding(s), "
+            f"{len(result.suppressed)} suppressed, "
+            f"{len(result.baselined)} baselined")
+    if result.stale_baseline:
+        tail += (f", {len(result.stale_baseline)} stale baseline "
+                 f"entr{'y' if len(result.stale_baseline) == 1 else 'ies'}"
+                 f" (run --baseline-update to drop)")
+    print(("FAIL: " if not result.ok else "ok: ") + tail)
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

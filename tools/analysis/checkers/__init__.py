@@ -1,0 +1,10 @@
+"""Built-in graft-lint checkers. Importing this package registers every
+rule with the core registry (tools.analysis.core.checkers())."""
+from . import collective       # noqa: F401
+from . import determinism      # noqa: F401
+from . import locks            # noqa: F401
+from . import registry_sync    # noqa: F401
+from . import trace_safety     # noqa: F401
+
+__all__ = ["collective", "determinism", "locks", "registry_sync",
+           "trace_safety"]

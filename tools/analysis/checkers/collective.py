@@ -1,0 +1,186 @@
+"""collective-discipline: every cross-rank dispatch rides run_collective.
+
+PR 10's elastic-training guarantee — a dead peer surfaces as a typed
+``CollectiveTimeout`` instead of a gloo deadlock — holds only if every
+host-side cross-rank dispatch goes through ``faults.run_collective``
+(that's where the deadline watchdog, the jittered retry, and the
+``collective_*`` counters live). This rule makes the "every dispatch is
+guarded" claim machine-checked instead of tribal.
+
+Raw primitives (anything that blocks on a peer):
+
+* ``multihost_utils.process_allgather`` / ``sync_global_devices``
+* ``jax.distributed.initialize`` / ``jax.distributed.shutdown``
+
+A raw call is **guarded** when
+
+* it is lexically inside a ``lambda``/``def`` passed as an argument to
+  ``faults.run_collective(...)``, or
+* its enclosing function is itself *transitively guarded*: every call
+  site of that function across the scanned tree is guarded (fixpoint —
+  this is how ``_allgather_host_bytes_inner`` is proven safe: its only
+  caller is the run_collective lambda in ``_allgather_host_bytes``).
+
+Everything else is a finding at the raw call's line. Self-guarding
+wrappers (``_allgather_host_bytes``, ``bootstrap.barrier``) come out
+clean automatically, so their callers never need annotations.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..core import Finding, Project, dotted_name, parent_map, register
+
+RULE = "collective-discipline"
+
+RAW_SUFFIXES = {"process_allgather", "sync_global_devices"}
+RAW_DOTTED_PREFIXES = ("jax.distributed.",)
+GUARD_NAMES = {"run_collective"}
+
+
+def _is_raw(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name:
+        return False
+    if name.rsplit(".", 1)[-1] in RAW_SUFFIXES:
+        return True
+    return any(name.startswith(p) or f".{p}" in f".{name}"
+               for p in RAW_DOTTED_PREFIXES)
+
+
+def _is_guard_call(call: ast.Call) -> bool:
+    return dotted_name(call.func).rsplit(".", 1)[-1] in GUARD_NAMES
+
+
+class _Site:
+    """One interesting call site: a raw primitive or a call to a named
+    function that (transitively) contains raw primitives."""
+
+    __slots__ = ("path", "node", "lex_guarded", "enclosing")
+
+    def __init__(self, path: str, node: ast.Call, lex_guarded: bool,
+                 enclosing: str):
+        self.path = path
+        self.node = node
+        self.lex_guarded = lex_guarded
+        self.enclosing = enclosing     # "path::name" or "" at module level
+
+
+def _scan_file(src) -> Tuple[List[_Site], Dict[str, List[_Site]]]:
+    """(raw sites, named-call sites by bare callee name) for one file."""
+    tree = src.tree
+    if tree is None:
+        return [], {}
+    parents = parent_map(tree)
+
+    guard_arg_nodes: Set[int] = set()    # lambda/def nodes passed to guards
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_guard_call(node):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                guard_arg_nodes.add(id(arg))
+                # fn=functools.partial(f, ...) style: the partial's first
+                # positional arg is the dispatched callable
+                if isinstance(arg, ast.Call) and arg.args:
+                    guard_arg_nodes.add(id(arg.args[0]))
+
+    def chain_info(node: ast.AST) -> Tuple[bool, str]:
+        """Walk up: (lexically guarded?, enclosing function key)."""
+        lex = False
+        enclosing = ""
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.Lambda, ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                if id(cur) in guard_arg_nodes:
+                    lex = True
+                if not enclosing and isinstance(
+                        cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    enclosing = f"{src.path}::{cur.name}"
+            # a def whose NAME is dispatched (run_collective(f)) guards
+            # the def body too — handled via guard_arg_nodes on Name
+            # resolution below
+        return lex, enclosing
+
+    # Name arguments to guards: run_collective(join, ...) where join is
+    # a local def/lambda assigned earlier — mark the def by name
+    guarded_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_guard_call(node):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    guarded_names.add(arg.id)
+    if guarded_names:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in guarded_names:
+                guard_arg_nodes.add(id(node))
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Lambda):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id in guarded_names:
+                        guard_arg_nodes.add(id(node.value))
+
+    raw_sites: List[_Site] = []
+    named_calls: Dict[str, List[_Site]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        lex, enclosing = chain_info(node)
+        if _is_raw(node):
+            raw_sites.append(_Site(src.path, node, lex, enclosing))
+        else:
+            callee = dotted_name(node.func).rsplit(".", 1)[-1]
+            if callee:
+                named_calls.setdefault(callee, []).append(
+                    _Site(src.path, node, lex, enclosing))
+    return raw_sites, named_calls
+
+
+@register(RULE, "cross-rank dispatches (process_allgather, "
+                "jax.distributed.*, barriers) must route through "
+                "faults.run_collective")
+def check(project: Project) -> Iterable[Finding]:
+    raw_sites: List[_Site] = []
+    named_calls: Dict[str, List[_Site]] = {}
+    # every file is scanned: a file with no raw primitive still matters
+    # as a caller of a guard-requiring function (the fixpoint below)
+    for src in project.files:
+        rs, nc = _scan_file(src)
+        raw_sites.extend(rs)
+        for k, v in nc.items():
+            named_calls.setdefault(k, []).extend(v)
+
+    # functions containing at least one non-lexically-guarded raw site
+    req: Set[str] = {s.enclosing for s in raw_sites
+                     if not s.lex_guarded and s.enclosing}
+
+    # fixpoint: F is SAFE when every call site of F's bare name is
+    # lexically guarded or sits inside a SAFE function
+    safe: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for fkey in sorted(req - safe):
+            fname = fkey.split("::", 1)[1].split(".")[-1]
+            sites = named_calls.get(fname, [])
+            if sites and all(s.lex_guarded or s.enclosing in safe
+                             for s in sites):
+                safe.add(fkey)
+                changed = True
+
+    out: List[Finding] = []
+    for s in raw_sites:
+        if s.lex_guarded or (s.enclosing and s.enclosing in safe):
+            continue
+        callee = dotted_name(s.node.func)
+        where = (s.enclosing.split("::", 1)[1] if s.enclosing
+                 else "module level")
+        out.append(Finding(
+            RULE, s.path, s.node.lineno,
+            f"raw collective `{callee}` in `{where}` dispatched outside "
+            f"faults.run_collective (no deadline/retry/counter; a dead "
+            f"peer hangs here forever)"))
+    return out

@@ -1,0 +1,232 @@
+"""determinism: order- and clock-nondeterminism in program order.
+
+Bit-identity (streamed == resident, distributed == virtual mesh,
+resume == uninterrupted) is the repo's central acceptance property, and
+it dies quietly: a ``set`` iterated in one order on rank 0 and another
+on rank 1 (string hashing is per-process randomized), a wall-clock read
+deciding a rank-divergent branch, or a Python ``sum()`` regrouping
+float adds. Three sub-rules:
+
+* **set-iteration** — ``for``/comprehension iteration, or
+  ``list()``/``tuple()``/``enumerate()``/``"".join()`` materialization,
+  over a ``set`` literal / ``set()`` call / set comprehension (directly
+  or via a name assigned one in the same function). Order-insensitive
+  reductions (``sorted``, ``len``, ``min``, ``max``, ``any``, ``all``,
+  ``frozenset``, ``sum``) are exempt — ``sorted(s)`` is the fix, not a
+  violation.
+* **clock/rng-into-collective** — ``time.time()``, unseeded
+  ``random.*`` / ``np.random.*`` module calls whose value flows (intra-
+  function assignment taint) into the payload of a collective dispatch
+  (``run_collective`` / ``_allgather_host_bytes`` / ``barrier`` /
+  ``process_allgather``): ranks would each ship a different value while
+  believing they agree. Seeded ``RandomState(seed)`` construction is
+  deterministic and exempt.
+* **python-sum-on-device** — builtin ``sum()`` over values derived from
+  traced parameters inside a jit function: a left-fold of float adds
+  whose grouping silently differs from the exactly-associative
+  accumulation lanes the histograms use. ``jnp.sum``/``np.sum`` don't
+  match (attribute call).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import Finding, Project, dotted_name, register
+from .trace_safety import _Taint, _collect_jit_functions
+
+RULE = "determinism"
+
+_ORDER_INSENSITIVE = {"sorted", "len", "min", "max", "any", "all",
+                      "frozenset", "sum", "set", "bool"}
+_MATERIALIZERS = {"list", "tuple", "enumerate", "iter", "map", "filter",
+                  "zip", "join", "dumps", "extend"}
+_COLLECTIVE_CALLS = {"run_collective", "_allgather_host_bytes",
+                     "_allgather_host_bytes_inner", "barrier",
+                     "process_allgather", "sync_global_devices"}
+
+
+def _is_set_expr(node: ast.AST, set_names: Set[str]) -> bool:
+    if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name == "set":
+            return True
+        # set ops that return sets: a | b on names known to be sets
+    if isinstance(node, ast.Name):
+        return node.id in set_names
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+        return (_is_set_expr(node.left, set_names)
+                or _is_set_expr(node.right, set_names))
+    return False
+
+
+def _check_set_iteration(src, tree: ast.AST) -> Iterable[Finding]:
+    out: List[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.set_names: Set[str] = set()
+            self.exempt_comps: Set[int] = set()
+
+        def _flag(self, node: ast.AST, how: str) -> None:
+            out.append(Finding(
+                RULE, src.path, node.lineno,
+                f"iteration over a set ({how}) — order varies per "
+                f"process (hash randomization); sort first if the order "
+                f"reaches a payload, wire, or program"))
+
+        def visit_FunctionDef(self, node) -> None:
+            saved = set(self.set_names)
+            self.generic_visit(node)
+            self.set_names = saved
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Assign(self, node: ast.Assign) -> None:
+            is_set = _is_set_expr(node.value, self.set_names)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    (self.set_names.add if is_set
+                     else self.set_names.discard)(tgt.id)
+            self.generic_visit(node)
+
+        def visit_For(self, node: ast.For) -> None:
+            if _is_set_expr(node.iter, self.set_names):
+                self._flag(node, "for loop")
+            self.generic_visit(node)
+
+        def _comp(self, node) -> None:
+            for gen in node.generators:
+                if _is_set_expr(gen.iter, self.set_names):
+                    # building ANOTHER unordered container from a set is
+                    # fine; building an ordered one is the hazard —
+                    # unless an order-insensitive reduction consumes it
+                    # (`any(... for c in s)`)
+                    if isinstance(node, (ast.SetComp, ast.DictComp)) \
+                            or id(node) in self.exempt_comps:
+                        continue
+                    self._flag(node, "comprehension")
+            self.generic_visit(node)
+
+        visit_ListComp = _comp
+        visit_GeneratorExp = _comp
+        visit_SetComp = _comp
+        visit_DictComp = _comp
+
+        def visit_Call(self, node: ast.Call) -> None:
+            fname = dotted_name(node.func).rsplit(".", 1)[-1]
+            if fname in _ORDER_INSENSITIVE:
+                # the comprehension argument is visited after this Call
+                # node, so marking it here exempts it in _comp
+                for arg in node.args:
+                    if isinstance(arg, (ast.GeneratorExp, ast.ListComp)):
+                        self.exempt_comps.add(id(arg))
+            elif fname in _MATERIALIZERS:
+                for arg in node.args:
+                    if _is_set_expr(arg, self.set_names):
+                        self._flag(node, f"`{fname}()`")
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+def _check_clock_into_collective(src, tree: ast.AST) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # does this function dispatch a collective?
+        coll_calls = [c for c in ast.walk(node)
+                      if isinstance(c, ast.Call)
+                      and dotted_name(c.func).rsplit(".", 1)[-1]
+                      in _COLLECTIVE_CALLS]
+        if not coll_calls:
+            continue
+        # names assigned from wall-clock / unseeded rng in this function
+        divergent: Dict[str, int] = {}
+        for st in ast.walk(node):
+            if isinstance(st, ast.Assign):
+                bad = _divergent_call(st.value)
+                for tgt in st.targets:
+                    if isinstance(tgt, ast.Name):
+                        if bad:
+                            divergent[tgt.id] = st.lineno
+                        else:
+                            divergent.pop(tgt.id, None)
+        if not divergent:
+            continue
+        for call in coll_calls:
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                for ref in ast.walk(arg):
+                    if isinstance(ref, ast.Name) and ref.id in divergent:
+                        out.append(Finding(
+                            RULE, src.path, call.lineno,
+                            f"rank-divergent value `{ref.id}` (wall clock "
+                            f"/ unseeded rng, line "
+                            f"{divergent[ref.id]}) flows into collective "
+                            f"payload in `{node.name}` — ranks ship "
+                            f"different bytes while assuming agreement"))
+    return out
+
+
+def _divergent_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = dotted_name(sub.func)
+        if name in ("time.time", "time.time_ns", "datetime.now",
+                    "datetime.datetime.now", "uuid.uuid4", "os.urandom"):
+            return True
+        if name.startswith("random.") or ".random." in f".{name}":
+            # np.random.RandomState(seed)/default_rng(seed) with args is
+            # deterministic; bare module-level draws are not
+            last = name.rsplit(".", 1)[-1]
+            if last in ("RandomState", "default_rng", "Generator",
+                        "PRNGKey", "seed") and (sub.args or sub.keywords):
+                continue
+            return True
+    return False
+
+
+def _check_python_sum(src, tree: ast.AST) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for fn, statics, how in _collect_jit_functions(tree):
+        taint = _Taint(fn, statics)
+        # settle assignment taint first (single forward pass is enough
+        # for the flag — sum sites re-checked after)
+        for st in ast.walk(fn):
+            if isinstance(st, ast.Assign):
+                t = taint.expr(st.value)
+                for tgt in st.targets:
+                    taint.assign_targets(tgt, t)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "sum" and node.args \
+                    and taint.expr(node.args[0]):
+                out.append(Finding(
+                    RULE, src.path, node.lineno,
+                    f"python `sum()` over traced values in "
+                    f"{how} function "
+                    f"`{getattr(fn, 'name', '<lambda>')}` — left-fold "
+                    f"float accumulation regroups adds; use jnp.sum or "
+                    f"the exactly-associative int lanes"))
+    return out
+
+
+@register(RULE, "set-iteration order, wall-clock/rng into collective "
+                "payloads, python sum() over traced values")
+def check(project: Project) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for src in project.files:
+        tree = src.tree
+        if tree is None:
+            continue
+        out.extend(_check_set_iteration(src, tree))
+        out.extend(_check_clock_into_collective(src, tree))
+        out.extend(_check_python_sum(src, tree))
+    return out

@@ -1,0 +1,248 @@
+"""lock-order: deadlock-shaped patterns in the threaded serving stack.
+
+The serving path now has six-plus interacting locks (batcher ``_cv``,
+registry, predictor cache, router, drift ``_lock``/``_eval_lock``, SLO,
+stats) with no runtime deadlock guard. This rule builds the
+lock-acquisition graph statically and flags the two patterns that
+actually take fleets down:
+
+* **cycles** — lock A held while acquiring B somewhere, B held while
+  acquiring A somewhere else. Edges come from lexical nesting
+  (``with self._a: ... with self._b:``) plus one level of same-class /
+  same-module call resolution (``with self._a: self.meth()`` where
+  ``meth`` acquires ``self._b``).
+* **blocking calls under a lock** — device sync (``block_until_ready``,
+  ``device_get``/``device_put``), XLA ``lower``/``compile``, socket
+  ops, ``time.sleep``, thread ``.join``-style waits, predictor
+  execute/warm-up, and collective dispatch while holding any lock. One
+  cold compile under a cache lock stalls every request on every model;
+  a collective under a lock deadlocks against a peer blocked on the
+  same lock.
+
+Lock identity is learned, not guessed: only attributes/globals assigned
+``threading.Lock()``/``RLock()``/``Condition()`` count, so ordinary
+``with`` contexts (files, timers, spans) never enter the graph.
+``Condition.wait()`` on the *held* condition is exempt — that's the
+one blocking call the primitive is designed to make (it releases the
+lock while waiting).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Project, dotted_name, iter_functions, register
+
+RULE = "lock-order"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+# attribute calls that block the calling thread for unbounded /
+# macroscopic time; receiver-name exemptions below keep noise out
+_BLOCKING_ATTRS = {
+    "block_until_ready": "device sync",
+    "device_get": "device transfer",
+    "device_put": "device transfer",
+    "lower": "XLA lowering",
+    "compile": "XLA compile",
+    "sleep": "sleep",
+    "accept": "socket accept",
+    "connect": "socket connect",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "sendall": "socket send",
+    "wait": "wait",
+    "join": "thread join",
+    "predict": "predictor execute",
+    "warm": "predictor warm-up/compile",
+    "urlopen": "HTTP request",
+    "run_collective": "collective dispatch",
+}
+# receivers whose methods sharing a blocking name are NOT blocking
+_RECEIVER_EXEMPT = {
+    "compile": {"re"},             # re.compile
+    "join": {"os", "path", "posixpath", "ntpath", "shlex"},
+}
+
+
+def _learn_locks(src) -> Tuple[Dict[str, Set[str]], Set[str]]:
+    """(class -> lock attr names, module-level lock names)."""
+    tree = src.tree
+    class_locks: Dict[str, Set[str]] = {}
+    module_locks: Set[str] = set()
+    if tree is None:
+        return class_locks, module_locks
+
+    def is_lock_ctor(value: ast.AST) -> bool:
+        return (isinstance(value, ast.Call)
+                and dotted_name(value.func).rsplit(".", 1)[-1]
+                in _LOCK_CTORS)
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and is_lock_ctor(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    module_locks.add(tgt.id)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs = class_locks.setdefault(node.name, set())
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and is_lock_ctor(sub.value):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self":
+                        attrs.add(tgt.attr)
+    return class_locks, module_locks
+
+
+def _lock_id(src, cls: Optional[str], expr: ast.AST,
+             class_locks: Dict[str, Set[str]],
+             module_locks: Set[str]) -> Optional[str]:
+    """Stable id of the lock a `with` context acquires, or None when the
+    context isn't a learned lock."""
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and cls \
+            and expr.attr in class_locks.get(cls, ()):
+        return f"{src.path}::{cls}.{expr.attr}"
+    if isinstance(expr, ast.Name) and expr.id in module_locks:
+        return f"{src.path}::{expr.id}"
+    return None
+
+
+@register(RULE, "lock-acquisition cycles and blocking calls while "
+                "holding a lock (serving/fleet threading discipline)")
+def check(project: Project) -> Iterable[Finding]:
+    # method qname -> set of lock ids it acquires lexically (top level
+    # of its own body, any depth)
+    method_locks: Dict[str, Set[str]] = {}
+    # edge (held, acquired) -> first site
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    blocking: List[Finding] = []
+    # (held lock, call site, src, class) resolved after method_locks known
+    call_sites: List[Tuple[str, str, Optional[str], ast.Call]] = []
+
+    per_file = {src.path: _learn_locks(src) for src in project.files}
+
+    for src in project.files:
+        class_locks, module_locks = per_file[src.path]
+        if not class_locks and not module_locks:
+            continue
+        tree = src.tree
+        if tree is None:
+            continue
+        for qname, fn, cls in iter_functions(tree):
+            held: List[Tuple[str, ast.AST]] = []
+            acquired: Set[str] = set()
+
+            def visit(node: ast.AST) -> None:
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    ids = []
+                    for item in node.items:
+                        lid = _lock_id(src, cls, item.context_expr,
+                                       class_locks, module_locks)
+                        if lid:
+                            ids.append((lid, item.context_expr))
+                    for lid, _expr in ids:
+                        acquired.add(lid)
+                        if held:
+                            edge = (held[-1][0], lid)
+                            edges.setdefault(
+                                edge, (src.path, node.lineno))
+                        held.append((lid, _expr))
+                    for child in node.body:
+                        visit(child)
+                    for _ in ids:
+                        held.pop()
+                    return
+                if isinstance(node, ast.Call) and held:
+                    _check_blocking(node, held, src, blocking)
+                    callee = dotted_name(node.func)
+                    if callee.startswith("self."):
+                        call_sites.append(
+                            (held[-1][0], f"{cls}.{callee[5:]}", src.path,
+                             node))
+                    elif "." not in callee and callee:
+                        call_sites.append(
+                            (held[-1][0], callee, src.path, node))
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)) \
+                        and node is not fn:
+                    return        # nested defs run later, locks not held
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+
+            visit(fn)
+            method_locks[f"{src.path}::{qname}"] = acquired
+
+    # call-resolved edges: one level, same file
+    for held_lock, callee_q, path, node in call_sites:
+        target = f"{path}::{callee_q}"
+        for lid in method_locks.get(target, ()):
+            if lid != held_lock:
+                edges.setdefault((held_lock, lid), (path, node.lineno))
+
+    out: List[Finding] = list(blocking)
+
+    # cycle detection over the edge graph
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    reported: Set[frozenset] = set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            cur, trail = stack.pop()
+            for nxt in sorted(graph.get(cur, ())):
+                if nxt == start and len(trail) > 1:
+                    cyc = frozenset(trail)
+                    if cyc in reported:
+                        continue
+                    reported.add(cyc)
+                    path, line = edges[(trail[-1], start)]
+                    pretty = " -> ".join(
+                        t.split("::", 1)[1] for t in trail + [start])
+                    out.append(Finding(
+                        RULE, path, line,
+                        f"lock-order cycle: {pretty} (two threads taking "
+                        f"these in opposite order deadlock)"))
+                elif nxt not in trail:
+                    stack.append((nxt, trail + [nxt]))
+    return out
+
+
+def _check_blocking(node: ast.Call, held, src,
+                    out: List[Finding]) -> None:
+    func = node.func
+    attr = None
+    receiver = ""
+    if isinstance(func, ast.Attribute):
+        attr = func.attr
+        receiver = dotted_name(func.value)
+    elif isinstance(func, ast.Name) and func.id in ("urlopen",):
+        attr = func.id
+    if attr not in _BLOCKING_ATTRS:
+        return
+    if receiver.rsplit(".", 1)[-1] in _RECEIVER_EXEMPT.get(attr, ()):
+        return
+    if attr == "join":
+        # str.join / path joins share the name; only receivers that look
+        # like threads/processes are the blocking kind
+        low = receiver.lower()
+        if not any(t in low for t in ("thread", "worker", "proc")):
+            return
+    if attr == "wait":
+        # Condition.wait on the held lock is the designed blocking call
+        # (it releases the lock); Event.wait under a lock is a real hang
+        held_exprs = {ast.dump(e) for _lid, e in held}
+        if ast.dump(func.value) in held_exprs:
+            return
+    held_name = held[-1][0].split("::", 1)[1]
+    what = _BLOCKING_ATTRS[attr]
+    out.append(Finding(
+        RULE, src.path, node.lineno,
+        f"blocking call ({what}: `{dotted_name(func)}`) while holding "
+        f"lock `{held_name}` — every thread needing the lock stalls "
+        f"behind it"))

@@ -1,0 +1,97 @@
+"""registry-sync: code registries <-> docs/Observability.md tables.
+
+Three bidirectional syncs, one rule: a name in code but not in the docs
+is telemetry nobody knows to query; a documented name no code produces
+is a dashboard lying about coverage.
+
+* recorder **phases** — literal ``phase("name")`` calls vs the
+  ``| Phase | Where |`` table (previously ``tools/check_phase_docs.py``,
+  now a shim over this checker).
+* flight-recorder **event kinds** — literal ``*.emit("kind")`` calls vs
+  the ``| kind | emitted by |`` table (previously
+  ``tools/check_event_docs.py``).
+* telemetry **counters/gauges** — literal
+  ``counters.incr/set_gauge/add_seconds("name")`` calls vs the
+  ``| counter / gauge | meaning |`` table. This is the new one: ~30
+  counters had no lint at all before this rule.
+
+All extraction lives in ``tools.analysis.docs_tables`` (single home for
+the docs-table parsing the two old lints each reimplemented).
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Set, Tuple
+
+from ..core import Finding, Project, register
+from .. import docs_tables as dt
+
+RULE = "registry-sync"
+DOC_REL = "docs/Observability.md"
+PKG_PREFIX = "lightgbm_tpu/"
+
+
+def _pkg_texts(project: Project) -> List[str]:
+    return [f.text for f in project.files
+            if f.path.startswith(PKG_PREFIX)]
+
+
+def _doc_text(project: Project) -> Tuple[str, bool]:
+    path = project.doc_path(DOC_REL)
+    if not os.path.exists(path):
+        return "", False
+    with open(path, encoding="utf-8") as f:
+        return f.read(), True
+
+
+def phase_sets(project: Project) -> Tuple[Set[str], Set[str]]:
+    doc, _ = _doc_text(project)
+    return (dt.code_literals(_pkg_texts(project), dt.PHASE_CALL),
+            dt.doc_first_column(doc, dt.PHASE_HEADER))
+
+
+def event_sets(project: Project) -> Tuple[Set[str], Set[str]]:
+    doc, _ = _doc_text(project)
+    return (dt.code_literals(_pkg_texts(project), dt.EMIT_CALL)
+            - dt.EVENT_EXEMPT,
+            dt.doc_first_column(doc, dt.EVENT_HEADER)
+            - dt.EVENT_EXEMPT)
+
+
+def counter_sets(project: Project) -> Tuple[Set[str], Set[str]]:
+    doc, _ = _doc_text(project)
+    return (dt.code_literals(_pkg_texts(project), dt.COUNTER_CALL)
+            | dt.COUNTER_IMPLICIT,
+            dt.doc_first_column(doc, dt.COUNTER_HEADER))
+
+
+_SYNCS = (
+    ("phase", phase_sets, 'phase("...") recorder call',
+     "| Phase | Where |"),
+    ("event kind", event_sets, '.emit("...") call',
+     "| kind | emitted by |"),
+    ("counter", counter_sets, "counters.incr/set_gauge/add_seconds call",
+     "| counter / gauge | meaning |"),
+)
+
+
+@register(RULE, "recorder phases, event kinds, and telemetry counters "
+                "stay in sync with the docs/Observability.md tables")
+def check(project: Project) -> Iterable[Finding]:
+    out: List[Finding] = []
+    doc, have_doc = _doc_text(project)
+    if not have_doc:
+        return [Finding(RULE, DOC_REL, 0, "docs/Observability.md missing")]
+    for what, fn, code_desc, table in _SYNCS:
+        code, docs = fn(project)
+        for name in sorted(code - docs):
+            out.append(Finding(
+                RULE, DOC_REL, 0,
+                f"{what} `{name}` is produced in code ({code_desc}) but "
+                f"missing from the `{table}` table"))
+        for name in sorted(docs - code):
+            out.append(Finding(
+                RULE, DOC_REL, 0,
+                f"{what} `{name}` is documented in the `{table}` table "
+                f"but never produced by any {code_desc}"))
+    return out

@@ -1,0 +1,282 @@
+"""trace-safety: Python control flow on traced values inside jit/shard_map.
+
+Inside a function handed to ``jax.jit`` or ``shard_map``, a Python
+``if``/``while``/``bool()``/``int()``/``float()`` on a value derived
+from a *traced* parameter either raises a ConcretizationTypeError or —
+worse — silently bakes one trace-time value into the compiled program.
+The repo's whole bit-identity story (streamed == resident, distributed
+== virtual mesh) rests on program structure depending only on the jit
+statics, so this rule makes the convention machine-checked.
+
+Detection is best-effort intra-function dataflow keyed off the repo's
+static-argnames conventions:
+
+* jit roots: ``@jax.jit``, ``@functools.partial(jax.jit,
+  static_argnames=(...))`` (and the bare ``partial`` spelling),
+  ``jax.jit(fn, ...)`` / ``shard_map(fn, ...)`` where ``fn`` names a
+  def in the same module.
+* parameters NOT named in ``static_argnames`` start tainted; taint
+  propagates through assignments; ``.shape``/``.ndim``/``.dtype``/
+  ``.size``/``.aval`` reads and ``len()`` are static under jit and
+  clear taint; ``is None`` / ``is not None`` comparisons are trace-time
+  facts and are exempt.
+* flagged: ``if``/``while``/``assert`` tests and ``bool``/``int``/
+  ``float`` casts whose expression still carries taint.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Project, dotted_name, register
+
+RULE = "trace-safety"
+
+# attribute reads that are static facts about a tracer, not traced data
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                "weak_type", "itemsize", "nbytes"}
+# calls returning static values even on traced arguments
+STATIC_CALLS = {"len", "isinstance", "type", "getattr", "hasattr",
+                "id", "repr", "str", "format"}
+FLAG_CASTS = {"bool", "int", "float"}
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        names.add(el.value)
+    return names
+
+
+def _jit_call_kind(call: ast.Call) -> Optional[str]:
+    """"jit" / "shard_map" when `call` is jax.jit(...) / shard_map(...),
+    or functools.partial(jax.jit, ...)."""
+    name = dotted_name(call.func)
+    last = name.rsplit(".", 1)[-1]
+    if last == "jit":
+        return "jit"
+    if last == "shard_map":
+        return "shard_map"
+    if last == "partial" and call.args:
+        inner = dotted_name(call.args[0])
+        if inner.rsplit(".", 1)[-1] == "jit":
+            return "jit"
+        if inner.rsplit(".", 1)[-1] == "shard_map":
+            return "shard_map"
+    return None
+
+
+def _collect_jit_functions(tree: ast.AST
+                           ) -> List[Tuple[ast.AST, Set[str], str]]:
+    """(function node, static param names, how) for every def that is
+    jit- or shard_map-compiled in this module."""
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    out: List[Tuple[ast.AST, Set[str], str]] = []
+    seen: Set[int] = set()
+
+    def add(fn_node: ast.AST, statics: Set[str], how: str) -> None:
+        if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)) and id(fn_node) not in seen:
+            seen.add(id(fn_node))
+            out.append((fn_node, statics, how))
+
+    # decorator forms
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call):
+                kind = _jit_call_kind(dec)
+                if kind:
+                    add(node, _static_argnames(dec), kind)
+            else:
+                name = dotted_name(dec)
+                if name.rsplit(".", 1)[-1] in ("jit", "shard_map"):
+                    add(node, set(), name.rsplit(".", 1)[-1])
+    # call forms: jax.jit(f, ...) / shard_map(f, ...) with f a local def
+    # or an inline lambda
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            kind = _jit_call_kind(node)
+            if not kind or not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                add(target, _static_argnames(node), kind)
+            elif isinstance(target, ast.Name):
+                for d in defs_by_name.get(target.id, []):
+                    add(d, _static_argnames(node), kind)
+    return out
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in list(a.posonlyargs) + list(a.args)
+             + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class _Taint:
+    """Two-pass forward taint over one function body (second pass lets
+    loop-carried assignments converge)."""
+
+    def __init__(self, fn: ast.AST, statics: Set[str]):
+        self.tainted: Set[str] = {
+            n for n in _param_names(fn) if n not in statics
+            and n not in ("self", "cls")}
+
+    def expr(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func).rsplit(".", 1)[-1]
+            if fname in STATIC_CALLS:
+                return False
+            if fname in FLAG_CASTS:
+                # the cast itself is flagged at visit time; its *result*
+                # is a concrete Python scalar
+                return False
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            recv_tainted = (isinstance(node.func, ast.Attribute)
+                            and self.expr(node.func.value))
+            return recv_tainted or any(self.expr(a) for a in args)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is a trace-time structural
+            # fact (the tracer is never None), not traced data
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops) and any(
+                    isinstance(c, ast.Constant) and c.value is None
+                    for c in [node.left] + list(node.comparators)):
+                return False
+            return any(self.expr(c)
+                       for c in [node.left] + list(node.comparators))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword)):
+                if self.expr(child.value if isinstance(child, ast.keyword)
+                             else child):
+                    return True
+        return False
+
+    def assign_targets(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self.assign_targets(el, tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign_targets(target.value, tainted)
+
+
+def _check_fn(src_path: str, fn: ast.AST, statics: Set[str],
+              how: str) -> Iterable[Finding]:
+    taint = _Taint(fn, statics)
+    fn_name = getattr(fn, "name", "<lambda>")
+    body = fn.body if isinstance(fn.body, list) else [ast.Return(fn.body)]
+
+    findings: Dict[Tuple[int, str], Finding] = {}
+
+    def flag(node: ast.AST, what: str) -> None:
+        key = (node.lineno, what)
+        findings[key] = Finding(
+            RULE, src_path, node.lineno,
+            f"{what} on a traced value in {how} function `{fn_name}` "
+            f"(concretizes at trace time; route through the statics or "
+            f"jnp.where/lax.cond)")
+
+    def walk_stmts(stmts: List[ast.stmt]) -> None:
+        for st in stmts:
+            if isinstance(st, ast.Assign):
+                t = taint.expr(st.value)
+                for tgt in st.targets:
+                    taint.assign_targets(tgt, t)
+            elif isinstance(st, ast.AugAssign):
+                if taint.expr(st.value) or taint.expr(st.target):
+                    taint.assign_targets(st.target, True)
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                taint.assign_targets(st.target, taint.expr(st.value))
+            elif isinstance(st, ast.If):
+                if taint.expr(st.test):
+                    flag(st, "python `if`")
+                walk_stmts(st.body)
+                walk_stmts(st.orelse)
+            elif isinstance(st, ast.While):
+                if taint.expr(st.test):
+                    flag(st, "python `while`")
+                walk_stmts(st.body)
+                walk_stmts(st.orelse)
+            elif isinstance(st, ast.Assert):
+                if taint.expr(st.test):
+                    flag(st, "python `assert`")
+            elif isinstance(st, ast.For):
+                taint.assign_targets(st.target, taint.expr(st.iter))
+                walk_stmts(st.body)
+                walk_stmts(st.orelse)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                walk_stmts(st.body)
+            elif isinstance(st, ast.Try):
+                walk_stmts(st.body)
+                for h in st.handlers:
+                    walk_stmts(h.body)
+                walk_stmts(st.orelse)
+                walk_stmts(st.finalbody)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue       # nested defs get their own jit analysis
+            # cast scan over the whole statement (covers expressions in
+            # any position, including inside the constructs above)
+            for node in ast.walk(st):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    break
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Name) \
+                        and node.func.id in FLAG_CASTS \
+                        and node.args \
+                        and taint.expr(node.args[0]):
+                    flag(node, f"`{node.func.id}()` cast")
+
+    # two passes: loop-carried taint settles on the second
+    walk_stmts(body)
+    snapshot = dict(findings)
+    findings.clear()
+    walk_stmts(body)
+    snapshot.update(findings)
+    return list(snapshot.values())
+
+
+@register(RULE, "Python control flow on traced values inside "
+                "jax.jit/shard_map functions")
+def check(project: Project) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for src in project.files:
+        tree = src.tree
+        if tree is None or "jit" not in src.text and \
+                "shard_map" not in src.text:
+            continue
+        for fn, statics, how in _collect_jit_functions(tree):
+            out.extend(_check_fn(src.path, fn, statics, how))
+    return out

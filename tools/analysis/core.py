@@ -1,0 +1,306 @@
+"""graft-lint core: project model, checker registry, suppressions,
+baseline.
+
+The framework is stdlib-only (ast + json + re) so the whole sweep runs
+as a fast tier-1 test with no JAX import. The moving parts:
+
+* ``Project`` — the parsed tree: every ``.py`` file under the scan
+  roots as a ``SourceFile`` (path, text, lazily parsed AST). Checkers
+  get the whole project, not one file, because two of the five rules
+  (collective-discipline's transitive guard propagation, registry-sync's
+  code<->docs tables) are inherently cross-file.
+* checker registry — ``@register("rule-name")`` on a callable
+  ``(project) -> Iterable[Finding]``. ``python -m tools.analysis``
+  runs every registered rule unless ``--rules`` narrows it.
+* suppressions — ``# lint: disable=rule[,rule2]`` on the finding's own
+  line, or on an immediately-preceding comment-only line. Suppressions
+  are for sites that are *correct but look wrong to the rule*; put the
+  why in the same comment.
+* baseline — ``tools/analysis/baseline.json`` holds grandfathered
+  findings keyed by (rule, path, message) — deliberately NOT by line
+  number, so unrelated edits above a finding don't invalidate the
+  baseline. Each entry carries the date it was baselined; ``--report``
+  surfaces the oldest so burn-down is deliberate, not accidental.
+
+Exit contract (``run`` + CLI): findings that are neither suppressed nor
+baselined fail the run. A baseline entry whose finding no longer exists
+is *stale* and reported (non-fatal) so the file shrinks over time.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+# scan roots, relative to the repo root; directories under tools/ that
+# hold build assets rather than analyzable Python are skipped
+DEFAULT_ROOTS = ("lightgbm_tpu", "tools")
+SKIP_DIRS = {"oracle", "rmock", "rstub", "jnistub", "__pycache__"}
+
+# the marker may trail prose in the same comment ("... why. lint:
+# disable=rule"), so it anchors on `lint:` inside a comment, not on `#`
+_SUPPRESS_RE = re.compile(r"#.*?\blint:\s*disable=([a-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int          # 1-based; 0 = file/project level
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift with unrelated edits,
+        so the stable key is (rule, path, message)."""
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self._tree: Optional[ast.AST] = None
+        self._tree_err: Optional[str] = None
+
+    @property
+    def tree(self) -> Optional[ast.AST]:
+        if self._tree is None and self._tree_err is None:
+            try:
+                self._tree = ast.parse(self.text, filename=self.path)
+            except SyntaxError as exc:   # pragma: no cover - tree is clean
+                self._tree_err = str(exc)
+        return self._tree
+
+    def suppressed_rules(self, line: int) -> Set[str]:
+        """Rules disabled at `line` (1-based): an inline marker on the
+        line itself, or a comment-only line directly above."""
+        out: Set[str] = set()
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                src = self.lines[ln - 1]
+                if ln != line and not src.lstrip().startswith("#"):
+                    continue           # line above counts only if pure comment
+                m = _SUPPRESS_RE.search(src)
+                if m:
+                    out.update(r.strip() for r in m.group(1).split(","))
+        return out
+
+
+class Project:
+    def __init__(self, files: Sequence[SourceFile],
+                 repo_root: str = REPO_ROOT):
+        self.files = list(files)
+        self.repo_root = repo_root
+        self.by_path = {f.path: f for f in self.files}
+
+    @classmethod
+    def scan(cls, roots: Sequence[str] = DEFAULT_ROOTS,
+             repo_root: str = REPO_ROOT) -> "Project":
+        files: List[SourceFile] = []
+        for root in roots:
+            top = os.path.join(repo_root, root)
+            if os.path.isfile(top) and top.endswith(".py"):
+                files.append(cls._read(top, repo_root))
+                continue
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        files.append(cls._read(
+                            os.path.join(dirpath, fn), repo_root))
+        return cls(files, repo_root)
+
+    @staticmethod
+    def _read(abs_path: str, repo_root: str) -> SourceFile:
+        rel = os.path.relpath(abs_path, repo_root).replace(os.sep, "/")
+        with open(abs_path, encoding="utf-8") as f:
+            return SourceFile(rel, f.read())
+
+    def doc_path(self, rel: str) -> str:
+        return os.path.join(self.repo_root, rel)
+
+
+# ---------------------------------------------------------------------------
+# checker registry
+
+CheckerFn = Callable[[Project], Iterable[Finding]]
+_CHECKERS: Dict[str, CheckerFn] = {}
+_CHECKER_DOCS: Dict[str, str] = {}
+
+
+def register(rule: str, doc: str = "") -> Callable[[CheckerFn], CheckerFn]:
+    def deco(fn: CheckerFn) -> CheckerFn:
+        _CHECKERS[rule] = fn
+        _CHECKER_DOCS[rule] = doc or (fn.__doc__ or "").strip()
+        return fn
+    return deco
+
+
+def checkers() -> Dict[str, CheckerFn]:
+    _load_builtin()
+    return dict(_CHECKERS)
+
+
+def checker_docs() -> Dict[str, str]:
+    _load_builtin()
+    return dict(_CHECKER_DOCS)
+
+
+_loaded = False
+
+
+def _load_builtin() -> None:
+    # importlib, not `from . import checkers`: the package __init__
+    # re-exports the checkers() *function*, which shadows the subpackage
+    # attribute of the same name.
+    global _loaded
+    if not _loaded:
+        import importlib
+        importlib.import_module(f"{__package__}.checkers")
+        _loaded = True
+
+
+# ---------------------------------------------------------------------------
+# run + classify
+
+@dataclasses.dataclass
+class RunResult:
+    active: List[Finding]          # fail the run
+    suppressed: List[Finding]      # silenced by an inline marker
+    baselined: List[Finding]       # grandfathered
+    stale_baseline: List[dict]     # baseline entries with no live finding
+
+    @property
+    def ok(self) -> bool:
+        return not self.active
+
+
+def run(project: Optional[Project] = None,
+        rules: Optional[Sequence[str]] = None,
+        baseline: Optional[List[dict]] = None) -> RunResult:
+    project = project or Project.scan()
+    table = checkers()
+    if rules:
+        unknown = sorted(set(rules) - set(table))
+        if unknown:
+            raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+        table = {r: table[r] for r in rules}
+    findings: List[Finding] = []
+    for rule in sorted(table):
+        findings.extend(table[rule](project))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+
+    if baseline is None:
+        baseline = load_baseline()
+    base_keys = {(e["rule"], e["path"], e["message"]) for e in baseline}
+
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    live_keys = set()
+    for f in findings:
+        src = project.by_path.get(f.path)
+        if src is not None and f.rule in src.suppressed_rules(f.line):
+            suppressed.append(f)
+            continue
+        live_keys.add(f.key())
+        if f.key() in base_keys:
+            baselined.append(f)
+        else:
+            active.append(f)
+    stale = [e for e in baseline
+             if (e["rule"], e["path"], e["message"]) not in live_keys]
+    return RunResult(active, suppressed, baselined, stale)
+
+
+# ---------------------------------------------------------------------------
+# baseline io
+
+def load_baseline(path: str = BASELINE_PATH) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("findings", []))
+
+
+def save_baseline(entries: List[dict], path: str = BASELINE_PATH) -> None:
+    entries = sorted(entries, key=lambda e: (e["rule"], e["path"],
+                                             e["message"]))
+    payload = {"format": 1,
+               "comment": "grandfathered graft-lint findings; see "
+                          "docs/Analysis.md for the burn-down workflow",
+               "findings": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def update_baseline(result: RunResult, today: str,
+                    old: Optional[List[dict]] = None) -> List[dict]:
+    """New baseline = every currently-live non-suppressed finding;
+    entries that survive keep their original `added` date so --report's
+    oldest-first ordering stays honest."""
+    if old is None:
+        old = load_baseline()
+    dates = {(e["rule"], e["path"], e["message"]): e.get("added", today)
+             for e in old}
+    out = []
+    for f in result.baselined + result.active:
+        out.append({"rule": f.rule, "path": f.path, "message": f.message,
+                    "added": dates.get(f.key(), today)})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several checkers
+
+def dotted_name(node: ast.AST) -> str:
+    """`a.b.c` -> "a.b.c"; non-trivial bases collapse to their last
+    attribute chain (best-effort; "" when unnameable)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def iter_functions(tree: ast.AST):
+    """Yield (qualname, node, class_name) for every def, with one level
+    of class nesting resolved (methods come out as Class.name, once)."""
+    method_ids = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    method_ids.add(id(sub))
+                    yield f"{node.name}.{sub.name}", sub, node.name
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and id(node) not in method_ids:
+            yield node.name, node, None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents

@@ -1,70 +1,56 @@
 #!/usr/bin/env python
 """Lint: flight-recorder event kinds in code <-> docs/Observability.md.
 
-Same contract as check_phase_docs.py, for the discrete event stream: an
-event emitted in code but missing from the docs' event-kind table is a
-record nobody knows to query, and a documented kind no code emits is a
-schema lying about coverage. This check extracts
+Now a thin shim over the graft-lint framework: extraction lives in
+``tools.analysis.docs_tables`` and the same sync runs (with recorder
+phases and telemetry counters) as the ``registry-sync`` rule of
+``python -m tools.analysis``. This entry point keeps the historical CLI
+and the ``code_kinds``/``doc_kinds``/``check``/``main`` API that
+tests/test_serving_obs.py loads by file path.
 
-* every literal ``*.emit("kind", ...)`` call under ``lightgbm_tpu/``
-  (the pattern tolerates the call spanning lines), and
-* every backticked name in the FIRST column of the event table in
-  ``docs/Observability.md`` (header row ``| kind | emitted by |``),
-
-and fails (exit 1) on any difference, in either direction. The
-``iteration`` record is emitted through a dedicated helper rather than
-a literal ``emit("iteration")`` call, so it is exempt on both sides.
-Run directly or via tests/test_tools.py (tier-1, fast — pure text).
+Fails (exit 1) on any difference between the literal ``*.emit("kind")``
+calls under ``lightgbm_tpu/`` and the first column of the
+``| kind | emitted by |`` table, in either direction. The ``iteration``
+record is emitted through a dedicated helper rather than a literal
+``emit("iteration")`` call, so it is exempt on both sides.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Set, Tuple
+from typing import Iterable, Set, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:          # loaded by file path in tests
+    sys.path.insert(0, REPO)
+
+from tools.analysis import docs_tables as dt   # noqa: E402
+
 PKG_DIR = os.path.join(REPO, "lightgbm_tpu")
 DOCS_PATH = os.path.join(REPO, "docs", "Observability.md")
 
-# matches events.emit("kind" / telem_events.emit(\n    "kind" — the
-# serve_warmup emit spans lines, so \s* must cross newlines (it does:
-# findall over whole-file text, \s matches \n)
-_EMIT_CALL = re.compile(r"\.emit\(\s*[\"']([a-z0-9_]+)[\"']")
+# kept for callers that referenced the exemption here
+_EXEMPT = dt.EVENT_EXEMPT
 
-# emitted via events.iteration_record(), not a literal emit() call
-_EXEMPT = {"iteration"}
+
+def _texts(pkg_dir: str) -> Iterable[str]:
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                with open(os.path.join(root, fn)) as f:
+                    yield f.read()
 
 
 def code_kinds(pkg_dir: str = PKG_DIR) -> Set[str]:
     """All literal event kinds emitted anywhere in the package."""
-    names: Set[str] = set()
-    for root, _dirs, files in os.walk(pkg_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(root, fn)) as f:
-                names.update(_EMIT_CALL.findall(f.read()))
-    return names - _EXEMPT
+    return dt.code_literals(_texts(pkg_dir), dt.EMIT_CALL) - _EXEMPT
 
 
 def doc_kinds(docs_path: str = DOCS_PATH) -> Set[str]:
     """Backticked names from the first column of the event-kind table
     (the table whose header row is ``| kind | emitted by |``)."""
-    names: Set[str] = set()
-    in_table = False
     with open(docs_path) as f:
-        for line in f:
-            stripped = line.strip()
-            if re.match(r"^\|\s*kind\s*\|\s*emitted by\s*\|", stripped):
-                in_table = True
-                continue
-            if in_table:
-                if not stripped.startswith("|"):
-                    break                      # table ended
-                first_col = stripped.split("|")[1]
-                names.update(re.findall(r"`([a-z0-9_]+)`", first_col))
-    return names - _EXEMPT
+        return dt.doc_first_column(f.read(), dt.EVENT_HEADER) - _EXEMPT
 
 
 def check() -> Tuple[Set[str], Set[str]]:

@@ -1,61 +1,51 @@
 #!/usr/bin/env python
 """Lint: recorder phase names in code <-> docs/Observability.md table.
 
-The per-iteration phase breakdown is only as trustworthy as its
-documentation: a phase added in code but missing from the docs table is
-invisible to whoever reads a waterfall, and a documented phase that no
-code records is a dashboard lying about coverage. This check extracts
+Now a thin shim over the graft-lint framework: extraction lives in
+``tools.analysis.docs_tables`` and the same sync runs (with event kinds
+and telemetry counters) as the ``registry-sync`` rule of
+``python -m tools.analysis``. This entry point keeps the historical CLI
+and the ``code_phases``/``doc_phases``/``check``/``main`` API that
+tests/test_observability.py loads by file path.
 
-* every literal ``phase("name")`` call under ``lightgbm_tpu/``, and
-* every backticked name in the FIRST column of the phase table in
-  ``docs/Observability.md``,
-
-and fails (exit 1) on any difference, in either direction. Run directly
-or via tests/test_tools.py (tier-1, fast — pure text, no jax).
+Fails (exit 1) on any difference between the literal ``phase("name")``
+calls under ``lightgbm_tpu/`` and the first column of the
+``| Phase | Where |`` table, in either direction.
 """
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Set, Tuple
+from typing import Iterable, Set, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:          # loaded by file path in tests
+    sys.path.insert(0, REPO)
+
+from tools.analysis import docs_tables as dt   # noqa: E402
+
 PKG_DIR = os.path.join(REPO, "lightgbm_tpu")
 DOCS_PATH = os.path.join(REPO, "docs", "Observability.md")
 
-_PHASE_CALL = re.compile(r"\bphase\(\s*[\"']([a-z0-9_]+)[\"']")
+
+def _texts(pkg_dir: str) -> Iterable[str]:
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                with open(os.path.join(root, fn)) as f:
+                    yield f.read()
 
 
 def code_phases(pkg_dir: str = PKG_DIR) -> Set[str]:
     """All literal phase names recorded anywhere in the package."""
-    names: Set[str] = set()
-    for root, _dirs, files in os.walk(pkg_dir):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(root, fn)) as f:
-                names.update(_PHASE_CALL.findall(f.read()))
-    return names
+    return dt.code_literals(_texts(pkg_dir), dt.PHASE_CALL)
 
 
 def doc_phases(docs_path: str = DOCS_PATH) -> Set[str]:
     """Backticked names from the first column of the phase table (the
     table whose header row is ``| Phase | Where |``)."""
-    names: Set[str] = set()
-    in_table = False
     with open(docs_path) as f:
-        for line in f:
-            stripped = line.strip()
-            if re.match(r"^\|\s*Phase\s*\|\s*Where\s*\|", stripped):
-                in_table = True
-                continue
-            if in_table:
-                if not stripped.startswith("|"):
-                    break                      # table ended
-                first_col = stripped.split("|")[1]
-                names.update(re.findall(r"`([a-z0-9_]+)`", first_col))
-    return names
+        return dt.doc_first_column(f.read(), dt.PHASE_HEADER)
 
 
 def check() -> Tuple[Set[str], Set[str]]:

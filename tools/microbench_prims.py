@@ -48,6 +48,8 @@ bench("argsort int8 keys (N)", jax.jit(lambda k: jnp.argsort(k, stable=True)), k
 bench("sort f32 (N)", jax.jit(lambda g: jnp.sort(g)), g)
 bench("cumsum f32 (N)", jax.jit(lambda g: jnp.cumsum(g)), g)
 bench("masked stream hist per-bin VPU (F=1)",
+      # the python sum() IS the candidate being measured (unrolled 8-way
+      # masked reduction vs one-hot matmul). lint: disable=determinism
       jax.jit(lambda c, g: sum(jnp.sum(jnp.where(c[0] == b, g, 0.)) for b in range(8))),
       codes_t, g)
 bench("column slice from (F,N)",
