@@ -133,6 +133,10 @@ def initialize(coordinator_address: str, num_processes: int,
     _state["rank"] = int(process_id)
     counters.set_gauge("dist_process_count", int(num_processes))
     counters.set_gauge("dist_rank", int(process_id))
+    # trace events carry pid=rank from here on, so per-rank dumps load
+    # side-by-side in Perfetto and rank 0 can merge them
+    from ..telemetry import spans
+    spans.set_pid(int(process_id))
     log.info("jax.distributed initialized: rank %d of %d (coordinator %s)",
              process_id, num_processes, coordinator_address)
 

@@ -12,8 +12,12 @@ recovered event (ROADMAP "survive" pillar):
   stdlib sockets) plus a prober that pings every peer each
   ``dist_heartbeat_ms``; ``max_misses`` consecutive failures mark a
   rank dead within a bounded window even while the collective lane is
-  wedged. The wire protocol is a 12-byte magic echo — no payload, no
-  clock sync, nothing to version.
+  wedged. The wire protocol is a 12-byte magic echo followed by the
+  responder's 8-byte wall-clock stamp — each probe doubles as a
+  Cristian clock sample (telemetry/clock.py): per-peer RTT lands in
+  the ``dist_heartbeat_rtt_ms`` gauge and the offset estimate is what
+  rank 0 re-bases merged timelines with. A reply carrying only the
+  magic (no stamp) still counts as alive.
 * **failure classification** — ``classify_failure`` maps the exception
   soup a dead peer produces (gloo transport errors, typed
   ``CollectiveTimeout`` from resilience/faults.py) onto a single typed
@@ -43,10 +47,13 @@ from __future__ import annotations
 import os
 import pickle
 import socket
+import struct
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry import bundle as telem_bundle
+from ..telemetry import clock as telem_clock
 from ..telemetry import counters as telem_counters
 from ..telemetry import events as telem_events
 from ..utils import log
@@ -55,8 +62,11 @@ __all__ = ["RankFailure", "Supervisor", "classify_failure",
            "shrink_after_failure", "start_supervision", "active",
            "stop_supervision"]
 
-# request == response: liveness is "the event loop answered", nothing else
+# request: the 12-byte magic. response: magic + struct.pack("<d",
+# time.time()) — liveness is "the event loop answered"; the stamp makes
+# every probe a free clock-offset sample (telemetry/clock.py)
 _MAGIC = b"lgbm-tpu-hb1"
+_STAMP_LEN = 8
 
 # error-text signatures a dead gloo peer produces in the survivor; all
 # are catchable XlaRuntimeError / RuntimeError, measured on the probed
@@ -129,8 +139,9 @@ class Supervisor:
         return self.port
 
     def _serve_loop(self) -> None:
-        # accept, read the magic, echo it back, close. Any failure on a
-        # single connection is the prober's problem, not ours.
+        # accept, read the magic, echo it back with a wall-clock stamp,
+        # close. Any failure on a single connection is the prober's
+        # problem, not ours.
         while not self._stop.is_set():
             srv = self._listener
             if srv is None:
@@ -149,7 +160,8 @@ class Supervisor:
                             break
                         buf += chunk
                     if buf == _MAGIC:
-                        conn.sendall(_MAGIC)
+                        conn.sendall(_MAGIC
+                                     + struct.pack("<d", time.time()))
             except OSError:
                 continue
 
@@ -222,17 +234,27 @@ class Supervisor:
         if addr is None:
             return True
         try:
+            t0 = time.time()
             with socket.create_connection(addr,
                                           timeout=self._timeout_s) as s:
                 s.settimeout(self._timeout_s)
                 s.sendall(_MAGIC)
+                want = len(_MAGIC) + _STAMP_LEN
                 buf = b""
-                while len(buf) < len(_MAGIC):
-                    chunk = s.recv(len(_MAGIC) - len(buf))
+                while len(buf) < want:
+                    chunk = s.recv(want - len(buf))
                     if not chunk:
-                        return False
+                        break
                     buf += chunk
-                return buf == _MAGIC
+                t1 = time.time()
+                if buf[:len(_MAGIC)] != _MAGIC:
+                    return False
+                if len(buf) == want:
+                    # full reply: fold the round trip into the clock
+                    # estimate (offset error bounded by rtt/2)
+                    t_peer = struct.unpack("<d", buf[len(_MAGIC):])[0]
+                    telem_clock.observe(peer_rank, t0, t1, t_peer)
+                return True
         except OSError:
             return False
 
@@ -462,6 +484,13 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
             log.fatal("cannot re-form: old coordinator address unknown")
         new_port = int(old_coord.rsplit(":", 1)[1]) + len(dead)
         new_coord = f"{lead_host}:{new_port}"
+
+    # freeze the dying world's evidence BEFORE any teardown: after
+    # stop_supervision/clear_backends the prober state, ring and
+    # timeline describe a group that no longer exists
+    telem_bundle.maybe_capture(
+        "rank_failure", dead_ranks=dead, old_world=world,
+        failure=failure.reason if failure is not None else "requested")
 
     stop_supervision()
     telem_counters.incr("shrinks")

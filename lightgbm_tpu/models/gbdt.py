@@ -265,6 +265,11 @@ class GBDT:
         cfg = self.config
         telemetry.configure(getattr(cfg, "telemetry", "off"),
                             explicit="telemetry" in getattr(cfg, "raw", {}))
+        # resolved config rides along in any postmortem bundle (a dict
+        # assignment — free when bundling is off)
+        telemetry.bundle.set_context(
+            "config", {str(k): str(v)
+                       for k, v in sorted(getattr(cfg, "raw", {}).items())})
         if self.objective is None and cfg.objective != "none":
             self.objective = create_objective(cfg.objective, cfg)
         if self.objective is not None:

@@ -55,6 +55,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..telemetry import bundle as telem_bundle
 from ..telemetry import counters as telem_counters
 from ..telemetry import events as telem_events
 from ..telemetry import recorder as telem
@@ -324,6 +325,10 @@ def kill_point(iteration: int) -> None:
         telem_events.emit("fault", fault="kill_rank", iteration=iteration,
                           code=code)
         telem_events.flush()
+        # the victim's last act: freeze its world before os._exit skips
+        # every destructor (LGBM_TPU_BUNDLE_DIR unset = no-op)
+        telem_bundle.maybe_capture("kill_rank", iteration=iteration,
+                                   exit_code=code)
         log.warning("fault injection: kill_rank at iteration %d "
                     "(os._exit(%d))", iteration, code)
         os._exit(code)
@@ -387,6 +392,8 @@ def _call_with_deadline(fn, site: str, timeout_ms: float):
         telem_counters.incr("collective_timeouts")
         telem_events.emit("collective_timeout", site=site,
                           timeout_ms=timeout_ms)
+        telem_bundle.maybe_capture("collective_timeout", site=site,
+                                   timeout_ms=timeout_ms)
         log.warning("collective %s exceeded its %.0f ms deadline", site,
                     timeout_ms)
         raise CollectiveTimeout(

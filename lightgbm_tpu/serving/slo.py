@@ -32,6 +32,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from ..telemetry import bundle as telem_bundle
 from ..telemetry import counters as telem_counters
 from ..telemetry import events as telem_events
 
@@ -133,6 +134,9 @@ class SloMonitor:
                               p99_ms=fast["p99_ms"],
                               error_rate=fast["error_rate"],
                               requests=fast["requests"])
+            # outside self._lock (released above): capture writes files
+            telem_bundle.maybe_capture("slo_burn",
+                                       violation=fast["violation"])
         elif was and not now:
             telem_events.emit("slo_clear", window="fast",
                               p99_ms=fast["p99_ms"],

@@ -38,14 +38,15 @@ from __future__ import annotations
 import os
 
 from ..utils import log
-from . import aggregate, counters, events, recorder, spans, watchdogs
+from . import (aggregate, bundle, clock, counters, events, recorder,
+               spans, timeline, watchdogs)
 from .spans import span
 
 __all__ = ["counters", "recorder", "spans", "span", "events", "watchdogs",
-           "aggregate", "mode", "set_mode", "enabled", "resolve_mode",
-           "configure", "dump_trace", "telemetry_summary",
-           "phase_breakdown", "prometheus_text", "record_iteration",
-           "reset", "xla_trace_active"]
+           "aggregate", "bundle", "clock", "timeline", "mode", "set_mode",
+           "enabled", "resolve_mode", "configure", "dump_trace",
+           "telemetry_summary", "phase_breakdown", "prometheus_text",
+           "record_iteration", "reset", "xla_trace_active"]
 
 MODES = ("off", "summary", "trace")
 _mode = "off"
@@ -235,6 +236,9 @@ def reset() -> None:
     events.reset()
     watchdogs.reset()
     aggregate.reset()
+    clock.reset()
+    timeline.reset()
+    bundle.reset()
 
 
 try:

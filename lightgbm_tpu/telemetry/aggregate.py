@@ -43,7 +43,7 @@ import statistics
 import time
 from typing import Dict, List, Optional
 
-from . import counters, events, recorder
+from . import clock, counters, events, recorder, spans, timeline
 
 __all__ = ["period", "straggler_threshold_s", "enabled", "maybe_tick",
            "skew_table", "prometheus_extras", "reset"]
@@ -59,7 +59,15 @@ _state = {
     "skew_table": [],        # rank-0 rows from the latest tick
     "fleet_counters": {},    # rank-0 fleet-summed counters
     "stragglers": 0,
+    "last_iter_shipped": -1,  # newest iteration record already shipped
+    "last_span_ts": 0.0,      # newest span ts (us) already shipped
 }
+
+# per-tick payload caps: a tick ships at most this many iteration
+# records / raw span events per rank, so the gather stays small even
+# with a large period or a span-heavy serving thread
+_MAX_ITER_RECORDS = 64
+_MAX_SPANS = 4096
 
 
 def period() -> int:
@@ -100,7 +108,7 @@ def _local_summary(iteration: int) -> dict:
     iters = bd["iterations"] - prev["iterations"]
     wall = bd["wall_s"] - prev["wall_s"]
     _state["prev_totals"] = bd
-    return {
+    summary = {
         "rank": bootstrap.rank(),
         "iteration": iteration,
         "arrival_ts": time.time(),
@@ -110,6 +118,29 @@ def _local_summary(iteration: int) -> dict:
         "phases": phases,
         "counters": {k: counters.get(k) for k in _SHIPPED_COUNTERS},
     }
+    # per-iteration records since the last tick: rank 0's timeline
+    # store turns these into critical-path attribution (timeline.py)
+    recs = []
+    for rec in events.events("iteration"):
+        it = rec.get("iteration")
+        if isinstance(it, int) and it > _state["last_iter_shipped"]:
+            recs.append({"iteration": it, "ts": rec.get("ts"),
+                         "wall_s": rec.get("wall_s"),
+                         "phases": rec.get("phases") or {}})
+    if recs:
+        recs = recs[-_MAX_ITER_RECORDS:]
+        _state["last_iter_shipped"] = recs[-1]["iteration"]
+        summary["iter_records"] = recs
+    # in trace mode ship the raw span ring too — this is what makes the
+    # merged Perfetto trace phase-resolved instead of iteration-boxed
+    if spans.enabled():
+        new_spans = [ev for ev in spans.events()
+                     if ev.get("ts", 0.0) > _state["last_span_ts"]]
+        if new_spans:
+            new_spans = new_spans[-_MAX_SPANS:]
+            _state["last_span_ts"] = max(ev["ts"] for ev in new_spans)
+            summary["spans"] = new_spans
+    return summary
 
 
 def _ingest(summaries: List[dict]) -> List[dict]:
@@ -141,10 +172,18 @@ def _ingest(summaries: List[dict]) -> List[dict]:
             fleet[k] = fleet.get(k, 0.0) + float(v)
     _state["skew_table"] = table
     _state["fleet_counters"] = fleet
+    # feed the cross-rank timeline store: re-base each rank's records
+    # and spans with its learned clock offset, then attribute every
+    # iteration all ranks have now reported
+    for s in summaries:
+        timeline.ingest(s["rank"], s.get("iter_records"),
+                        s.get("spans"), clock.offset_s(s["rank"]))
+    cp_rows = timeline.attribute_pending(world=len(summaries))
     events.emit("fleet", ranks=len(summaries),
                 iteration=summaries[0]["iteration"] if summaries else None,
                 skew_table=[{k: v for k, v in row.items() if k != "phases"}
-                            for row in table])
+                            for row in table],
+                critical_path=cp_rows or None)
     return table
 
 
@@ -191,3 +230,5 @@ def reset() -> None:
     _state["skew_table"] = []
     _state["fleet_counters"] = {}
     _state["stragglers"] = 0
+    _state["last_iter_shipped"] = -1
+    _state["last_span_ts"] = 0.0

@@ -1,0 +1,133 @@
+"""Per-peer clock alignment from the heartbeat wire.
+
+Merging per-rank timelines (timeline.py) needs every rank's span
+timestamps on one time base, but each host stamps with its own wall
+clock. Rather than adding a sync protocol, this module piggybacks on
+the supervision heartbeat (distributed/supervisor.py): the responder
+already answers every probe, and since PR 15 its reply carries the
+responder's ``time.time()``. That makes each probe a Cristian-style
+clock sample — the prober records ``t0`` just before the request and
+``t1`` when the reply is complete, and if network delays are symmetric
+the peer's clock read the midpoint when it stamped:
+
+    offset = t_peer - (t0 + t1) / 2        rtt = t1 - t0
+
+The estimate can be wrong by at most the asymmetry, so ``rtt / 2`` is a
+hard error bound (pinned by a unit test). Samples are EWMA-smoothed so
+one slow probe does not jerk the timeline re-basing; the bound reported
+is the tightest (minimum-RTT) sample's, which is the classic Cristian
+refinement.
+
+Exports per-peer labeled gauges ``dist_clock_skew_ms{rank="r"}`` and
+``dist_heartbeat_rtt_ms{rank="r"}`` and emits a ``clock_skew`` event on
+the first sample per peer (then periodically), so run reports show the
+alignment quality the merged trace was built with.
+
+Everything here runs on the prober thread — never on the training hot
+path — and a process with supervision off simply has no samples:
+``offset_s`` returns 0.0, which is exact for the single-host case.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from . import counters, events
+
+__all__ = ["observe", "offset_s", "error_bound_s", "offsets",
+           "max_abs_skew_ms", "snapshot", "reset"]
+
+# EWMA weight of the newest sample; ~15 samples to converge, which the
+# default 500 ms heartbeat reaches in seconds
+ALPHA = 0.2
+# re-emit the clock_skew event every this many samples per peer
+_EVENT_EVERY = 256
+
+_lock = threading.Lock()
+# rank -> {offset_s, rtt_s, best_offset_s, best_rtt_s, samples}
+_peers: Dict[int, dict] = {}
+
+
+def observe(peer_rank: int, t0: float, t1: float,
+            t_peer: float) -> Tuple[float, float]:
+    """Fold one probe round-trip into the peer's estimate. ``t0``/``t1``
+    are the prober's wall clock around the exchange, ``t_peer`` the
+    responder's stamp. Returns this sample's ``(offset_s, rtt_s)``."""
+    rtt = max(float(t1) - float(t0), 0.0)
+    sample = float(t_peer) - (float(t0) + float(t1)) / 2.0
+    peer_rank = int(peer_rank)
+    with _lock:
+        st = _peers.get(peer_rank)
+        if st is None:
+            st = {"offset_s": sample, "rtt_s": rtt,
+                  "best_offset_s": sample, "best_rtt_s": rtt,
+                  "samples": 0}
+            _peers[peer_rank] = st
+        else:
+            st["offset_s"] += ALPHA * (sample - st["offset_s"])
+            st["rtt_s"] += ALPHA * (rtt - st["rtt_s"])
+            if rtt < st["best_rtt_s"]:
+                st["best_rtt_s"] = rtt
+                st["best_offset_s"] = sample
+        st["samples"] += 1
+        n = st["samples"]
+        smoothed, rtt_smoothed = st["offset_s"], st["rtt_s"]
+        bound = st["best_rtt_s"] / 2.0
+    # gauges/events outside _lock: they take their own locks and must
+    # never nest under this one
+    label = f'{{rank="{peer_rank}"}}'
+    counters.set_gauge("dist_clock_skew_ms" + label, smoothed * 1e3)
+    counters.set_gauge("dist_heartbeat_rtt_ms" + label, rtt_smoothed * 1e3)
+    if n == 1 or n % _EVENT_EVERY == 0:
+        events.emit("clock_skew", rank=peer_rank,
+                    offset_ms=round(smoothed * 1e3, 3),
+                    rtt_ms=round(rtt_smoothed * 1e3, 3),
+                    bound_ms=round(bound * 1e3, 3), samples=n)
+    return sample, rtt
+
+
+def offset_s(rank: int) -> float:
+    """Smoothed offset of ``rank``'s clock relative to this process
+    (positive = peer's clock is ahead). 0.0 when no samples exist —
+    exact for self and for co-located single-clock topologies."""
+    with _lock:
+        st = _peers.get(int(rank))
+        return float(st["offset_s"]) if st else 0.0
+
+
+def error_bound_s(rank: int) -> Optional[float]:
+    """Tightest RTT/2 bound observed for ``rank``, or None before the
+    first sample."""
+    with _lock:
+        st = _peers.get(int(rank))
+        return st["best_rtt_s"] / 2.0 if st else None
+
+
+def offsets() -> Dict[int, dict]:
+    """Snapshot of every peer's estimate (offset/rtt/bound/samples)."""
+    with _lock:
+        return {r: {"offset_s": st["offset_s"], "rtt_s": st["rtt_s"],
+                    "bound_s": st["best_rtt_s"] / 2.0,
+                    "samples": st["samples"]}
+                for r, st in _peers.items()}
+
+
+def max_abs_skew_ms() -> float:
+    """Largest |smoothed offset| across peers in ms (0.0 with no
+    samples) — the one-number summary dist_smoke ships."""
+    with _lock:
+        if not _peers:
+            return 0.0
+        return max(abs(st["offset_s"]) for st in _peers.values()) * 1e3
+
+
+def snapshot() -> dict:
+    """JSON-able dump for postmortem bundles."""
+    return {"peers": {str(r): {k: round(v, 9) if isinstance(v, float)
+                               else v for k, v in st.items()}
+                      for r, st in offsets().items()}}
+
+
+def reset() -> None:
+    with _lock:
+        _peers.clear()

@@ -23,7 +23,7 @@ from collections import deque
 from typing import Dict, List
 
 __all__ = ["NULL_SPAN", "span", "add_event", "enable", "enabled",
-           "events", "clear", "dump_trace"]
+           "events", "clear", "dump_trace", "epoch", "set_pid", "pid"]
 
 
 class _NullSpan:
@@ -44,6 +44,33 @@ _enabled = False
 _lock = threading.Lock()
 _events = deque(maxlen=max(16, int(os.environ.get(
     "LGBM_TPU_TRACE_RING", 65536))))
+
+# perf_counter -> unix epoch at import: every event's `ts` lands on the
+# wall clock (microseconds since the unix epoch), a base that is common
+# across processes — which is what merging per-rank traces requires.
+# Monotonicity within the process is preserved (the offset is constant).
+_EPOCH = time.time() - time.perf_counter()
+
+# trace `pid` override: the distributed bootstrap sets this to the rank
+# so per-rank dumps load side-by-side in Perfetto (one track per rank)
+# even before rank 0 merges them. None = real os.getpid().
+_pid = None
+
+
+def epoch() -> float:
+    """The constant perf_counter -> unix-seconds offset used for `ts`."""
+    return _EPOCH
+
+
+def set_pid(value) -> None:
+    """Override the `pid` stamped on trace events (bootstrap passes the
+    rank; None restores the real process id)."""
+    global _pid
+    _pid = None if value is None else int(value)
+
+
+def pid() -> int:
+    return os.getpid() if _pid is None else _pid
 
 
 def enable(flag: bool = True) -> None:
@@ -87,8 +114,9 @@ def add_event(name: str, dur_s: float, t0: float = None, **args) -> None:
         return
     if t0 is None:
         t0 = time.perf_counter() - dur_s
-    ev = {"name": name, "ph": "X", "ts": t0 * 1e6, "dur": dur_s * 1e6,
-          "pid": os.getpid(), "tid": threading.get_ident()}
+    ev = {"name": name, "ph": "X", "ts": (t0 + _EPOCH) * 1e6,
+          "dur": dur_s * 1e6, "pid": pid(),
+          "tid": threading.get_ident()}
     if args:
         ev["args"] = args
     with _lock:
@@ -108,9 +136,12 @@ def clear() -> None:
 
 def dump_trace(path: str) -> str:
     """Write the ring as a Chrome trace-event JSON file; returns `path`.
-    Timestamps are perf_counter microseconds (one consistent monotonic
-    origin per process), which is all the trace viewers require."""
-    doc = {"traceEvents": events(), "displayTimeUnit": "ms"}
+    Timestamps are wall-clock microseconds (unix epoch base), so dumps
+    from different ranks share one time base and load side-by-side."""
+    meta = [{"name": "process_name", "ph": "M", "pid": pid(),
+             "args": {"name": (f"rank {_pid}" if _pid is not None
+                               else f"pid {os.getpid()}")}}]
+    doc = {"traceEvents": meta + events(), "displayTimeUnit": "ms"}
     with open(path, "w") as fh:
         json.dump(doc, fh)
     return path

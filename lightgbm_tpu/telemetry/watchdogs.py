@@ -106,6 +106,12 @@ def _fire(monitor: str, iteration, value: float, baseline: float,
     events.emit("watchdog", monitor=monitor, iteration=iteration,
                 value=round(float(value), 6),
                 baseline=round(float(baseline), 6), factor=factor)
+    # postmortem evidence while the anomaly is still in the ring; the
+    # import is deferred (bundle imports this module for fired()) and
+    # the call site holds no lock — capture does file I/O
+    from . import bundle
+    bundle.maybe_capture("watchdog_" + monitor, monitor=monitor,
+                         iteration=iteration)
 
 
 def drift_threshold() -> float:
@@ -134,6 +140,9 @@ def fire_drift(where: str, value: float, threshold: float,
                 version=version, value=round(float(value), 6),
                 baseline=round(float(threshold), 6),
                 factor=1.0)
+    from . import bundle
+    bundle.maybe_capture("watchdog_drift_psi", where=where,
+                         version=version)
     return True
 
 

@@ -1,0 +1,597 @@
+"""Fleet deep-trace tests (PR 15): clock alignment, cross-rank
+timelines + critical-path attribution, postmortem bundles.
+
+Fast tier-1 coverage: Cristian offset math with the RTT/2 bound, the
+heartbeat wire carrying real clock samples between two in-process
+supervisors, span epoch/pid stamping, the pure attribution kernel,
+timeline ingest with offset re-basing and merged-trace export, bundle
+atomicity (manifest inventory vs disk), fault-driven captures
+(watchdog fire, kill_rank in a subprocess), torn-bundle handling in
+run_report, and the trace-mode warm overhead guard. The two-process
+delay_ms acceptance (merged trace + critical path charged to the
+delayed rank) is slow+distributed-tagged.
+"""
+import importlib.util
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_binary
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.distributed.supervisor import Supervisor
+from lightgbm_tpu.resilience import faults
+from lightgbm_tpu.telemetry import (bundle, clock, counters, events,
+                                    spans, timeline, watchdogs)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off_after(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_BUNDLE_DIR", raising=False)
+    telemetry.set_mode("off")
+    telemetry.reset()
+    events.set_sink(None)
+    spans.set_pid(None)
+    faults.clear()
+    yield
+    telemetry.set_mode("off")
+    telemetry.reset()
+    events.set_sink(None)
+    spans.set_pid(None)
+    faults.clear()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# clock: Cristian samples, EWMA, gauges, events
+
+
+def test_clock_offset_bounded_by_half_rtt():
+    """Synthetic probe exchanges with a known true offset and asymmetric
+    stamping inside the round trip: every sample must land within RTT/2
+    of the truth (the Cristian guarantee), and the EWMA converges."""
+    telemetry.set_mode("summary")
+    true_offset = 5.0
+    rtt = 0.010
+    # stamp the peer reply at varying points inside [t0, t1]
+    for i, frac in enumerate((0.1, 0.9, 0.5, 0.3, 0.7) * 4):
+        t0 = 100.0 + i
+        t1 = t0 + rtt
+        t_peer = (t0 + frac * rtt) + true_offset
+        sample, sample_rtt = clock.observe(1, t0, t1, t_peer)
+        assert abs(sample - true_offset) <= rtt / 2 + 1e-12
+        assert sample_rtt == pytest.approx(rtt)
+    assert clock.offset_s(1) == pytest.approx(true_offset, abs=rtt / 2)
+    assert clock.error_bound_s(1) == pytest.approx(rtt / 2)
+    assert clock.max_abs_skew_ms() == pytest.approx(true_offset * 1e3,
+                                                    abs=rtt * 1e3)
+    # unknown peer: exact-zero default (single-host case)
+    assert clock.offset_s(7) == 0.0 and clock.error_bound_s(7) is None
+    # labeled gauges + the first-sample clock_skew event
+    assert counters.get('dist_clock_skew_ms{rank="1"}') \
+        == pytest.approx(true_offset * 1e3, abs=rtt * 1e3)
+    assert counters.get('dist_heartbeat_rtt_ms{rank="1"}') \
+        == pytest.approx(rtt * 1e3, rel=0.01)
+    skews = events.events("clock_skew")
+    assert len(skews) == 1 and skews[0]["rank"] == 1
+    assert skews[0]["bound_ms"] == pytest.approx(rtt / 2 * 1e3, rel=0.01)
+
+
+def test_clock_ewma_rejects_one_slow_probe():
+    clock.reset()
+    for i in range(20):
+        clock.observe(2, 10.0 + i, 10.001 + i, 10.0005 + i)  # offset 0
+    before = clock.offset_s(2)
+    clock.observe(2, 50.0, 50.4, 50.39)     # one 400ms-RTT outlier
+    after = clock.offset_s(2)
+    # EWMA damps the jerk to ALPHA of the outlier's raw offset
+    assert abs(after - before) < 0.2 * abs(0.19) + 1e-6
+    # and the reported bound stays the tight (min-RTT) sample's
+    assert clock.error_bound_s(2) == pytest.approx(0.0005, rel=0.01)
+
+
+def test_heartbeat_probe_feeds_clock_same_host():
+    """Two in-process supervisors: a real probe exchange produces a
+    clock sample whose offset is within the RTT/2 bound of 0 (both
+    ranks share one wall clock)."""
+    telemetry.set_mode("summary")
+    responder = Supervisor(0, {})
+    responder.start_listener()
+    prober = Supervisor(1, {0: ("127.0.0.1", responder.port)},
+                        heartbeat_ms=200.0)
+    try:
+        for _ in range(5):
+            assert prober._probe_once(0)
+    finally:
+        responder.stop()
+    offs = clock.offsets()
+    assert 0 in offs and offs[0]["samples"] == 5
+    bound = clock.error_bound_s(0)
+    assert bound is not None and bound > 0
+    # same clock: every sample obeys |sample| <= rtt/2, so the EWMA obeys
+    # the EWMA'd bound (best-sample bound only constrains the best sample)
+    assert abs(offs[0]["offset_s"]) <= offs[0]["rtt_s"] / 2 + 1e-6
+    assert counters.get('dist_heartbeat_rtt_ms{rank="0"}') > 0
+    assert events.events("clock_skew")
+
+
+def test_heartbeat_magic_only_reply_counts_alive():
+    """A stamp-less responder (old wire format) still probes alive —
+    just contributes no clock sample."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    import threading
+
+    def _answer():
+        conn, _ = srv.accept()
+        with conn:
+            conn.recv(64)
+            conn.sendall(b"lgbm-tpu-hb1")     # magic, no stamp
+    t = threading.Thread(target=_answer, daemon=True)
+    t.start()
+    prober = Supervisor(1, {0: ("127.0.0.1", srv.getsockname()[1])},
+                        heartbeat_ms=200.0)
+    try:
+        assert prober._probe_once(0)
+    finally:
+        srv.close()
+        t.join(timeout=2)
+    assert 0 not in clock.offsets()
+
+
+# ---------------------------------------------------------------------------
+# spans: process-epoch base + rank pid
+
+
+def test_spans_epoch_base_and_rank_pid(tmp_path):
+    telemetry.set_mode("trace")
+    with spans.span("probe"):
+        pass
+    ev = spans.events()[-1]
+    # ts is wall-clock microseconds since the unix epoch
+    assert ev["ts"] == pytest.approx(time.time() * 1e6, abs=60e6)
+    assert ev["pid"] == os.getpid()
+    spans.set_pid(3)
+    with spans.span("probe2"):
+        pass
+    assert spans.events()[-1]["pid"] == 3
+    path = str(tmp_path / "t.json")
+    spans.dump_trace(path)
+    doc = json.load(open(path))
+    meta = doc["traceEvents"][0]
+    assert meta["ph"] == "M" and meta["args"]["name"] == "rank 3"
+    assert meta["pid"] == 3
+
+
+# ---------------------------------------------------------------------------
+# timeline: pure attribution + ingest/re-base/merge
+
+
+def test_attribute_iteration_charges_the_slow_rank():
+    row = timeline.attribute_iteration(4, {
+        0: {"wall_s": 0.33, "phases": {"hist": 0.01, "collective": 0.31}},
+        1: {"wall_s": 0.33, "phases": {"hist": 0.30, "collective": 0.02}},
+    })
+    assert row["critical_rank"] == 1
+    assert row["ranks"][0]["wait_s"] == pytest.approx(0.29)
+    assert row["ranks"][0]["compute_s"] == pytest.approx(0.03)
+    assert row["ranks"][1]["wait_s"] == pytest.approx(0.0)
+    assert row["ranks"][1]["compute_s"] == pytest.approx(0.32)
+    # compute + wait recovers each rank's phase sum exactly
+    for r, ent in row["ranks"].items():
+        assert ent["compute_s"] + ent["wait_s"] == pytest.approx(
+            0.32 if r else 0.32)
+
+
+def test_attribute_iteration_tie_breaks_lowest_rank():
+    row = timeline.attribute_iteration(0, {
+        1: {"wall_s": 0.1, "phases": {"hist": 0.1}},
+        0: {"wall_s": 0.1, "phases": {"hist": 0.1}},
+    })
+    assert row["critical_rank"] == 0      # no blocking time: tie -> 0
+
+
+def _feed_timeline(offset_r1=2.0):
+    """Two ranks, one iteration; rank 1's stamps are 2 s ahead."""
+    timeline.ingest(0, [{"iteration": 0, "ts": 100.0, "wall_s": 0.5,
+                         "phases": {"hist": 0.4, "collective": 0.05}}])
+    timeline.ingest(
+        1,
+        [{"iteration": 0, "ts": 100.0 + offset_r1, "wall_s": 0.5,
+          "phases": {"hist": 0.1, "collective": 0.35}}],
+        spans=[{"name": "hist", "ph": "X", "ts": (101.5 + offset_r1) * 1e6,
+                "dur": 1000.0, "pid": 99999, "tid": 1}],
+        offset_s=offset_r1)
+    return timeline.attribute_pending(world=2)
+
+
+def test_timeline_ingest_rebases_and_merges(tmp_path):
+    rows = _feed_timeline()
+    assert len(rows) == 1 and rows[0]["critical_rank"] == 0
+    assert rows[0]["ranks"][1]["wait_s"] == pytest.approx(0.30)
+    totals = timeline.per_rank_totals()
+    assert totals[1]["wait_s"] == pytest.approx(0.30)
+    merged = timeline.merged_trace_events()
+    meta = [e for e in merged if e["ph"] == "M"]
+    assert {m["pid"] for m in meta} == {0, 1}
+    # rank 1's raw span: pid rewritten to the rank, ts re-based onto
+    # rank 0's clock (minus the 2 s offset)
+    r1 = [e for e in merged if e["ph"] == "X" and e["pid"] == 1]
+    assert len(r1) == 1 and r1[0]["ts"] == pytest.approx(101.5e6)
+    # rank 0 shipped no spans: it gets a synthesized iteration mark
+    r0 = [e for e in merged if e["ph"] == "X" and e["pid"] == 0]
+    assert len(r0) == 1 and r0[0]["name"] == "iteration"
+    assert r0[0]["ts"] == pytest.approx((100.0 - 0.5) * 1e6)
+    path = timeline.write_merged_trace(str(tmp_path / "merged.json"))
+    assert path is not None
+    rr = _load_tool("run_report")
+    digest = rr._trace_digest(path)
+    assert set(digest) == {"0", "1"}
+    snap = timeline.snapshot()
+    assert snap["ranks"] == [0, 1] and snap["critical_path"]
+
+
+def test_timeline_waits_for_all_ranks():
+    timeline.ingest(0, [{"iteration": 3, "ts": 1.0, "wall_s": 0.1,
+                         "phases": {"hist": 0.1}}])
+    assert timeline.attribute_pending(world=2) == []
+    timeline.ingest(1, [{"iteration": 3, "ts": 1.0, "wall_s": 0.1,
+                         "phases": {"hist": 0.1}}])
+    assert len(timeline.attribute_pending(world=2)) == 1
+
+
+# ---------------------------------------------------------------------------
+# bundles: atomic capture, inventory, cooldown, rotation
+
+
+def _manifest_matches_disk(bundle_dir):
+    manifest = json.load(open(os.path.join(bundle_dir, "MANIFEST.json")))
+    for fname, size in manifest["files"].items():
+        fp = os.path.join(bundle_dir, fname)
+        assert os.path.isfile(fp), f"{fname} missing"
+        assert os.path.getsize(fp) == size, f"{fname} size drifted"
+    return manifest
+
+
+def test_bundle_capture_manifest_inventory(tmp_path, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_BUNDLE_DIR", str(tmp_path))
+    telemetry.set_mode("summary")
+    events.emit("fault", fault="synthetic")
+    bundle.set_context("config", {"num_leaves": "15"})
+    _feed_timeline()
+    clock.observe(1, 1.0, 1.01, 1.005)
+    path = bundle.maybe_capture("test_reason", iteration=9)
+    assert path and os.path.isdir(path)
+    assert not os.path.basename(path).startswith(".tmp-")
+    manifest = _manifest_matches_disk(path)
+    assert manifest["reason"] == "test_reason"
+    assert manifest["iteration"] == 9
+    for fname in ("events.jsonl", "trace.json", "counters.json",
+                  "config.json", "clock.json", "critical_path.json",
+                  "env.json"):
+        assert fname in manifest["files"], f"missing {fname}"
+    assert counters.get("bundles_captured") == 1
+    cap = events.events("bundle_captured")
+    assert len(cap) == 1 and cap[0]["path"] == path
+    # the captured ring does NOT contain its own bundle_captured event
+    ring = [json.loads(l) for l in open(os.path.join(path,
+                                                     "events.jsonl"))]
+    assert all(e["kind"] != "bundle_captured" for e in ring)
+    # per-reason cooldown swallows an immediate repeat
+    assert bundle.maybe_capture("test_reason") is None
+    # env fingerprint carries identity + LGBM_TPU_ env
+    env = json.load(open(os.path.join(path, "env.json")))
+    assert env["pid"] == os.getpid()
+    assert "LGBM_TPU_BUNDLE_DIR" in env["env"]
+
+
+def test_bundle_disabled_without_root():
+    telemetry.set_mode("summary")
+    assert not bundle.enabled()
+    assert bundle.maybe_capture("whatever") is None
+    with pytest.raises(RuntimeError):
+        bundle.capture("whatever")
+
+
+def test_bundle_rotation_keeps_newest(tmp_path, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_BUNDLE_DIR", str(tmp_path))
+    monkeypatch.setenv("LGBM_TPU_BUNDLE_COOLDOWN_S", "0")
+    monkeypatch.setenv("LGBM_TPU_BUNDLE_KEEP", "2")
+    telemetry.set_mode("summary")
+    paths = [bundle.maybe_capture(f"reason_{i}") for i in range(4)]
+    assert all(paths)
+    left = sorted(d for d in os.listdir(str(tmp_path))
+                  if d.startswith("bundle-"))
+    assert len(left) == 2
+    # the survivors are the two newest captures
+    assert {os.path.join(str(tmp_path), d) for d in left} \
+        == set(paths[-2:])
+
+
+def test_watchdog_fire_captures_bundle(tmp_path, monkeypatch):
+    """A delay_ms-driven slow iteration trips the slow_iter watchdog,
+    which must leave a complete bundle behind."""
+    monkeypatch.setenv("LGBM_TPU_BUNDLE_DIR", str(tmp_path))
+    telemetry.set_mode("summary")
+    watchdogs.configure("")
+
+    def one_iter(i):
+        t0 = time.perf_counter()
+        faults.sleep_point("train_iter")
+        telemetry.record_iteration(
+            {"iteration": i, "wall_s": time.perf_counter() - t0 + 0.005})
+
+    for i in range(6):                    # healthy baseline
+        one_iter(i)
+    faults.install("delay_ms=120")
+    one_iter(6)                           # ~25x the median wall
+    faults.clear()
+    assert watchdogs.fired().get("slow_iter") == 1
+    bundles = [d for d in os.listdir(str(tmp_path))
+               if d.startswith("bundle-")]
+    assert len(bundles) == 1 and "watchdog_slow_iter" in bundles[0]
+    manifest = _manifest_matches_disk(
+        os.path.join(str(tmp_path), bundles[0]))
+    assert manifest["reason"] == "watchdog_slow_iter"
+    assert manifest["monitor"] == "slow_iter"
+
+
+_KILL_WORKER = r"""
+import os, sys
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.resilience import faults
+from lightgbm_tpu.telemetry import events
+telemetry.set_mode("summary")
+events.emit("checkpoint", iteration=0, path="x.ckpt")
+faults.install("kill_rank@iter=2")
+for i in range(5):
+    faults.kill_point(i)
+raise SystemExit("kill_point never fired")
+"""
+
+
+def test_kill_rank_leaves_complete_bundle(tmp_path):
+    """kill_rank dies via os._exit — no atexit, no teardown — yet the
+    bundle written just before must be complete on disk."""
+    broot = tmp_path / "bundles"
+    script = tmp_path / "victim.py"
+    script.write_text(_KILL_WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["LGBM_TPU_BUNDLE_DIR"] = str(broot)
+    p = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 137, p.stderr[-2000:]
+    bundles = [d for d in os.listdir(str(broot))
+               if d.startswith("bundle-")]
+    assert len(bundles) == 1 and "kill_rank" in bundles[0]
+    manifest = _manifest_matches_disk(os.path.join(str(broot),
+                                                   bundles[0]))
+    assert manifest["reason"] == "kill_rank"
+    assert manifest["iteration"] == 2 and manifest["exit_code"] == 137
+    # the flight-recorder ring rode along, with the pre-kill events
+    ring = [json.loads(l) for l in
+            open(os.path.join(str(broot), bundles[0], "events.jsonl"))]
+    kinds = {e["kind"] for e in ring}
+    assert {"checkpoint", "fault"} <= kinds
+
+
+# ---------------------------------------------------------------------------
+# run_report: bundle input, torn bundles, rendered sections
+
+
+def test_run_report_renders_from_bundle_alone(tmp_path, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_BUNDLE_DIR", str(tmp_path))
+    telemetry.set_mode("summary")
+    _feed_timeline()
+    events.emit("fault", fault="synthetic")
+    path = bundle.maybe_capture("watchdog_slow_iter", monitor="slow_iter")
+    rr = _load_tool("run_report")
+    s = rr.summarize(path)
+    assert s["bundle"]["reason"] == "watchdog_slow_iter"
+    assert s["critical_path"] and s["trace_digest"]
+    md = rr.render(s)
+    for section in ("## Critical path", "## Timeline (merged trace)",
+                    "## Bundles", "watchdog_slow_iter"):
+        assert section in md, f"missing {section!r}"
+
+
+def test_run_report_skips_torn_bundles(tmp_path, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_BUNDLE_DIR", str(tmp_path))
+    monkeypatch.setenv("LGBM_TPU_BUNDLE_COOLDOWN_S", "0")
+    telemetry.set_mode("summary")
+    good = bundle.maybe_capture("good_reason")
+    # torn variant 1: no manifest at all (crash mid-capture)
+    t1 = tmp_path / "bundle-20200101-000000-torn-r0-p1"
+    t1.mkdir()
+    (t1 / "events.jsonl").write_text('{"kind": "fault"}\n')
+    # torn variant 2: manifest inventory disagrees with disk
+    t2 = tmp_path / "bundle-20200101-000001-short-r0-p1"
+    t2.mkdir()
+    (t2 / "MANIFEST.json").write_text(json.dumps(
+        {"reason": "short", "files": {"events.jsonl": 999}}))
+    (t2 / "events.jsonl").write_text("{}\n")
+    rr = _load_tool("run_report")
+    s = rr.summarize(str(tmp_path))             # the bundle ROOT
+    assert [row["name"] for row in s["bundles_index"]] \
+        == [os.path.basename(good)]
+    notes = {row["name"]: row["note"] for row in s["bundles_skipped"]}
+    assert "MANIFEST" in notes[t1.name]
+    assert "999" in notes[t2.name]
+    md = rr.render(s)                           # note, not traceback
+    assert "skipped" in md and t1.name in md
+    # a torn bundle given directly is also a note, not a crash
+    s2 = rr.summarize(str(t2))
+    assert s2["bundle"] is None and s2["bundles_skipped"]
+
+
+# ---------------------------------------------------------------------------
+# invariance + overhead with the full deep-trace stack on
+
+
+def test_trace_mode_overhead_under_2pct(tmp_path, monkeypatch):
+    """Warm-jit A/B on ONE booster: trace mode (span ring + events +
+    recorder) vs everything off. Same <2%-or-<2ms gate as the events
+    guard."""
+    monkeypatch.delenv("LGBM_TPU_XLA_TRACE", raising=False)
+    x, y = make_binary(n=2000, f=10, seed=5)
+    bst = lgb.Booster({"objective": "binary", "num_leaves": 15,
+                       "verbosity": -1}, lgb.Dataset(x, y))
+
+    def timed(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            bst.update()
+        _ = bst._gbdt.models
+        return (time.perf_counter() - t0) / k
+
+    for _ in range(4):
+        bst.update()
+    _ = bst._gbdt.models
+    k = 5
+    telemetry.set_mode("off")
+    t_off = min(timed(k), timed(k))
+    telemetry.set_mode("trace")
+    timed(1)                            # burn-in after the flip
+    t_on = min(timed(k), timed(k))
+    assert spans.events(), "trace mode recorded no spans"
+    overhead = (t_on - t_off) / t_off
+    assert overhead < 0.02 or (t_on - t_off) < 2e-3, (
+        f"trace overhead {overhead:.1%} "
+        f"({t_off * 1e3:.2f} -> {t_on * 1e3:.2f} ms/iter)")
+
+
+# ---------------------------------------------------------------------------
+# slow: two-process delay_ms acceptance — ONE merged trace, critical
+# path charges the delayed rank, offsets honor the RTT/2 bound
+# ---------------------------------------------------------------------------
+
+_DEEP_WORKER = r"""
+import json, os, sys, time
+import numpy as np
+rank = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
+import jax
+from lightgbm_tpu.distributed import bootstrap, ingest, supervisor
+bootstrap.initialize(f"127.0.0.1:{port}", 2, rank)
+assert bootstrap.is_distributed()
+supervisor.start_supervision(50.0)
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine
+from lightgbm_tpu.telemetry import clock, timeline
+
+r = np.random.RandomState(7)
+n, f = 1200, 6
+x = r.randn(n, f)
+y = (1.5 * x[:, 0] - x[:, 1] + r.randn(n) * 0.5 > 0).astype(np.float64)
+params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+          "max_bin": 63, "min_data_in_leaf": 20, "tree_learner": "data",
+          "metric": "none"}
+ds = ingest.wrap_train_set(ingest.load_sharded(x, label=y, params=params))
+engine.train(dict(params), ds, num_boost_round=4, verbose_eval=False)
+time.sleep(0.3)                   # a few extra heartbeat clock samples
+supervisor.stop_supervision()
+out = {"rank": rank, "offsets": {str(k): v
+                                 for k, v in clock.offsets().items()}}
+if rank == 0:
+    out["critical_path"] = timeline.critical_path()
+    out["merged_trace"] = timeline.write_merged_trace(
+        os.path.join(outdir, "merged.json"))
+with open(os.path.join(outdir, f"r{rank}.json"), "w") as fh:
+    json.dump(out, fh)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.distributed
+def test_two_process_critical_path_charges_delayed_rank(tmp_path):
+    """Acceptance: trace mode + supervision + per-iteration aggregation
+    on a two-process run with delay_ms=300 on rank 1 -> rank 0 holds
+    ONE merged trace with both rank tracks, the critical path charges
+    the delay to rank 1 (everyone else's wait), compute+wait sums to
+    each rank's phase time within 5%, and the learned offsets honor
+    their own RTT/2 bounds."""
+    script = tmp_path / "worker.py"
+    script.write_text(_DEEP_WORKER)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = ""
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["LGBM_TPU_TELEMETRY"] = "trace"
+        # period 2, NOT 1: the aggregation gather is itself a sync
+        # point, and with a gather after every iteration the delayed
+        # rank is re-synced before the next update — the wait would
+        # land in the (unbracketed) gather instead of an iteration
+        # phase. With period 2 rank 1 enters every other update late
+        # and rank 0 blocks inside its bracketed host_sync.
+        env["LGBM_TPU_AGG_PERIOD"] = "2"
+        if r == 1:
+            env["LGBM_TPU_FAULT_SPEC"] = "delay_ms=300"
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port),
+             str(tmp_path)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            text=True))
+    for p in procs:
+        _, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err[-3000:]
+    r0 = json.load(open(tmp_path / "r0.json"))
+
+    # ONE merged trace with one track per rank, phase-resolved
+    assert r0["merged_trace"]
+    doc = json.load(open(r0["merged_trace"]))
+    pids = {e["pid"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert pids == {0, 1}
+    names = {e["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "X" and e["pid"] == 1}
+    assert "iteration" in names         # spans shipped, not just marks
+
+    # critical path: the 300 ms/iter delay on rank 1 lands as rank 0's
+    # wait, so rank 1 is the critical rank on the delayed iterations
+    cp = r0["critical_path"]
+    assert cp, "no attributed iterations on rank 0"
+    delayed = [row for row in cp
+               if row["ranks"]["0"]["wait_s"] > 0.15]
+    assert delayed, f"rank 0 never waited: {cp}"
+    assert all(row["critical_rank"] == 1 for row in delayed)
+    # compute + wait sums to the rank's in-phase time, which covers
+    # wall within the recorder's coverage slack (5%)
+    for row in delayed:
+        for ent in row["ranks"].values():
+            busy = ent["compute_s"] + ent["wait_s"]
+            assert busy <= ent["wall_s"] * 1.05 + 0.005
+            assert busy >= ent["wall_s"] * 0.80 - 0.005
+
+    # clock alignment: each rank learned its peer's offset, and on one
+    # host the true offset is 0 — the estimate must sit inside its own
+    # reported RTT/2 bound (plus scheduling slack)
+    for fname in ("r0.json", "r1.json"):
+        offs = json.load(open(tmp_path / fname))["offsets"]
+        assert len(offs) == 1
+        for ent in offs.values():
+            assert ent["samples"] >= 3
+            assert abs(ent["offset_s"]) <= ent["rtt_s"] / 2 + 0.005

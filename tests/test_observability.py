@@ -293,7 +293,7 @@ def test_fault_and_skip_iter_events():
     assert skip["reason"] == "non_finite"
 
 
-def test_float_path_byte_identical_with_events_on(tmp_path):
+def test_float_path_byte_identical_with_events_on(tmp_path, monkeypatch):
     def trees_text(bst):
         return bst._gbdt.save_model_to_string(0, -1).split(
             "\nparameters:")[0]
@@ -302,6 +302,13 @@ def test_float_path_byte_identical_with_events_on(tmp_path):
     events.set_sink(str(tmp_path / "inv.jsonl"))
     m_on = trees_text(_train({"telemetry": "summary"}, num_boost_round=5))
     assert m_off == m_on
+    # full deep-trace stack (span ring + bundle capture armed) must not
+    # perturb the model bytes either
+    monkeypatch.delenv("LGBM_TPU_XLA_TRACE", raising=False)
+    monkeypatch.setenv("LGBM_TPU_BUNDLE_DIR", str(tmp_path / "bundles"))
+    telemetry.set_mode("trace")
+    m_trace = trees_text(_train({"telemetry": "trace"}, num_boost_round=5))
+    assert m_off == m_trace
 
 
 def test_events_on_overhead_under_2pct(tmp_path):

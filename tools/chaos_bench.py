@@ -23,7 +23,12 @@ rank 1 hard-killed mid-train via the ``kill_rank@iter=`` fault verb;
 reports the survivor's detection latency, the recovery outcome
 (shrink to single-host + resume from the last rank-0 checkpoint), and
 whether the recovered model text is bit-identical to a single-host run
-resumed from that same checkpoint.
+resumed from that same checkpoint. The group runs with summary
+telemetry and a bundle root, so the scenario also reports the
+postmortem bundles left behind (the victim's ``kill_rank`` capture and
+the survivor's pre-teardown ``rank_failure`` capture) and whether
+tools/run_report.py can render a critical path from the survivor's
+bundle alone.
 
 Usage: python tools/chaos_bench.py [dist_kill]
 Env:   CHAOS_ROWS (6000), CHAOS_FEATURES (20), CHAOS_ITERS (24),
@@ -166,6 +171,29 @@ with open(out, "w") as fh:
 """
 
 
+def _bundle_report(root):
+    """Inventory the postmortem bundles a kill scenario left behind:
+    completeness via run_report's manifest validator, plus whether the
+    survivor's pre-teardown bundle ALONE yields a rendered critical
+    path (the bundle is the whole input — no event stream)."""
+    import run_report                               # tools/ on sys.path
+    _, index, skipped = run_report._resolve_bundle_dir(root)
+    reasons = sorted({str(row.get("reason")) for row in index})
+    report_cp = False
+    for row in index:
+        if row.get("reason") != "rank_failure":
+            continue
+        summ = run_report.summarize(os.path.join(root, row["name"]))
+        report_cp = bool(summ["critical_path"]) \
+            and bool(summ["trace_digest"])
+        break
+    return {"complete": len(index), "torn": len(skipped),
+            "reasons": reasons,
+            "kill_bundle": "kill_rank" in reasons,
+            "pre_teardown_bundle": "rank_failure" in reasons,
+            "report_from_bundle_ok": report_cp}
+
+
 def _kill_scenario(world, shard_mode):
     """One kill-and-continue measurement: `world` supervised processes,
     the last rank dies mid-run, the survivors shrink to world-1 and
@@ -191,6 +219,13 @@ def _kill_scenario(world, shard_mode):
         env["PYTHONPATH"] = (dist_smoke.REPO + os.pathsep
                              + env.get("PYTHONPATH", ""))
         env["XLA_FLAGS"] = ""            # 1 device per process
+        # deep-trace stack: per-iteration aggregation feeds rank 0's
+        # timeline store; the bundle root collects the victim's
+        # kill_rank capture and the survivor's pre-teardown capture
+        bundle_dir = os.path.join(tmp, "bundles")
+        env["LGBM_TPU_TELEMETRY"] = "summary"
+        env["LGBM_TPU_AGG_PERIOD"] = "1"
+        env["LGBM_TPU_BUNDLE_DIR"] = bundle_dir
         outs = [os.path.join(tmp, f"r{i}.json") for i in range(world)]
         args = [ckpt_dir, kill_iter, n, f, iters, leaves, world,
                 shard_mode]
@@ -224,6 +259,9 @@ def _kill_scenario(world, shard_mode):
         # freq 2 => iteration kill_iter - 1) — on world-1 devices
         ckpt_name = f"ckpt_iter_{kill_iter - 1:07d}.ckpt"
         envb = dict(env)
+        for k in ("LGBM_TPU_TELEMETRY", "LGBM_TPU_AGG_PERIOD",
+                  "LGBM_TPU_BUNDLE_DIR"):
+            envb.pop(k, None)       # baseline: plain resume, no capture
         if world > 2:
             envb["XLA_FLAGS"] = ("--xla_force_host_platform_device_count"
                                  f"={world - 1}")
@@ -231,6 +269,7 @@ def _kill_scenario(world, shard_mode):
         dist_smoke._run(script, [-1, 0, vout] + args + [ckpt_name], envb)
         with open(vout) as fh:
             base = json.load(fh)
+        bundles = _bundle_report(bundle_dir)
     detect_ms = (None if not r0.get("shrink_unix") else
                  round((r0["shrink_unix"] - t_kill) * 1e3, 1))
     return {
@@ -244,6 +283,7 @@ def _kill_scenario(world, shard_mode):
         "rank_failures": int(r0.get("rank_failures", 0)),
         "heartbeat_probes": int(r0.get("heartbeat_probes", 0)),
         "parity_vs_resume": bool(r0["model"] == base["model"]),
+        "bundles": bundles,
         "wall_secs": round(time.time() - t0, 1),
     }
 

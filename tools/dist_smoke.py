@@ -31,6 +31,11 @@ Emits ONE JSON line (`dist_smoke`) like the other tools/ benches:
   the only per-iteration traffic
 * ``collective_dispatches`` / ``collective_retries`` — host-collective
   counters from the bootstrap/barrier sites (resilience/faults.py)
+* ``clock_skew_ms`` + ``critical_path`` — the deep-trace pair: the
+  float run supervises with a 50 ms heartbeat (clock alignment from
+  the probe timestamps, telemetry/clock.py) and aggregates every
+  iteration, so rank 0's timeline store can attribute each iteration
+  into per-rank compute vs collective-wait (telemetry/timeline.py)
 
 Usage: python tools/dist_smoke.py
 Env:   DIST_ROWS (2000), DIST_FEATURES (8), DIST_ITERS (3),
@@ -61,11 +66,17 @@ rank = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
 quantized = sys.argv[4] == "1"
 N, F, ITERS, LEAVES = (int(v) for v in sys.argv[5:9])
 shard_mode = sys.argv[9]
+deep = os.environ.get("DIST_SMOKE_TELEMETRY") == "1"
+if deep:                       # before telemetry import resolves mode
+    os.environ["LGBM_TPU_TELEMETRY"] = "summary"
+    os.environ.setdefault("LGBM_TPU_AGG_PERIOD", "1")
 import jax
-from lightgbm_tpu.distributed import bootstrap, ingest
+from lightgbm_tpu.distributed import bootstrap, ingest, supervisor
 if rank >= 0:
     bootstrap.initialize(f"127.0.0.1:{port}", 2, rank)
     assert bootstrap.is_distributed() and len(jax.devices()) == 2
+    if deep:
+        supervisor.start_supervision(50.0)
 import lightgbm_tpu as lgb
 from lightgbm_tpu.telemetry import counters
 
@@ -114,6 +125,14 @@ payload = {"model": txt,
            "allgathers": counters.get("dist_allgathers"),
            "dispatches": counters.get("collective_dispatches"),
            "retries": counters.get("collective_retries")}
+if deep and rank >= 0:
+    import time as _time
+    _time.sleep(0.3)           # a few more heartbeat clock samples
+    from lightgbm_tpu.telemetry import clock, timeline
+    supervisor.stop_supervision()
+    payload["clock_skew_ms"] = clock.max_abs_skew_ms()
+    payload["critical_path"] = {
+        str(r): ent for r, ent in timeline.per_rank_totals().items()}
 with open(out, "w") as fh:
     json.dump(payload, fh)
 """
@@ -153,10 +172,12 @@ def _run(script, args, env, timeout=600):
         raise RuntimeError(f"worker failed:\n{p.stderr[-3000:]}")
 
 
-def _dist2(script, tmp, tag, quant, mode, n, f):
+def _dist2(script, tmp, tag, quant, mode, n, f, extra_env=None):
     """One 2-process localhost run; returns both rank payloads."""
     port = _free_port()
     env = _env()
+    if extra_env:
+        env.update(extra_env)
     outs = [os.path.join(tmp, f"{tag}_r{i}.json") for i in range(2)]
     args = [quant, n, f, ITERS, LEAVES, mode]
     procs = [subprocess.Popen(
@@ -177,9 +198,13 @@ def _dist2(script, tmp, tag, quant, mode, n, f):
     return res
 
 
-def _pair(script, tmp, quant):
-    """One parity measurement: 2-process localhost vs virtual mesh."""
-    r0, r1 = _dist2(script, tmp, f"p{quant}", quant, "replicated", N, F)
+def _pair(script, tmp, quant, deep=False):
+    """One parity measurement: 2-process localhost vs virtual mesh.
+    With deep=True the two dist workers run the deep-trace stack
+    (summary telemetry + supervision + per-iteration aggregation)."""
+    extra = {"DIST_SMOKE_TELEMETRY": "1"} if deep else None
+    r0, r1 = _dist2(script, tmp, f"p{quant}", quant, "replicated", N, F,
+                    extra_env=extra)
     envv = _env()
     envv["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     vout = os.path.join(tmp, f"v_{quant}.json")
@@ -188,7 +213,7 @@ def _pair(script, tmp, quant):
     with open(vout) as fh:
         v = json.load(fh)
     parity = (r0["model"] == r1["model"] == v["model"])
-    return parity, r0
+    return parity, r0, r1
 
 
 def main():
@@ -197,10 +222,10 @@ def main():
         script = os.path.join(tmp, "worker.py")
         with open(script, "w") as fh:
             fh.write(_WORKER)
-        parity, r0 = _pair(script, tmp, "0")
+        parity, r0, r1 = _pair(script, tmp, "0", deep=True)
         quant_parity = None
         if RUN_QUANT:
-            quant_parity, _ = _pair(script, tmp, "1")
+            quant_parity, _, _ = _pair(script, tmp, "1")
         mem = None
         if MEM_F > 0:
             rep = _dist2(script, tmp, "mem_rep", "0", "replicated", N,
@@ -248,6 +273,9 @@ def main():
         "allgathers": int(r0["allgathers"]),
         "collective_dispatches": int(r0["dispatches"]),
         "collective_retries": int(r0["retries"]),
+        "clock_skew_ms": round(max(r0.get("clock_skew_ms", 0.0),
+                                   r1.get("clock_skew_ms", 0.0)), 4),
+        "critical_path": r0.get("critical_path") or {},
     }
     if mem is not None:
         out.update(mem)

@@ -11,18 +11,32 @@ markdown document:
 * phase waterfall — per-phase seconds with ASCII bars
 * metric curve — per train/valid metric: first/best/last + sparkline
 * per-rank skew table — from the newest ``fleet`` aggregation event
+* critical path — per-iteration per-rank compute vs collective-wait
+  attribution (telemetry/timeline.py), from ``fleet`` events or a
+  bundle's ``critical_path.json``
 * serving section (when the stream came from a serving process):
   per-version traffic from sampled ``trace_span`` server spans, the
   drift-fire timeline, and the router decision log with the counter
   snapshot that justified each promote/demote
+* bundles — postmortem bundles captured during the run, and, when the
+  input IS a bundle, its manifest + merged-trace timeline digest
 * event timeline — every non-iteration event, time-offset ordered
 
 Rotation (``LGBM_TPU_EVENTS_MAX_MB``) is handled: a ``<path>.1``
 generation, when present, is read before the live file.
 
+Besides a JSONL stream the input may be a **postmortem bundle
+directory** (telemetry/bundle.py) — the report is then rendered from
+the bundle's own ``events.jsonl``/``critical_path.json``/``trace.json``
+alone — or a bundle ROOT (``LGBM_TPU_BUNDLE_DIR``): the newest complete
+bundle is rendered and every bundle is indexed. Torn bundles (a crash
+mid-capture leaves no ``MANIFEST.json``, or files missing/short
+against the manifest inventory) are skipped with a note, never a
+traceback.
+
 Usage::
 
-    python tools/run_report.py events.jsonl [-o report.md]
+    python tools/run_report.py events.jsonl|bundle_dir [-o report.md]
 
 Pure stdlib + no jax import: safe to run anywhere, including on a
 laptop against a JSONL scp'd off a pod.
@@ -62,6 +76,91 @@ def load_events(path: str) -> List[dict]:
     return out
 
 
+def _read_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def _bundle_manifest(path: str):
+    """-> (manifest, note). A readable manifest whose file inventory
+    matches the directory exactly means complete; anything else is a
+    torn capture and the note says why."""
+    manifest = _read_json(os.path.join(path, "MANIFEST.json"))
+    if not isinstance(manifest, dict):
+        return None, "no readable MANIFEST.json (torn capture?)"
+    for fname, size in (manifest.get("files") or {}).items():
+        fp = os.path.join(path, fname)
+        if not os.path.isfile(fp):
+            return None, f"manifest lists {fname} but it is missing"
+        try:
+            actual = os.path.getsize(fp)
+        except OSError:
+            return None, f"cannot stat {fname}"
+        if actual != int(size):
+            return None, (f"{fname} is {actual} bytes, manifest says "
+                          f"{size}")
+    return manifest, None
+
+
+def _resolve_bundle_dir(root: str):
+    """-> (dir_to_render, index_rows, skipped_rows). ``root`` is either
+    one bundle (has MANIFEST.json) or a bundle root full of them."""
+    manifest, note = _bundle_manifest(root)
+    if manifest is not None:
+        return root, [_index_row(os.path.basename(root), manifest)], []
+    if os.path.isfile(os.path.join(root, "MANIFEST.json")):
+        # it tried to be a bundle but the inventory is torn
+        return None, [], [{"name": os.path.basename(root), "note": note}]
+    index, skipped = [], []
+    newest = None
+    for name in sorted(os.listdir(root)):
+        sub = os.path.join(root, name)
+        if not os.path.isdir(sub) or not name.startswith(
+                ("bundle-", ".tmp-")):
+            continue
+        manifest, note = _bundle_manifest(sub)
+        if manifest is None:
+            skipped.append({"name": name, "note": note})
+        else:
+            index.append(_index_row(name, manifest))
+            newest = sub           # sorted() => last complete is newest
+    return newest, index, skipped
+
+
+def _index_row(name: str, manifest: dict) -> dict:
+    return {"name": name, "reason": manifest.get("reason"),
+            "ts_unix": manifest.get("ts_unix"),
+            "rank": manifest.get("rank"),
+            "files": sorted(manifest.get("files") or ())}
+
+
+def _trace_digest(path: str):
+    """Per-track digest of a Chrome trace file: events, extent, top
+    phases — the timeline rendered without a browser."""
+    doc = _read_json(path)
+    if not isinstance(doc, dict):
+        return None
+    tracks: Dict[str, dict] = {}
+    for ev in doc.get("traceEvents") or []:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        pid = str(ev.get("pid"))
+        tr = tracks.setdefault(pid, {"events": 0, "t0_us": None,
+                                     "t1_us": None, "phases": {}})
+        tr["events"] += 1
+        ts = float(ev.get("ts") or 0.0)
+        dur = float(ev.get("dur") or 0.0)
+        tr["t0_us"] = ts if tr["t0_us"] is None else min(tr["t0_us"], ts)
+        tr["t1_us"] = (ts + dur if tr["t1_us"] is None
+                       else max(tr["t1_us"], ts + dur))
+        name = str(ev.get("name"))
+        tr["phases"][name] = tr["phases"].get(name, 0.0) + dur / 1e6
+    return tracks or None
+
+
 def _bar(value: float, vmax: float, width: int = BAR_WIDTH) -> str:
     n = int(round(width * value / vmax)) if vmax > 0 else 0
     return "█" * max(n, 1 if value > 0 else 0)
@@ -82,8 +181,27 @@ def _sparkline(values: List[float], width: int = 32) -> str:
 
 def summarize(path: str) -> dict:
     """Digest the stream into the report's data model (also the
-    programmatic API — tests and bench tooling read this dict)."""
-    events = load_events(path)
+    programmatic API — tests and bench tooling read this dict).
+    ``path`` may be a JSONL stream, one bundle directory, or a bundle
+    root (the newest complete bundle is rendered, torn ones noted)."""
+    bundle_manifest = None
+    bundles_index: List[dict] = []
+    bundles_skipped: List[dict] = []
+    critical_path: List[dict] = []
+    trace_digest = None
+    if os.path.isdir(path):
+        bdir, bundles_index, bundles_skipped = _resolve_bundle_dir(path)
+        events: List[dict] = []
+        if bdir is not None:
+            bundle_manifest, _ = _bundle_manifest(bdir)
+            ev_path = os.path.join(bdir, "events.jsonl")
+            if os.path.exists(ev_path):
+                events = load_events(ev_path)
+            critical_path = _read_json(
+                os.path.join(bdir, "critical_path.json")) or []
+            trace_digest = _trace_digest(os.path.join(bdir, "trace.json"))
+    else:
+        events = load_events(path)
     iters = [e for e in events if e["kind"] == "iteration"]
     others = [e for e in events if e["kind"] != "iteration"]
     counts: Dict[str, int] = {}
@@ -125,6 +243,14 @@ def summarize(path: str) -> dict:
     router_log = [e for e in others
                   if e["kind"].startswith("router_")]
 
+    # critical path: the bundle's file wins; else accumulate the rows
+    # the fleet aggregation events carried
+    if not critical_path:
+        for e in others:
+            if e["kind"] == "fleet" and e.get("critical_path"):
+                critical_path.extend(e["critical_path"])
+    bundle_events = [e for e in others if e["kind"] == "bundle_captured"]
+
     return {
         "path": path,
         "events": len(events),
@@ -141,6 +267,12 @@ def summarize(path: str) -> dict:
         "serve_versions": serve_versions,
         "drift_fires": drift_fires,
         "router_log": router_log,
+        "critical_path": critical_path,
+        "bundle": bundle_manifest,
+        "bundles_index": bundles_index,
+        "bundles_skipped": bundles_skipped,
+        "bundle_events": bundle_events,
+        "trace_digest": trace_digest,
         "timeline": others,
     }
 
@@ -209,6 +341,61 @@ def render(summary: dict) -> str:
               f"| {'YES' if row.get('straggler') else ''} |")
         w("")
 
+    cp = summary["critical_path"]
+    if cp:
+        w("## Critical path")
+        w("")
+        totals: Dict[str, dict] = {}
+        for row in cp:
+            for r, ent in (row.get("ranks") or {}).items():
+                t = totals.setdefault(
+                    str(r), {"compute_s": 0.0, "wait_s": 0.0,
+                             "critical": 0})
+                t["compute_s"] += float(ent.get("compute_s") or 0.0)
+                t["wait_s"] += float(ent.get("wait_s") or 0.0)
+            crit = str(row.get("critical_rank"))
+            if crit in totals:
+                totals[crit]["critical"] += 1
+        w(f"{len(cp)} attributed iteration(s); the critical rank is the "
+          "one every other rank waited for.")
+        w("")
+        w("| rank | compute (s) | collective wait (s) | wait share "
+          "| iters critical |")
+        w("|---|---|---|---|---|")
+        for r in sorted(totals, key=lambda x: (len(x), x)):
+            t = totals[r]
+            busy = t["compute_s"] + t["wait_s"]
+            share = t["wait_s"] / busy * 100 if busy > 0 else 0.0
+            w(f"| {r} | {t['compute_s']:.4f} | {t['wait_s']:.4f} "
+              f"| {share:.1f}% | {t['critical']} |")
+        w("")
+        tail = cp[-8:]
+        w("| iteration | critical rank | per-rank wait (s) |")
+        w("|---|---|---|")
+        for row in tail:
+            waits = ", ".join(
+                f"r{r}={float(ent.get('wait_s') or 0.0):.4f}"
+                for r, ent in sorted((row.get("ranks") or {}).items(),
+                                     key=lambda kv: str(kv[0])))
+            w(f"| {row.get('iteration')} | {row.get('critical_rank')} "
+              f"| {waits} |")
+        w("")
+
+    if summary["trace_digest"]:
+        w("## Timeline (merged trace)")
+        w("")
+        w("| track | events | extent (s) | top phases |")
+        w("|---|---|---|---|")
+        for pid in sorted(summary["trace_digest"],
+                          key=lambda x: (len(x), x)):
+            tr = summary["trace_digest"][pid]
+            extent = ((tr["t1_us"] or 0.0) - (tr["t0_us"] or 0.0)) / 1e6
+            top = ", ".join(
+                f"{name}={secs:.3f}s" for name, secs in sorted(
+                    tr["phases"].items(), key=lambda kv: -kv[1])[:4])
+            w(f"| rank {pid} | {tr['events']} | {extent:.3f} | {top} |")
+        w("")
+
     if summary["serve_versions"] or summary["drift_fires"] \
             or summary["router_log"]:
         w("## Serving")
@@ -255,6 +442,32 @@ def render(summary: dict) -> str:
                   f"| {', '.join(bits)} |")
             w("")
 
+    if summary["bundle"] or summary["bundles_index"] \
+            or summary["bundles_skipped"] or summary["bundle_events"]:
+        w("## Bundles")
+        w("")
+        if summary["bundle"]:
+            m = summary["bundle"]
+            w(f"Rendered from bundle: reason=`{m.get('reason')}` "
+              f"rank={m.get('rank')}/{m.get('world')} "
+              f"pid={m.get('pid')}")
+            w("")
+        if summary["bundles_index"]:
+            w("| bundle | reason | rank | files |")
+            w("|---|---|---|---|")
+            for row in summary["bundles_index"]:
+                w(f"| {row['name']} | {row.get('reason')} "
+                  f"| {row.get('rank')} | {', '.join(row['files'])} |")
+            w("")
+        for row in summary["bundles_skipped"]:
+            w(f"- `{row['name']}` skipped: {row['note']}")
+        if summary["bundles_skipped"]:
+            w("")
+        for e in summary["bundle_events"]:
+            w(f"- captured `{e.get('reason')}` -> `{e.get('path')}`")
+        if summary["bundle_events"]:
+            w("")
+
     timeline = summary["timeline"]
     if timeline:
         w("## Event timeline")
@@ -266,7 +479,7 @@ def render(summary: dict) -> str:
             detail = ", ".join(
                 f"{k}={v}" for k, v in sorted(e.items())
                 if k not in ("kind", "ts", "seq", "skew_table",
-                             "gate", "psis"))
+                             "gate", "psis", "critical_path"))
             w(f"| {e.get('ts', t0) - t0:+.3f} | {e['kind']} | {detail} |")
         w("")
     return "\n".join(lines) + "\n"
