@@ -1,4 +1,5 @@
-"""Command-line application: train / predict / convert_model / refit / serve.
+"""Command-line application: train / predict / convert_model / refit /
+serve / gateway.
 
 Equivalent of the reference CLI (reference: src/main.cpp,
 src/application/application.cpp:30-261). Usage matches the reference:
@@ -55,6 +56,10 @@ def run(argv=None) -> int:
         # serve_* keys are serving-stack options, not training Config
         # parameters: dispatch before Config so they aren't warned away
         _serve(params)
+        return 0
+    if params.get("task") == "gateway":
+        # fleet front end: gateway_* keys, same dispatch reasoning
+        _gateway(params)
         return 0
     cfg = Config(params)
     if cfg.task in ("train", "refit"):
@@ -228,6 +233,18 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
                                     cfg.output_model + ".drift.json")
             log.info("Drift baseline saved to %s (%d features)",
                      sidecar, len(baseline.get("features", [])))
+        # edge-transform sidecar: the fitted bin mappers, so the fleet
+        # gateway can accept raw CSV/JSON rows (serving/transforms.py)
+        try:
+            from .serving.transforms import (capture_transform,
+                                             save_transform)
+            spec = capture_transform(train_set)
+            sidecar = save_transform(spec,
+                                     cfg.output_model + ".transform.json")
+            log.info("Edge transform saved to %s (%d mapped features)",
+                     sidecar, len(spec.get("mappers", {})))
+        except Exception as exc:   # noqa: BLE001 — sidecar is best-effort
+            log.warning("edge transform capture failed: %s", exc)
     else:
         log.info("rank %d: model output is rank-0 work", dist.rank())
 
@@ -306,20 +323,27 @@ def _serve(params: Dict[str, str], block: bool = True):
     non-zero arms the monitor), serve_trace_sample (request-trace
     sampling rate; env LGBM_TPU_TRACE_SAMPLE wins when set),
     drift_psi_threshold (PSI alarm level when the model ships a
-    ``.drift.json`` baseline sidecar).
+    ``.drift.json`` baseline sidecar), serve_shed (``auto`` arms the
+    brownout load shedder whenever an SLO monitor is armed; 1/0 force),
+    serve_manifest (fleet manifest path to poll and converge on — may
+    replace input_model entirely: the replica loads whatever the
+    manifest deploys), serve_manifest_poll_s (poll period),
+    serve_manifest_publish (bind this replica's router transitions
+    back into the manifest — exactly one replica per fleet should).
     """
     from .serving import ModelRegistry, PredictorCache, ServingApp, \
         run_http_server
     model_file = params.get("input_model") or params.get("model")
-    if not model_file:
-        log.fatal("task=serve requires input_model")
+    manifest_path = str(params.get("serve_manifest", "")).strip() or None
+    if not model_file and not manifest_path:
+        log.fatal("task=serve requires input_model or serve_manifest")
     warm = [int(v) for v in
             str(params.get("serve_warm_buckets", "1,16,256")).split(",") if v]
     export_cache = None
     cache_opt = str(params.get("serve_export_cache", "")).strip()
     if cache_opt and cache_opt.lower() not in ("0", "false", "off"):
         from .fleet import ExportCache, cache_dir_for_model
-        cache_dir = (cache_dir_for_model(model_file)
+        cache_dir = (cache_dir_for_model(model_file or manifest_path)
                      if cache_opt.lower() in ("1", "true", "on", "auto")
                      else cache_opt)
         export_cache = ExportCache(cache_dir)
@@ -339,6 +363,12 @@ def _serve(params: Dict[str, str], block: bool = True):
     if slo_p99 > 0.0 or slo_err > 0.0:
         from .serving.slo import SloMonitor
         slo = SloMonitor(p99_ms=slo_p99, error_rate=slo_err)
+    shed = None
+    shed_opt = str(params.get("serve_shed", "auto")).strip().lower()
+    if shed_opt in ("1", "true", "on") or (shed_opt == "auto"
+                                           and slo is not None):
+        from .serving.shed import LoadShedder
+        shed = LoadShedder(slo=slo)
     from .serving import trace as serve_trace
     if os.environ.get("LGBM_TPU_TRACE_SAMPLE", "").strip():
         serve_trace.configure()           # env wins over the param
@@ -347,27 +377,96 @@ def _serve(params: Dict[str, str], block: bool = True):
     app = ServingApp(
         registry,
         slo=slo,
+        shed=shed,
         max_batch=int(params.get("serve_max_batch", 256)),
         max_delay_ms=float(params.get("serve_max_delay_ms", 2.0)),
         max_queue_rows=int(params.get("serve_queue_rows", 4096)),
         default_timeout_ms=float(params.get("serve_timeout_ms", 5000.0)))
     t0 = time.time()
-    version = registry.load(model_file)
-    app.router.set_stable(version)
-    baseline = registry.drift_baselines.get(version)
-    if baseline is not None:
-        from .serving.drift import DriftMonitor
-        thr = params.get("drift_psi_threshold")
-        app.drift = DriftMonitor(
-            baseline, threshold=(float(thr) if thr is not None else None))
-        log.info("Drift monitor armed (threshold %.3f, %d features)",
-                 app.drift.threshold, len(baseline.get("features", [])))
-    log.info("Loaded + warmed model %s in %.3f seconds (buckets %s%s)",
-             version, time.time() - t0, warm,
-             ", export cache on" if export_cache else "")
-    return run_http_server(app, host=params.get("serve_host", "127.0.0.1"),
-                           port=int(params.get("serve_port", 8080)),
-                           background=not block)
+    if model_file:
+        version = registry.load(model_file)
+        app.router.set_stable(version)
+        baseline = registry.drift_baselines.get(version)
+        if baseline is not None:
+            from .serving.drift import DriftMonitor
+            thr = params.get("drift_psi_threshold")
+            app.drift = DriftMonitor(
+                baseline,
+                threshold=(float(thr) if thr is not None else None))
+            log.info("Drift monitor armed (threshold %.3f, %d features)",
+                     app.drift.threshold,
+                     len(baseline.get("features", [])))
+        log.info("Loaded + warmed model %s in %.3f seconds (buckets %s%s)",
+                 version, time.time() - t0, warm,
+                 ", export cache on" if export_cache else "")
+    follower = None
+    if manifest_path:
+        from .fleet.manifest import ManifestFollower, ManifestPublisher
+        follower = ManifestFollower(
+            app, manifest_path,
+            poll_s=float(params.get("serve_manifest_poll_s", 0.5)))
+        # converge BEFORE binding the port, so /healthz only reports ok
+        # once the manifest's models are loaded and warmed — and before
+        # binding the publisher, so the initial convergence doesn't
+        # republish its own state
+        follower.poll_once()
+        pub_opt = str(params.get("serve_manifest_publish", "")).lower()
+        if pub_opt in ("1", "true", "on"):
+            ManifestPublisher(manifest_path).bind_router(app.router,
+                                                         registry)
+        follower.start()
+        log.info("Manifest follower armed on %s (rev %d, stable %s)",
+                 manifest_path, follower._applied_rev, app.router.stable)
+    if app.router.stable is None and registry.latest is None:
+        log.fatal("task=serve: no model from input_model or manifest")
+    try:
+        return run_http_server(
+            app, host=params.get("serve_host", "127.0.0.1"),
+            port=int(params.get("serve_port", 8080)),
+            background=not block)
+    finally:
+        if follower is not None and block:
+            follower.stop()
+
+
+def _gateway(params: Dict[str, str], block: bool = True):
+    """task=gateway: the fleet HTTP front over N task=serve replicas.
+
+    Options (all ``gateway_*``): gateway_host, gateway_port,
+    gateway_manifest (fleet manifest supplying the replica set, model
+    sources and the edge-transform sidecar), gateway_replicas
+    (comma-separated base URLs when running without a manifest),
+    gateway_retries, gateway_backoff_ms, gateway_eject_s,
+    gateway_health_period_s, gateway_timeout_ms, gateway_transform
+    (explicit ``.transform.json`` path for raw CSV/JSON ingestion).
+    """
+    from .fleet.gateway import FleetGateway, run_gateway_server
+    replicas = [u for u in
+                str(params.get("gateway_replicas", "")).split(",") if u]
+    manifest = str(params.get("gateway_manifest", "")).strip() or None
+    if not replicas and not manifest:
+        log.fatal("task=gateway requires gateway_replicas or "
+                  "gateway_manifest")
+    transform = None
+    tpath = params.get("gateway_transform")
+    if tpath:
+        from .serving.transforms import EdgeTransform, load_transform
+        spec = load_transform(tpath)
+        if spec is None:
+            log.fatal("gateway_transform %s is not an edge-transform "
+                      "sidecar", tpath)
+        transform = EdgeTransform(spec)
+    gateway = FleetGateway(
+        replicas=replicas, manifest_path=manifest, transform=transform,
+        retries=int(params.get("gateway_retries", 1)),
+        backoff_s=float(params.get("gateway_backoff_ms", 50.0)) / 1e3,
+        eject_s=float(params.get("gateway_eject_s", 2.0)),
+        health_period_s=float(params.get("gateway_health_period_s", 0.5)),
+        timeout_s=float(params.get("gateway_timeout_ms", 10000.0)) / 1e3)
+    return run_gateway_server(
+        gateway, host=params.get("gateway_host", "127.0.0.1"),
+        port=int(params.get("gateway_port", 8088)),
+        background=not block)
 
 
 def _convert_model(params: Dict[str, str], cfg: Config) -> None:

@@ -8,13 +8,28 @@ models on MANY devices for MANY replicas.
 - `router` — canary/shadow traffic router over the registry's version
   pinning: weighted split, shadow mirroring, counter-gated promotion,
   watchdog-triggered demotion.
+- `manifest` — the versioned fleet deploy artifact: replicas poll and
+  converge on it, and the router's promote/demote decisions publish
+  back into it, so one canary rollout spans N processes.
+- `gateway` — stdlib HTTP front over the replica set: deterministic
+  weighted selection, health-aware ejection, retry-with-backoff, edge
+  feature transforms (raw CSV/JSON in, predictions out).
 
 Rolling-restart tooling that drives this plane lives in
-`tools/rollout.py`.
+`tools/rollout.py`; the capacity curve tooling in
+`tools/serve_storm.py`.
 """
 from .export_cache import ExportCache, cache_dir_for_model
+from .gateway import (FleetGateway, Replica, make_gateway_server,
+                      run_gateway_server)
+from .manifest import (ManifestFollower, ManifestPublisher, load_manifest,
+                       new_manifest, save_manifest)
 from .placement import PlacementPlan
 from .router import CanaryRouter, RouterState
 
 __all__ = ["ExportCache", "cache_dir_for_model", "PlacementPlan",
-           "CanaryRouter", "RouterState"]
+           "CanaryRouter", "RouterState",
+           "ManifestFollower", "ManifestPublisher", "load_manifest",
+           "new_manifest", "save_manifest",
+           "FleetGateway", "Replica", "make_gateway_server",
+           "run_gateway_server"]

@@ -89,6 +89,11 @@ class CanaryRouter:
         self.history: List[dict] = []
         self.audit: List[dict] = []
         self._last_eval: Optional[dict] = None
+        # transition hook: callable(action, version, **detail) invoked
+        # after every stable/deploy/promote/demote lands (outside the
+        # lock). fleet/manifest.py binds the ManifestPublisher here so
+        # this router's decisions propagate to every replica.
+        self.on_transition = None
 
     # -- configuration ---------------------------------------------------
     def set_stable(self, version: str) -> None:
@@ -102,6 +107,7 @@ class CanaryRouter:
             self.registry.unpin_version(previous)
         telem_events.emit("router_stable", version=version,
                           previous=previous)
+        self._notify("stable", version, previous=previous)
 
     def deploy(self, version: str, weight: float = 0.10,
                shadow: bool = False) -> None:
@@ -133,6 +139,7 @@ class CanaryRouter:
                                  0.0 if shadow else weight)
         telem_events.emit("router_deploy", version=version, weight=weight,
                           shadow=shadow)
+        self._notify("deploy", version, weight=weight, shadow=shadow)
         log.info("router: canary %s at %.0f%%%s", version, weight * 100,
                  " (shadow)" if shadow else "")
 
@@ -284,6 +291,7 @@ class CanaryRouter:
         telem_counters.set_gauge("router_canary_weight", 0.0)
         telem_events.emit("router_promote", version=canary,
                           previous=old_stable, gate=gate)
+        self._notify("promote", canary, previous=old_stable)
         log.info("router: promoted %s (was %s)", canary, old_stable)
 
     def demote(self, reason: str = "manual", missing_ok: bool = False,
@@ -304,7 +312,29 @@ class CanaryRouter:
         telem_counters.set_gauge("router_canary_weight", 0.0)
         telem_events.emit("router_demote", version=canary, reason=reason,
                           gate=gate)
+        self._notify("demote", canary, reason=reason)
         log.warning("router: demoted %s (%s)", canary, reason)
+
+    def _notify(self, action: str, version: str, **detail) -> None:
+        """Fire the on_transition hook; a failing subscriber must never
+        take the routing path down with it."""
+        cb = self.on_transition
+        if cb is None:
+            return
+        try:
+            cb(action, version, **detail)
+        except Exception as exc:   # noqa: BLE001 — hook is advisory
+            log.warning("router: on_transition hook failed for %s %s: %s",
+                        action, version, exc)
+
+    def audit_note(self, action: str, version: Optional[str] = None,
+                   **detail) -> None:
+        """Append a non-transition decision to the audit channel — the
+        one bounded log for everything that reroutes traffic. The load
+        shedder logs brownout level changes here so `GET /router/audit`
+        explains shed traffic next to canary transitions."""
+        with self._lock:
+            self._audit_locked(action, version, **detail)
 
     def _record_locked(self, action: str, version: str, **detail) -> None:
         self.history.append({"action": action, "version": version,

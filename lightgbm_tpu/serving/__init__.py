@@ -13,6 +13,10 @@ Layers (each usable on its own):
 * `trace` — sampled per-request span traces (X-Request-Id propagation)
 * `slo` — dual-window p99/error-rate burn-rate monitor
 * `drift` — training-baseline vs served-traffic PSI drift monitor
+* `shed` — brownout load shedding: priority classes (pinned /
+  versioned / shadow) over the batcher queue, levels driven by `slo`
+* `transforms` — edge feature transforms: raw CSV/JSON rows binned by
+  the model's training-time mappers (gateway side)
 
 The fleet control plane (persistent compiled-predictor cache,
 multi-model placement, canary/shadow router) lives in
@@ -32,12 +36,14 @@ from .drift import DriftMonitor
 from .predictor import PredictorCache, PreparedModel
 from .registry import ModelNotFound, ModelRegistry
 from .server import ServingApp, make_http_server, run_http_server
+from .shed import LoadShedder
 from .slo import SloMonitor
 from .stats import LatencyHistogram, ServingStats
+from .transforms import EdgeTransform
 
 __all__ = [
     "MicroBatcher", "OverloadedError", "RequestTimeout",
-    "DriftMonitor", "SloMonitor",
+    "DriftMonitor", "SloMonitor", "LoadShedder", "EdgeTransform",
     "PredictorCache", "PreparedModel",
     "ModelNotFound", "ModelRegistry",
     "ServingApp", "make_http_server", "run_http_server",

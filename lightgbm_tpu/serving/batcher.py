@@ -10,6 +10,10 @@ Operational guarantees:
 
 * Admission control — a full queue (`max_queue_rows`) fast-fails new
   requests with OverloadedError instead of building unbounded latency.
+  With a `serving.shed.LoadShedder` attached, admission is priority-
+  aware: each request carries a class (pinned / versioned / shadow)
+  and the shedder's headroom fractions + brownout level decide who is
+  rejected first (shadow, then versioned, pinned last).
 * Per-request timeout — requests that exceed their deadline while queued
   are failed at flush time, and waiters give up on their own clock.
 * Version consistency — the model version is resolved ONCE per request
@@ -90,8 +94,12 @@ class MicroBatcher:
     def __init__(self, registry, max_batch: int = 256,
                  max_delay_ms: float = 2.0, max_queue_rows: int = 4096,
                  default_timeout_ms: float = 5000.0,
-                 stats: Optional[ServingStats] = None, start: bool = True):
+                 stats: Optional[ServingStats] = None, start: bool = True,
+                 shed=None):
         self.registry = registry
+        # optional serving.shed.LoadShedder: priority-class admission
+        # (None keeps the single flat queue cap)
+        self.shed = shed
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1e3
         self.max_queue_rows = int(max_queue_rows)
@@ -112,11 +120,11 @@ class MicroBatcher:
     def submit(self, rows, version: Optional[str] = None,
                raw_score: bool = False,
                timeout_ms: Optional[float] = None,
-               trace=None) -> Tuple[np.ndarray, str]:
+               trace=None, priority: str = "pinned") -> Tuple[np.ndarray, str]:
         """Blocking predict through the batch queue. Returns
         (scores (N, num_class), model version used)."""
         handles = self.submit_async(rows, version, raw_score, timeout_ms,
-                                    trace=trace)
+                                    trace=trace, priority=priority)
         timeout_s = (self.default_timeout_s if timeout_ms is None
                      else timeout_ms / 1e3)
         # grace on top of the request deadline: expiry is reported by the
@@ -131,7 +139,7 @@ class MicroBatcher:
     def submit_async(self, rows, version: Optional[str] = None,
                      raw_score: bool = False,
                      timeout_ms: Optional[float] = None,
-                     trace=None) -> List[_Pending]:
+                     trace=None, priority: str = "pinned") -> List[_Pending]:
         """Enqueue without blocking for the result; returns the pending
         handles (one per <=max_batch chunk, in row order)."""
         x = np.ascontiguousarray(np.asarray(rows, dtype=np.float32))
@@ -156,6 +164,14 @@ class MicroBatcher:
                 # is already queued (run_http_server drains on exit)
                 self.stats.incr("serve_rejected_draining")
                 raise OverloadedError("batcher is draining")
+            if self.shed is not None:
+                # priority-aware admission: brownout level + per-class
+                # queue headroom (shadow rejected first, pinned last)
+                reason = self.shed.admit(priority, self._queued_rows,
+                                         x.shape[0], self.max_queue_rows)
+                if reason is not None:
+                    self.stats.incr("serve_shed_" + priority)
+                    raise OverloadedError(f"shed [{priority}]: {reason}")
             if self._queued_rows + x.shape[0] > self.max_queue_rows:
                 self.stats.incr("serve_rejected_overload")
                 raise OverloadedError(

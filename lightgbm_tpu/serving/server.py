@@ -44,6 +44,7 @@ from ..utils import log
 from . import trace as serve_trace
 from .batcher import MicroBatcher, OverloadedError, RequestTimeout
 from .registry import ModelNotFound, ModelRegistry
+from .shed import PRIORITIES
 from .stats import ServingStats
 
 
@@ -58,26 +59,35 @@ class ServingApp:
     "stable"}` or `app.router.set_stable`.
 
     Optional observability attachments: `slo` (serving.slo.SloMonitor —
-    folds into /healthz, /metrics and the router's demotion gate) and
+    folds into /healthz, /metrics and the router's demotion gate),
     `drift` (serving.drift.DriftMonitor — windows served traffic
-    against the model's training baseline)."""
+    against the model's training baseline) and `shed`
+    (serving.shed.LoadShedder — priority-class brownout admission in
+    the batcher, level changes logged to the router audit channel)."""
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
                  batcher: Optional[MicroBatcher] = None,
                  stats: Optional[ServingStats] = None,
                  router: Optional[CanaryRouter] = None,
-                 slo=None, drift=None,
+                 slo=None, drift=None, shed=None,
                  **batcher_kwargs):
         self.registry = registry or ModelRegistry()
         self.stats = stats or ServingStats()
+        self.shed = shed
         self.batcher = batcher or MicroBatcher(
-            self.registry, stats=self.stats, **batcher_kwargs)
+            self.registry, stats=self.stats, shed=shed, **batcher_kwargs)
+        if shed is not None and self.batcher.shed is None:
+            self.batcher.shed = shed
         self.slo = slo
         self.drift = drift
         self.router = router or CanaryRouter(self.registry, self.stats,
                                              slo=slo)
         if slo is not None and getattr(self.router, "slo", None) is None:
             self.router.slo = slo
+        if shed is not None and shed.audit is None:
+            # brownout level changes land in the same bounded decision
+            # log as canary transitions (GET /router/audit)
+            shed.audit = self.router.audit_note
 
     # ------------------------------------------------------------------
     def predict(self, payload: dict,
@@ -87,6 +97,14 @@ class ServingApp:
             raise BadRequest("missing 'rows'")
         raw_score = bool(payload.get("raw_score", False))
         version = payload.get("version")
+        # priority class for shed admission: explicit tag wins, else
+        # routed traffic is "pinned" (the SLO class) and explicit-
+        # version requests are "versioned" (replay/debug traffic)
+        priority = payload.get("priority") or (
+            "versioned" if version else "pinned")
+        if priority not in PRIORITIES:
+            raise BadRequest(f"unknown priority {priority!r} "
+                             f"(one of {', '.join(PRIORITIES)})")
         # sampled per-request timeline (None when sampled out / tracing
         # off); the request id itself is handled by the HTTP layer so
         # the response header exists whether or not this is sampled
@@ -104,7 +122,8 @@ class ServingApp:
         try:
             out, version_used = self.batcher.submit(
                 rows, version=version, raw_score=raw_score,
-                timeout_ms=payload.get("timeout_ms"), trace=trace)
+                timeout_ms=payload.get("timeout_ms"), trace=trace,
+                priority=priority)
         except Exception as exc:
             # error series keyed by the *requested* tag — no answer
             # resolved one, and "which version is erroring" is exactly
@@ -152,7 +171,8 @@ class ServingApp:
             t0 = time.monotonic()
             try:
                 _, ver = self.batcher.submit(rows, version=version,
-                                             raw_score=raw_score)
+                                             raw_score=raw_score,
+                                             priority="shadow")
                 self.stats.observe_version(ver, time.monotonic() - t0)
             except Exception as exc:   # noqa: BLE001 — shadow never throws
                 self.stats.observe_version(version, error=True)
@@ -189,6 +209,8 @@ class ServingApp:
             snap["slo"] = self.slo.snapshot()
         if self.drift is not None:
             snap["drift"] = self.drift.snapshot()
+        if self.shed is not None:
+            snap["shed"] = self.shed.snapshot()
         return snap
 
     # -- fleet control ---------------------------------------------------
@@ -233,11 +255,19 @@ class ServingApp:
         routing, in-flight work still completes) or ``degraded``
         (batcher worker dead/closed, or the fast SLO window is burning
         — servable but violating its objectives). The HTTP layer maps
-        non-``ok`` to 503."""
+        non-``ok`` to 503. Degradation is *explained*: ``reason`` names
+        which SLO window is burning (with the violation string) or that
+        the batcher died, and ``shed_level`` reports the current
+        brownout level — one curl tells an operator (or the fleet
+        gateway, which records it per ejection) exactly why a replica
+        left rotation."""
         batcher_alive = self.batcher.alive()
         draining = self.batcher.draining
         status = ("draining" if draining
                   else "ok" if batcher_alive else "degraded")
+        reasons = []
+        if not draining and not batcher_alive:
+            reasons.append("batcher_dead")
         body = {"status": status,
                 "model_loaded": self.registry.latest is not None,
                 "batcher_alive": batcher_alive,
@@ -246,8 +276,19 @@ class ServingApp:
         if self.slo is not None:
             snap = self.slo.snapshot()
             body["slo"] = snap
-            if status == "ok" and snap["fast"].get("burning"):
-                body["status"] = "degraded"
+            if snap["fast"].get("burning"):
+                if body["status"] == "ok":
+                    body["status"] = "degraded"
+                reasons.append("slo_fast_burn: "
+                               + str(snap["fast"].get("violation")))
+            elif snap["slow"].get("burning"):
+                # slow burn doesn't degrade routability, but the reason
+                # is surfaced so the shed level below is explainable
+                reasons.append("slo_slow_burn: "
+                               + str(snap["slow"].get("violation")))
+        body["shed_level"] = (self.shed.level()
+                              if self.shed is not None else 0)
+        body["reason"] = "; ".join(reasons) if reasons else None
         return body
 
     def drain(self, timeout_s: float = 5.0) -> None:

@@ -1,0 +1,321 @@
+"""Fleet tier: gateway selection/ejection, manifest convergence across
+replicas, edge transforms (raw CSV -> bit-identical predictions), and
+the serve_storm capacity harness smoke.
+
+These are the cross-process behaviors run in-process: real HTTP
+servers on ephemeral ports, real manifest files on disk, real
+gateway retries — just all inside one interpreter so tier-1 stays
+fast and deterministic.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+from conftest import make_binary
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.fleet import FleetGateway
+from lightgbm_tpu.fleet.gateway import make_gateway_server
+from lightgbm_tpu.fleet.manifest import (ManifestFollower,
+                                         ManifestPublisher, load_manifest)
+from lightgbm_tpu.serving import (EdgeTransform, ModelRegistry,
+                                  ServingApp, make_http_server)
+from lightgbm_tpu.serving.transforms import (capture_transform,
+                                             load_transform,
+                                             save_transform)
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+F = 8
+
+
+def _train(seed=5, n=400):
+    x, y = make_binary(n=n, f=F, seed=seed)
+    ds = lgb.Dataset(x, y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "max_bin": 31},
+                    ds, num_boost_round=3, verbose_eval=False)
+    return bst, ds, x
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return _train()
+
+
+def _serve(app):
+    httpd = make_http_server(app, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, "http://%s:%d" % httpd.server_address[:2]
+
+
+def _post(url, payload, timeout=10.0, content_type="application/json"):
+    data = (payload if isinstance(payload, bytes)
+            else json.dumps(payload).encode())
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": content_type},
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# selection
+# ---------------------------------------------------------------------------
+
+def test_smooth_weighted_round_robin_is_deterministic_and_proportional():
+    def sequence():
+        gw = FleetGateway(replicas=[{"url": "http://a", "weight": 3.0},
+                                    {"url": "http://b", "weight": 1.0},
+                                    {"url": "http://c", "weight": 1.0}])
+        return [gw.pick().url for _ in range(10)]
+
+    seq = sequence()
+    assert seq == sequence()                    # deterministic
+    counts = {u: seq.count(u) for u in set(seq)}
+    # exact proportions on the full period (weights 3/1/1 over 10 picks)
+    assert counts["http://a"] == 6
+    assert counts["http://b"] == 2
+    assert counts["http://c"] == 2
+    # smooth: the heavy replica never runs 3 times back to back
+    assert "http://a" not in [seq[i] for i in range(8)
+                              if seq[i] == seq[i + 1] == seq[i + 2]]
+
+
+def test_ejected_replica_is_skipped_then_reconsidered():
+    gw = FleetGateway(replicas=["http://a", "http://b"], eject_s=0.05)
+    rep_a = gw._replicas["http://a"]
+    gw._eject(rep_a, "test")
+    picks = {gw.pick().url for _ in range(4)}
+    assert picks == {"http://b"}                # a is out of rotation
+    time.sleep(0.06)
+    picks = {gw.pick().url for _ in range(4)}   # eject window expired:
+    assert picks == {"http://a", "http://b"}    # probe traffic returns
+
+
+# ---------------------------------------------------------------------------
+# request path: retry, ejection, health
+# ---------------------------------------------------------------------------
+
+def test_gateway_retries_past_dead_replica(trained):
+    bst, _, x = trained
+    reg = ModelRegistry()
+    reg.load(bst, version="v1")
+    app = ServingApp(reg, max_batch=16, max_delay_ms=2.0)
+    httpd, url = _serve(app)
+    try:
+        # a dead replica first in rotation: connect failure -> eject ->
+        # retry lands on the live one; the client sees only a 200
+        gw = FleetGateway(replicas=[{"url": "http://127.0.0.1:9", "weight": 9.0},
+                                    {"url": url, "weight": 1.0}],
+                          retries=1, backoff_s=0.0)
+        code, body = gw.predict({"rows": x[:2].tolist()})
+        assert code == 200 and len(body["predictions"]) == 2
+        dead = gw._replicas["http://127.0.0.1:9"]
+        assert not dead.healthy and "connect_error" in dead.last_reason
+        assert gw.health()["healthy_replicas"] == 1
+        # health sweep records the live replica's degrade explanation
+        gw.check_health()
+        live = gw._replicas[url]
+        assert live.healthy and live.last_status == "ok"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.close()
+
+
+def test_gateway_http_surface(trained):
+    bst, _, x = trained
+    reg = ModelRegistry()
+    reg.load(bst, version="v1")
+    app = ServingApp(reg, max_batch=16, max_delay_ms=2.0)
+    httpd, url = _serve(app)
+    gw = FleetGateway(replicas=[url])
+    gw_httpd = make_gateway_server(gw, port=0)
+    threading.Thread(target=gw_httpd.serve_forever, daemon=True).start()
+    gw_url = "http://%s:%d" % gw_httpd.server_address[:2]
+    try:
+        code, body = _post(gw_url + "/predict", {"rows": x[:3].tolist()})
+        assert code == 200 and len(body["predictions"]) == 3
+        with urllib.request.urlopen(gw_url + "/healthz", timeout=5) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok" and health["healthy_replicas"] == 1
+        with urllib.request.urlopen(gw_url + "/stats", timeout=5) as r:
+            stats = json.loads(r.read())
+        assert stats["replicas"][0]["url"] == url
+        assert stats["counters"]["gateway_requests"] >= 1
+    finally:
+        gw_httpd.shutdown()
+        gw_httpd.server_close()
+        httpd.shutdown()
+        httpd.server_close()
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# edge transforms: raw CSV through the gateway, bit-identical scores
+# ---------------------------------------------------------------------------
+
+def test_raw_csv_through_gateway_bit_identical(trained, tmp_path):
+    bst, ds, x = trained
+    model_path = str(tmp_path / "model.txt")
+    bst.save_model(model_path)
+    spec = capture_transform(ds.construct()._inner)
+    save_transform(spec, model_path + ".transform.json")
+    assert load_transform(model_path + ".transform.json") is not None
+
+    reg = ModelRegistry()
+    reg.load(bst, version="v1")
+    app = ServingApp(reg, max_batch=32, max_delay_ms=2.0)
+    httpd, url = _serve(app)
+
+    # manifest-discovered transform: the gateway finds the sidecar next
+    # to the stable model source named in the manifest
+    mpath = str(tmp_path / "manifest.json")
+    ManifestPublisher(mpath).seed({"v1": model_path}, stable="v1",
+                                  replicas=[url])
+    gw = FleetGateway(manifest_path=mpath)
+    assert gw.transform is not None
+    gw_httpd = make_gateway_server(gw, port=0)
+    threading.Thread(target=gw_httpd.serve_forever, daemon=True).start()
+    gw_url = "http://%s:%d" % gw_httpd.server_address[:2]
+    try:
+        rows = x[:16]
+        csv = "\n".join(",".join(f"{v:.9g}" for v in row) for row in rows)
+        # raw CSV text straight at the gateway
+        code, via_csv = _post(gw_url + "/predict", csv.encode(),
+                              content_type="text/csv")
+        assert code == 200
+        # client-side pre-binned rows straight at the replica
+        prebinned = gw.transform.prebin_rows(
+            np.asarray(rows, dtype=np.float32))
+        _, via_prebin = _post(url + "/predict",
+                              {"rows": prebinned.tolist()})
+        # and raw rows straight at the replica (the reference scores)
+        _, via_raw = _post(url + "/predict", {"rows": rows.tolist()})
+        assert np.array_equal(via_csv["predictions"],
+                              via_prebin["predictions"])
+        assert np.array_equal(via_csv["predictions"],
+                              via_raw["predictions"])
+        # JSON rows with nulls also pass through the mappers
+        holey = [[None if j == 2 else float(v)
+                  for j, v in enumerate(row)] for row in rows[:4]]
+        code, via_null = _post(gw_url + "/predict", {"rows": holey})
+        assert code == 200 and len(via_null["predictions"]) == 4
+    finally:
+        gw_httpd.shutdown()
+        gw_httpd.server_close()
+        httpd.shutdown()
+        httpd.server_close()
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# manifest convergence: one deploy artifact, every replica follows
+# ---------------------------------------------------------------------------
+
+def test_manifest_canary_rollout_spans_replicas(trained, tmp_path):
+    bst, _, _ = trained
+    v1 = str(tmp_path / "v1.txt")
+    v2 = str(tmp_path / "v2.txt")
+    bst.save_model(v1)
+    _train(seed=11)[0].save_model(v2)
+    mpath = str(tmp_path / "manifest.json")
+
+    apps, followers = [], []
+    for _ in range(2):
+        app = ServingApp(ModelRegistry(), max_batch=16, start=False)
+        apps.append(app)
+        followers.append(ManifestFollower(app, mpath, poll_s=0.1))
+
+    publisher = ManifestPublisher(mpath)
+    publisher.seed({"v1": v1}, stable="v1")
+    for f in followers:
+        f.poll_once()
+    assert all(a.registry.latest == "v1" for a in apps)
+
+    # the publishing replica's router decisions ARE the fleet's:
+    # ship the v2 reference, warm it locally, then canary it
+    publisher.bind_router(apps[0].router, apps[0].registry)
+    publisher.add_model("v2", v2)
+    apps[0].registry.load(v2, version="v2")
+    apps[0].router.deploy("v2", weight=0.25)
+    manifest = load_manifest(mpath)
+    assert manifest["canary"] == {"version": "v2", "weight": 0.25,
+                                  "shadow": False}
+    assert manifest["models"]["v2"] == v2
+    followers[1].poll_once()
+    assert apps[1].router.snapshot()["canary"] == "v2"
+
+    apps[0].router.promote(missing_ok=True)
+    assert load_manifest(mpath)["stable"] == "v2"
+    followers[1].poll_once()
+    snap = apps[1].router.snapshot()
+    assert snap["stable"] == "v2" and snap["canary"] is None
+    # every replica audited its own convergence, no restarts involved
+    actions = [d["action"] for d in
+               apps[1].router.audit_snapshot()["decisions"]]
+    assert "deploy" in actions and "promote" in actions
+    for a in apps:
+        a.close()
+
+
+def test_manifest_follower_rev_is_applied_once(trained, tmp_path):
+    bst, _, _ = trained
+    v1 = str(tmp_path / "v1.txt")
+    bst.save_model(v1)
+    mpath = str(tmp_path / "manifest.json")
+    app = ServingApp(ModelRegistry(), max_batch=16, start=False)
+    follower = ManifestFollower(app, mpath, poll_s=0.1)
+    assert follower.poll_once() is False        # no manifest yet: no-op
+    ManifestPublisher(mpath).seed({"v1": v1}, stable="v1")
+    assert follower.poll_once() is True
+    assert follower.poll_once() is False        # same rev: converged
+    app.close()
+
+
+# ---------------------------------------------------------------------------
+# serve_storm smoke: the capacity harness on a 2-replica fleet
+# ---------------------------------------------------------------------------
+
+def _load_storm():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serve_storm", os.path.join(REPO, "tools", "serve_storm.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_storm_two_replica_smoke(trained):
+    """tools/serve_storm.py end to end on a 2-replica in-process
+    fleet with a sub-2s storm: the JSON point carries the full schema
+    and admission control sheds strictly by class worth."""
+    storm = _load_storm()
+    bst, _, _ = trained
+    fleet = storm.build_fleet(2, booster=bst, max_batch=64,
+                              max_delay_ms=10.0, queue_rows=12,
+                              warm_buckets=(8, 16))
+    try:
+        time.sleep(0.2)
+        point = storm.run_storm(fleet.gw_url, secs=1.2, clients=8,
+                                rows_per_req=4, stable=fleet.stable,
+                                num_features=F)
+    finally:
+        fleet.stop()
+    for key in ("rows_per_s", "p50_ms", "p99_ms", "requests", "ok",
+                "errors", "error_rate", "shed", "shed_fraction",
+                "slo_burns", "secs", "clients"):
+        assert key in point, key
+    assert point["ok"] > 0 and point["rows_per_s"] > 0
+    assert point["errors"] == 0
+    # saturation reached, and it bit in priority order
+    sf = point["shed_fraction"]
+    assert point["shed"]["shadow"] > 0
+    assert sf["shadow"] >= sf["versioned"] >= sf["pinned"]

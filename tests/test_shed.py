@@ -1,0 +1,206 @@
+"""Brownout load shedding: priority-class admission over the batcher
+queue (serving/shed.py) and its wiring into the batcher, the server's
+/healthz explanation, and the router audit channel.
+
+The contract under test is the overload *ordering*: a filling queue
+rejects shadow before versioned before pinned, brownout level 1 (slow
+SLO burn) sheds shadow outright, level 2 (fast burn) sheds shadow +
+versioned, and a pinned request admitted at level 2 still meets its
+deadline flush — overload degrades measurement traffic first and SLO
+traffic last.
+"""
+import time
+
+import numpy as np
+import pytest
+from conftest import make_binary
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (LoadShedder, MicroBatcher,
+                                  ModelRegistry, OverloadedError,
+                                  ServingApp, SloMonitor)
+from lightgbm_tpu.serving.server import BadRequest
+
+pytestmark = pytest.mark.fleet
+
+
+def _train(n=300, f=8, seed=3):
+    x, y = make_binary(n=n, f=f, seed=seed)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "max_bin": 63},
+                    lgb.Dataset(x, y, free_raw_data=False),
+                    num_boost_round=3, verbose_eval=False)
+    return bst, x
+
+
+@pytest.fixture(scope="module")
+def booster():
+    return _train()
+
+
+# ---------------------------------------------------------------------------
+# admission policy in isolation
+# ---------------------------------------------------------------------------
+
+def test_headroom_rejects_shadow_before_versioned_before_pinned():
+    shed = LoadShedder()
+    cap = 10
+    # sweep the queue up: record the depth at which each class first
+    # gets rejected for a 1-row request
+    first_reject = {}
+    for depth in range(cap + 1):
+        for priority in ("pinned", "versioned", "shadow"):
+            if priority in first_reject:
+                continue
+            if shed.admit(priority, depth, 1, cap) is not None:
+                first_reject[priority] = depth
+    # defaults 1.0 / 0.8 / 0.5 of cap=10 -> limits 10 / 8 / 5
+    assert first_reject["shadow"] == 5
+    assert first_reject["versioned"] == 8
+    assert first_reject["pinned"] == 10
+    assert (first_reject["shadow"] < first_reject["versioned"]
+            < first_reject["pinned"])
+    assert shed.snapshot()["shed"]["shadow"] > 0
+
+
+def test_brownout_levels_shed_by_class():
+    shed = LoadShedder()
+    shed.set_level(1, reason="test")
+    assert shed.admit("shadow", 0, 1, 100) is not None
+    assert shed.admit("versioned", 0, 1, 100) is None
+    assert shed.admit("pinned", 0, 1, 100) is None
+    shed.set_level(2, reason="test")
+    assert shed.admit("shadow", 0, 1, 100) is not None
+    assert shed.admit("versioned", 0, 1, 100) is not None
+    assert shed.admit("pinned", 0, 1, 100) is None
+    shed.set_level(None)            # back to SLO control (none -> 0)
+    assert shed.admit("shadow", 0, 1, 100) is None
+
+
+def test_slo_burn_drives_brownout_level():
+    """Fast-window burn -> level 2; once the fast window ages out but
+    the slow window still holds the bad samples -> level 1."""
+    slo = SloMonitor(p99_ms=5.0, fast_window_s=0.05, slow_window_s=30.0,
+                     min_requests=5)
+    shed = LoadShedder(slo=slo, refresh_s=0.0)
+    assert shed.level() == 0
+    for _ in range(8):              # 100ms latencies vs a 5ms objective
+        slo.observe("v1", 0.1)
+    assert shed.level() == 2
+    time.sleep(0.08)                # fast window empties, slow remains
+    assert shed.level() == 1
+
+
+# ---------------------------------------------------------------------------
+# batcher integration: the queue itself enforces the ordering
+# ---------------------------------------------------------------------------
+
+def test_batcher_queue_rejects_in_priority_order(booster):
+    bst, _ = booster
+    reg = ModelRegistry()
+    reg.load(bst)
+    shed = LoadShedder()
+    b = MicroBatcher(reg, max_batch=64, max_queue_rows=10, start=False,
+                     shed=shed)
+    one = np.zeros((1, 8), dtype=np.float32)
+
+    def refused(priority):
+        try:
+            b.submit_async(one, priority=priority)
+            return False
+        except OverloadedError:
+            return True
+
+    # no worker: each admitted request stays queued
+    for _ in range(5):
+        assert not refused("shadow")
+    assert refused("shadow")            # 5 queued = shadow limit
+    for _ in range(3):
+        assert not refused("versioned")
+    assert refused("versioned")         # 8 queued = versioned limit
+    for _ in range(2):
+        assert not refused("pinned")
+    assert refused("pinned")            # 10 queued = hard cap
+    assert b.stats.get("serve_shed_shadow") >= 1
+    assert b.stats.get("serve_shed_versioned") >= 1
+    b.close()
+
+
+def test_pinned_at_level2_still_meets_deadline_flush(booster):
+    """Brownout level 2 is not an outage for the SLO class: a pinned
+    request submitted while versioned+shadow are being shed still
+    flushes within the coalescing deadline and returns predictions."""
+    bst, x = booster
+    reg = ModelRegistry()
+    reg.load(bst)
+    shed = LoadShedder()
+    shed.set_level(2, reason="test")
+    b = MicroBatcher(reg, max_batch=32, max_delay_ms=5.0,
+                     max_queue_rows=64, shed=shed)
+    try:
+        rows = x[:4].astype(np.float32)
+        with pytest.raises(OverloadedError):
+            b.submit(rows, priority="shadow", timeout_ms=1000.0)
+        with pytest.raises(OverloadedError):
+            b.submit(rows, priority="versioned", timeout_ms=1000.0)
+        t0 = time.monotonic()
+        out, version = b.submit(rows, priority="pinned", timeout_ms=2000.0)
+        elapsed = time.monotonic() - t0
+        assert out.shape[0] == 4 and np.isfinite(out).all()
+        assert version is not None
+        # deadline flush: max_delay_ms plus compile-free predict slack
+        assert elapsed < 1.5
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# server integration: priorities, /healthz explanation, audit channel
+# ---------------------------------------------------------------------------
+
+def test_app_priority_mapping_validation_and_audit(booster):
+    bst, x = booster
+    reg = ModelRegistry()
+    reg.load(bst, version="v1")
+    shed = LoadShedder()
+    app = ServingApp(reg, shed=shed, max_batch=16, max_delay_ms=2.0)
+    try:
+        with pytest.raises(BadRequest):
+            app.predict({"rows": x[:1].tolist(), "priority": "bulk"})
+        # shed level changes land in the router audit channel
+        shed.set_level(1, reason="test_audit")
+        with pytest.raises(OverloadedError):
+            app.predict({"rows": x[:1].tolist(), "priority": "shadow"})
+        out = app.predict({"rows": x[:2].tolist()})     # pinned default
+        assert len(out["predictions"]) == 2
+        decisions = app.router.audit_snapshot()["decisions"]
+        shed_notes = [d for d in decisions if d["action"] == "shed_level"]
+        assert shed_notes and shed_notes[-1]["level"] == 1
+        assert shed_notes[-1]["reason"] == "test_audit"
+        snap = app.stats_snapshot()
+        assert snap["shed"]["level"] == 1
+        assert snap["shed"]["shed"]["shadow"] >= 1
+    finally:
+        app.close()
+
+
+def test_healthz_explains_burn_and_shed_level(booster):
+    bst, _ = booster
+    reg = ModelRegistry()
+    reg.load(bst, version="v1")
+    slo = SloMonitor(p99_ms=5.0, fast_window_s=5.0, slow_window_s=60.0,
+                     min_requests=5)
+    shed = LoadShedder(slo=slo, refresh_s=0.0)
+    app = ServingApp(reg, slo=slo, shed=shed, max_batch=16)
+    try:
+        body = app.health()
+        assert body["status"] == "ok"
+        assert body["reason"] is None and body["shed_level"] == 0
+        for _ in range(8):
+            slo.observe("v1", 0.1)          # 100ms >> 5ms objective
+        body = app.health()
+        assert body["status"] == "degraded"
+        assert "slo_fast_burn" in body["reason"]
+        assert body["shed_level"] == 2
+    finally:
+        app.close()

@@ -30,7 +30,14 @@ the survivor's pre-teardown ``rank_failure`` capture) and whether
 tools/run_report.py can render a critical path from the survivor's
 bundle alone.
 
-Usage: python tools/chaos_bench.py [dist_kill]
+``python tools/chaos_bench.py fleet_kill`` runs the serving-fleet
+scenario (one ``fleet_kill`` JSON line): a 3-replica in-process fleet
+behind the fleet gateway (tools/serve_storm.py plumbing) under mixed-
+priority storm traffic, one replica hard-killed at the halfway mark.
+Reports gateway ejections/retries and asserts the client-visible
+error rate stays below the fleet's own shed rate.
+
+Usage: python tools/chaos_bench.py [dist_kill|fleet_kill]
 Env:   CHAOS_ROWS (6000), CHAOS_FEATURES (20), CHAOS_ITERS (24),
        CHAOS_WARMUP (4), CHAOS_LEAVES (15) — defaults sized for a
        1-core CPU CI host; raise them on real hardware. The dist_kill
@@ -301,6 +308,58 @@ def dist_kill_main():
         print(json.dumps({"dist_kill_n1": _kill_scenario(3, "rows")}))
 
 
+def fleet_kill_main():
+    """Serving-fleet chaos (`fleet_kill` JSON line): a 3-replica
+    in-process fleet (tools/serve_storm.py plumbing) under mixed-
+    priority storm load loses one replica cold at the halfway mark.
+    The gateway must notice (connect failure -> ejection), retries
+    must land on the survivors, and the client-visible error rate must
+    stay below the fleet's own shed rate — losing a replica should
+    cost less than ordinary admission control does."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serve_storm",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "serve_storm.py"))
+    storm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(storm)
+
+    secs = float(os.environ.get("CHAOS_FLEET_SECS", 4.0))
+    fleet = storm.build_fleet(3, booster=storm.train_storm_model())
+    retries0 = int(telem_counters.get("gateway_retries"))
+    ejections0 = int(telem_counters.get("gateway_ejections"))
+    victim = {}
+    try:
+        time.sleep(0.2)
+        point = storm.run_storm(
+            fleet.gw_url, secs, clients=8, rows_per_req=4,
+            stable=fleet.stable,
+            mid_hook=lambda: victim.update(
+                url=fleet.kill_replica(1), at_s=round(secs / 2, 2)))
+        stats = fleet.gateway.stats()
+    finally:
+        fleet.stop()
+
+    retries = int(telem_counters.get("gateway_retries")) - retries0
+    ejections = int(telem_counters.get("gateway_ejections")) - ejections0
+    victim_rep = next((r for r in stats["replicas"]
+                       if r["url"] == victim.get("url")), {})
+    shed_total = sum(point["shed"].values())
+    shed_rate = shed_total / point["requests"] if point["requests"] else 0.0
+    print(json.dumps({"fleet_kill": {
+        "replicas": 3, "victim": victim, "secs": point["secs"],
+        "requests": point["requests"], "ok": point["ok"],
+        "rows_per_s": point["rows_per_s"], "p99_ms": point["p99_ms"],
+        "errors": point["errors"], "error_rate": point["error_rate"],
+        "shed": point["shed"], "shed_rate": round(shed_rate, 4),
+        "gateway_retries": retries, "gateway_ejections": ejections,
+        "victim_ejected": bool(not victim_rep.get("healthy", True)
+                               or ejections >= 1),
+        "retries_landed": bool(retries >= 1 and point["ok"] > 0),
+        "errors_below_shed": bool(point["errors"] < max(shed_total, 1)),
+    }}))
+
+
 def main():
     x, y = make_data()
     faults.clear()
@@ -374,5 +433,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "dist_kill":
         dist_kill_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet_kill":
+        fleet_kill_main()
     else:
         main()

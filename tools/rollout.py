@@ -27,8 +27,10 @@ CLI demo (self-contained, no deps)::
 
     python tools/rollout.py --demo 2 --secs 6
 
-trains a tiny model, spawns N ``task=serve`` replicas sharing one
-export cache, drives closed-loop traffic with per-request failover
+trains a tiny model, publishes it as ``v1`` in a fleet manifest
+(fleet/manifest.py), spawns N ``task=serve`` replicas that converge
+from that manifest (``serve_manifest=...`` — no per-replica
+``input_model``), drives closed-loop traffic with per-request failover
 across replicas, rolls the whole fleet, and prints ONE JSON line:
 ``errors`` is the number of requests that got no answer from any
 replica — the demo's acceptance number is 0.
@@ -143,11 +145,14 @@ def _train_demo_model(path: str) -> None:
     bst.save_model(path)
 
 
-def _spawn_replica(model: str, port: int, cache_dir: str,
+def _spawn_replica(manifest: str, port: int, cache_dir: str,
                    log_path: str) -> subprocess.Popen:
+    """A demo replica knows ONE thing: the manifest path. Model
+    versions, the stable pointer, and canary state all arrive by
+    convergence — deploy once, fleet follows."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [sys.executable, "-m", "lightgbm_tpu", "task=serve",
-           f"input_model={model}", "serve_host=127.0.0.1",
+           f"serve_manifest={manifest}", "serve_host=127.0.0.1",
            f"serve_port={port}", f"serve_export_cache={cache_dir}",
            "serve_warm_buckets=1,16"]
     logf = open(log_path, "ab")
@@ -158,6 +163,7 @@ def _spawn_replica(model: str, port: int, cache_dir: str,
 
 def _demo(n_replicas: int, secs: float) -> None:
     import tempfile
+    from lightgbm_tpu.fleet.manifest import ManifestPublisher
     workdir = tempfile.mkdtemp(prefix="lgbm_rollout_")
     model = os.path.join(workdir, "model.txt")
     cache_dir = os.path.join(workdir, "xcache")
@@ -166,9 +172,16 @@ def _demo(n_replicas: int, secs: float) -> None:
     base_port = int(os.environ.get("ROLLOUT_BASE_PORT", 18480))
     ports = [base_port + i for i in range(n_replicas)]
     endpoints = [f"http://127.0.0.1:{p}" for p in ports]
+
+    # the single deploy artifact: every replica converges from this
+    manifest = os.path.join(workdir, "fleet_manifest.json")
+    ManifestPublisher(manifest).seed(
+        {"v1": model}, stable="v1",
+        replicas=[{"url": ep, "weight": 1.0} for ep in endpoints])
+
     procs = {}
     for ep, port in zip(endpoints, ports):
-        procs[ep] = _spawn_replica(model, port, cache_dir,
+        procs[ep] = _spawn_replica(manifest, port, cache_dir,
                                    os.path.join(workdir, f"r{port}.log"))
     t_first = time.monotonic()
     for ep in endpoints:
@@ -212,7 +225,7 @@ def _demo(n_replicas: int, secs: float) -> None:
         proc.wait(timeout=30)
         port = int(endpoint.rsplit(":", 1)[1])
         procs[endpoint] = _spawn_replica(
-            model, port, cache_dir,
+            manifest, port, cache_dir,
             os.path.join(workdir, f"r{port}.log"))
 
     t0 = time.monotonic()
@@ -236,6 +249,7 @@ def _demo(n_replicas: int, secs: float) -> None:
         "rollout_s": round(rollout_s, 3),
         "cold_start_healthy_s": round(cold_start_s, 3),
         "restart_healthy_s": warm_waits,
+        "manifest": manifest,
         "steps": report["steps"],
         "workdir": workdir,
     }))
