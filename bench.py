@@ -68,7 +68,7 @@ N_CAT = int(os.environ.get("BENCH_CAT_FEATURES", 0))
 CAT_CARD = int(os.environ.get("BENCH_CAT_CARD", 64))
 
 
-def make_higgs_like(n, f, seed=17, w=None, n_cat=0, card=64):
+def make_higgs_like(n, f, seed=17, w=None, n_cat=0, card=64, n_classes=1):
     """Synthetic stand-in with Higgs-like statistics: mixed informative /
     noise features, moderately separable classes. Pass `w` to draw a new
     sample from the SAME ground-truth function (e.g. a held-out valid set)
@@ -99,6 +99,15 @@ def make_higgs_like(n, f, seed=17, w=None, n_cat=0, card=64):
         cats = r.randint(0, card, n)
         x[:, f - len(cat_tables) + j] = cats
         logit += cat_tables[j][cats]
+    if n_classes > 1:
+        # large-K multiclass variant: margin quantiles become balanced
+        # K-class labels (class 0 = lowest margin). The one-vs-rest
+        # structure keeps an AUC-style gate usable — class-0 margin vs
+        # (label == 0) is the same separability the binary label has.
+        noisy = logit + r.randn(n) * 1.5
+        edges = np.quantile(noisy, np.linspace(0, 1, n_classes + 1)[1:-1])
+        y = np.searchsorted(edges, noisy).astype(np.float64)
+        return x, y, w
     y = (logit + r.randn(n) * 1.5 > 0).astype(np.float64)
     return x, y, w
 
@@ -336,7 +345,8 @@ def main():
               "BENCH_CAT_FEATURES", "BENCH_QUANTIZED",
               "BENCH_GRAD_BITS", "BENCH_STRATEGY",
               "BENCH_TELEMETRY", "BENCH_STREAM",
-              "BENCH_CHUNK_ROWS", "BENCH_DIST_SHARD") if k in os.environ}
+              "BENCH_CHUNK_ROWS", "BENCH_DIST_SHARD",
+              "BENCH_GROW_PROGRAM", "BENCH_NUM_CLASS") if k in os.environ}
     sys.stderr.write(f"rows={N_ROWS} iters={N_ITERS} knobs={knobs}\n")
 
     # any capped run (explicit CPU or fallback) is not comparable to the
@@ -347,11 +357,17 @@ def main():
     if os.environ.get("BENCH_OBJECTIVE", "binary") == "lambdarank":
         return _run_lambdarank(backend, degraded, num_leaves,
                                time_budget, lgb)
+    # large-K multiclass scenario (ROADMAP item 5b): BENCH_NUM_CLASS=K
+    # trains K per-class trees per iteration; combined with
+    # BENCH_GROW_PROGRAM=fused_tree and the masked strategy all K trees
+    # dispatch as ONE vmap-batched program (device_learner.train_batched)
+    num_class = int(os.environ.get("BENCH_NUM_CLASS", "1"))
     n_valid = min(N_VALID, max(N_ROWS // 10, 1000))
     x, y, w_true = make_higgs_like(N_ROWS, N_FEATURES, n_cat=N_CAT,
-                                   card=CAT_CARD)
+                                   card=CAT_CARD, n_classes=num_class)
     xv, yv, _ = make_higgs_like(n_valid, N_FEATURES, seed=4242, w=w_true,
-                                n_cat=N_CAT, card=CAT_CARD)
+                                n_cat=N_CAT, card=CAT_CARD,
+                                n_classes=num_class)
     params = {
         "objective": "binary",
         "num_leaves": num_leaves,
@@ -361,6 +377,13 @@ def main():
         "verbosity": -1,
         "min_data_in_leaf": 20,
     }
+    if num_class > 1:
+        params.update(objective="multiclass", num_class=num_class)
+    # growth-loop formulation lever (per_split | fused_tree): the A/B
+    # for the single-program tree-growth trajectory (BENCH_r06)
+    grow_program = os.environ.get("BENCH_GROW_PROGRAM", "")
+    if grow_program:
+        params.update(grow_program=grow_program)
     # quantized-gradient A/B lever: BENCH_QUANTIZED=1 trains with int
     # histograms (one i8 contraction instead of the bf16 hi/lo pair)
     quantized = os.environ.get("BENCH_QUANTIZED", "0") == "1"
@@ -436,6 +459,17 @@ def main():
         return float((ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2)
                      / max(pos.sum() * (~pos).sum(), 1))
 
+    def gate_score(models, xx):
+        # multiclass: the models list interleaves classes iteration-major,
+        # so class 0's ensemble is models[0::num_class]; the gate is the
+        # one-vs-rest AUC of the class-0 margin (same ground-truth
+        # separability as the binary label)
+        trees = models[0::num_class] if num_class > 1 else models
+        return host_predict_raw(trees, xx)
+
+    yv_gate = (yv == 0).astype(np.float64) if num_class > 1 else yv
+    y_gate = (y == 0).astype(np.float64) if num_class > 1 else y
+
     # timed loop: the clock accumulates update() wall only; held-out AUC is
     # evaluated off-clock every EVAL_EVERY iters to find sec_to_auc (the
     # reference's headline is time-to-AUC, docs/Experiments.rst:106).
@@ -471,8 +505,8 @@ def main():
         # predict)
         if (sec_to_auc is None and eval_every and not stop
                 and i + 1 < N_ITERS and (i + 1) % eval_every == 0):
-            mid_auc = rank_auc(host_predict_raw(booster._gbdt.models, xv),
-                               yv)
+            mid_auc = rank_auc(gate_score(booster._gbdt.models, xv),
+                               yv_gate)
             if mid_auc >= AUC_TARGET:
                 sec_to_auc = round(warmup_secs + t_train, 3)
                 sys.stderr.write(
@@ -485,7 +519,8 @@ def main():
                 f"{done_iters} iters\n")
             break
     iters_per_sec = done_iters / t_train if t_train > 0 else 0.0
-    rowtrees_per_sec = N_ROWS * iters_per_sec
+    # K trees land per iteration in multiclass, so row-trees/s scales by K
+    rowtrees_per_sec = N_ROWS * iters_per_sec * max(num_class, 1)
 
     # growth-strategy + working-row diagnostics for the trajectory: the
     # packed strategies report the physical row width (codes words + gh
@@ -504,13 +539,13 @@ def main():
                 and params.get("bagging_freq", 0) == 0 else 2
         bytes_per_row = (int(learner.codes_pack.shape[1]) + gh_words + 1) * 4
 
-    valid_auc = rank_auc(host_predict_raw(booster._gbdt.models, xv), yv)
+    valid_auc = rank_auc(gate_score(booster._gbdt.models, xv), yv_gate)
     if sec_to_auc is None and valid_auc >= AUC_TARGET:
         sec_to_auc = round(warmup_secs + t_train, 3)
     sys.stderr.write(f"valid AUC ({len(yv)} held-out): {valid_auc:.4f}\n")
     # sanity: the model must actually learn
     train_auc = rank_auc(
-        host_predict_raw(booster._gbdt.models, x[:100_000]), y[:100_000])
+        gate_score(booster._gbdt.models, x[:100_000]), y_gate[:100_000])
     sys.stderr.write(f"train AUC (100k sample): {train_auc:.4f}\n")
     assert train_auc > 0.60, "model failed to learn"
 
@@ -536,6 +571,18 @@ def main():
         "hist_dtype": hist_dtype,
         "strategy": strategy,
         "bytes_per_row": bytes_per_row,
+        # single-program growth trajectory (BENCH_r06): the loop
+        # formulation under test plus the dispatch-count proof —
+        # grow_dispatches_per_tree is ~1 for whole-tree device programs
+        # (1/K with the vmap-batched multiclass program), ~num_leaves
+        # for the serial host loop
+        "num_class": num_class,
+        "grow_program": str(getattr(
+            booster._gbdt.config, "grow_program", "per_split")),
+        "grow_dispatches": telemetry.counters.get("grow_dispatches"),
+        "grow_trees": telemetry.counters.get("grow_trees"),
+        "grow_dispatches_per_tree": round(telemetry.counters.get(
+            "grow_dispatches_per_tree"), 4),
         # out-of-core streaming diagnostics (stream_mode off => overlap
         # null): transfer_overlap_fraction is 1 - stream_wait/stream
         # wall from the shard's own counters

@@ -231,6 +231,9 @@ class Config:
         if self.telemetry not in ("off", "summary", "trace"):
             log.fatal("telemetry must be one of off/summary/trace, got %s",
                       self.telemetry)
+        if self.grow_program not in ("per_split", "fused_tree"):
+            log.fatal("grow_program must be one of per_split/fused_tree, "
+                      "got %s", self.grow_program)
         if self.stream_mode not in ("off", "chunked", "goss"):
             log.fatal("stream_mode must be one of off/chunked/goss, got %s",
                       self.stream_mode)

@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
-from typing import NamedTuple, Optional
+from typing import List, NamedTuple, Optional
 
 import numpy as np
 
@@ -54,8 +54,10 @@ from ..io.stream import DeviceDataShard
 from ..ops import bundle as bundle_ops
 from ..ops import quantize as quant_ops
 from ..ops import split as split_ops
+from ..ops.fused import run_split_loop
 from ..ops.partition import decide_left
 from ..ops.pallas.histogram_kernel import build_histogram_pallas_t
+from .. import telemetry
 from ..telemetry import recorder as telem
 from ..utils import log
 from ..utils.log import LightGBMError
@@ -301,7 +303,7 @@ def split_epilogue(*, k, key, l, new_id, row, mono_f, best_cat_l,
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "col_bins", "max_depth",
                      "bynode_k", "use_pallas", "cat_statics", "quant_bits",
-                     "hist_chunk"))
+                     "hist_chunk", "grow_program"))
 def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
               grad: jax.Array, hess: jax.Array,   # (N,)
               w: jax.Array,               # (N,) bagging weight (0/1)
@@ -317,7 +319,8 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
               l1: float, l2: float, max_delta_step: float,
               min_data_in_leaf: int, min_sum_hessian: float,
               min_gain_to_split: float, bynode_k: int, use_pallas: bool,
-              cat_statics=None, quant_bits: int = 0, hist_chunk: int = 0):
+              cat_statics=None, quant_bits: int = 0, hist_chunk: int = 0,
+              grow_program: str = "per_split"):
     c_cols, n = codes_t.shape
     f = f_numbins.shape[0]
     L = num_leaves
@@ -429,7 +432,7 @@ def grow_tree(codes_t: jax.Array,         # (C, N) column codes (EFB view)
         return _Carry(new_id, leaf_id, pool, depth, leaf_min, leaf_max,
                       best2, best_cat2, rec2, rec_cat2, key)
 
-    out = jax.lax.while_loop(cond, body, carry)
+    out = run_split_loop(cond, body, carry, L - 1, grow_program)
     return (out.rec, out.rec_cat if has_cat else None,
             out.leaf_id, out.k, totals)
 
@@ -484,7 +487,8 @@ def _unpack_codes(words: jax.Array, c_cols: int, item_bits: int) -> jax.Array:
                      "num_leaves", "num_bins", "col_bins", "max_depth",
                      "bynode_k", "use_pallas", "partition",
                      "pool_slots", "window_step", "trivial_weights",
-                     "cat_statics", "quant_bits", "quant_renew"))
+                     "cat_statics", "quant_bits", "quant_renew",
+                     "grow_program"))
 def grow_tree_compact(
         codes_pack: jax.Array,       # (N, CW) u32: packed column codes
         codes_row: jax.Array,        # (N, C) u8/u16 for the root pass
@@ -500,7 +504,8 @@ def grow_tree_compact(
         partition: str = "sort",
         pool_slots: int = 0, window_step: int = 4,
         trivial_weights: bool = False, cat_statics=None,
-        quant_bits: int = 0, quant_renew: bool = True):
+        quant_bits: int = 0, quant_renew: bool = True,
+        grow_program: str = "per_split"):
     return grow_tree_compact_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -514,7 +519,7 @@ def grow_tree_compact(
         axis_name=None, pool_slots=pool_slots,
         window_step=window_step, trivial_weights=trivial_weights,
         cat_statics=cat_statics, quant_bits=quant_bits,
-        quant_renew=quant_renew)
+        quant_renew=quant_renew, grow_program=grow_program)
 
 
 def make_voting_search(*, axis_name, voting_k, c_cols, col_bins,
@@ -816,7 +821,7 @@ def grow_tree_compact_core(
         feature_shards: int = 0, voting_k: int = 0, window_step: int = 4,
         trivial_weights: bool = False, cat_statics=None,
         quant_bits: int = 0, quant_renew: bool = True,
-        quant_total_rows: int = 0):
+        quant_total_rows: int = 0, grow_program: str = "per_split"):
     """Compaction-based whole-tree growth: O(leaf-size) work per split.
 
     The masked strategy in grow_tree pays a full O(N) histogram pass per
@@ -1332,11 +1337,12 @@ def grow_tree_compact_core(
         scale0 = jnp.ones((L, 2), jnp.float32) \
             .at[0].set(jnp.stack([r0_g, r0_h]))
         leafmax0 = jnp.zeros((L, 2), jnp.float32).at[0].set(root_max)
-        out, _ = jax.lax.while_loop(
+        out, _ = run_split_loop(
             lambda t: cond(t[0]), lambda t: body(t[0], t[1]),
-            (carry, (scale0, leafmax0)))
+            (carry, (scale0, leafmax0)), L - 1, grow_program)
     else:
-        out = jax.lax.while_loop(cond, lambda cc: body(cc)[0], carry)
+        out = run_split_loop(cond, lambda cc: body(cc)[0], carry,
+                             L - 1, grow_program)
     # final row -> leaf map: scatter physical-position leaves onto row ids
     row_ids = out.data[:n, d_cols - 1].astype(jnp.int32)
     leaf_id = jnp.zeros(n, jnp.int32).at[row_ids].set(
@@ -1370,7 +1376,7 @@ class _CarryK(NamedTuple):
                      "bynode_k", "use_pallas", "partition",
                      "chunk_rows", "fuse_hist", "feature_shards",
                      "cat_statics", "trivial_weights", "quant_bits",
-                     "quant_renew", "data_prebuilt"))
+                     "quant_renew", "data_prebuilt", "grow_program"))
 def grow_tree_chunk(
         codes_pack: jax.Array, codes_row: jax.Array,
         grad: jax.Array, hess: jax.Array, w: jax.Array,
@@ -1386,7 +1392,7 @@ def grow_tree_chunk(
         fuse_hist: bool = True, feature_shards: int = 0,
         cat_statics=None, trivial_weights: bool = False,
         quant_bits: int = 0, quant_renew: bool = True,
-        data_prebuilt: bool = False):
+        data_prebuilt: bool = False, grow_program: str = "per_split"):
     return grow_tree_chunk_core(
         codes_pack, codes_row, grad, hess, w, base_mask,
         f_numbins, f_missing, f_default, f_monotone, f_penalty,
@@ -1400,7 +1406,8 @@ def grow_tree_chunk(
         fuse_hist=fuse_hist, feature_shards=feature_shards,
         axis_name=None, cat_statics=cat_statics,
         trivial_weights=trivial_weights, quant_bits=quant_bits,
-        quant_renew=quant_renew, data_prebuilt=data_prebuilt)
+        quant_renew=quant_renew, data_prebuilt=data_prebuilt,
+        grow_program=grow_program)
 
 
 def grow_tree_chunk_core(
@@ -1419,7 +1426,8 @@ def grow_tree_chunk_core(
         scatter_cols: int = 0, voting_k: int = 0,
         axis_name=None, cat_statics=None, trivial_weights: bool = False,
         quant_bits: int = 0, quant_renew: bool = True,
-        quant_total_rows: int = 0, data_prebuilt: bool = False):
+        quant_total_rows: int = 0, data_prebuilt: bool = False,
+        grow_program: str = "per_split"):
     """Switch-free whole-tree growth over fixed-size chunks.
 
     The compact strategy resolves dynamic leaf sizes with a lax.switch
@@ -1936,11 +1944,12 @@ def grow_tree_chunk_core(
         scale0 = jnp.ones((L, 2), jnp.float32) \
             .at[0].set(jnp.stack([r0_g, r0_h]))
         leafmax0 = jnp.zeros((L, 2), jnp.float32).at[0].set(root_max)
-        out, _ = jax.lax.while_loop(
+        out, _ = run_split_loop(
             lambda t: cond(t[0]), lambda t: body(t[0], t[1]),
-            (carry, (scale0, leafmax0)))
+            (carry, (scale0, leafmax0)), L - 1, grow_program)
     else:
-        out = jax.lax.while_loop(cond, lambda cc: body(cc)[0], carry)
+        out = run_split_loop(cond, lambda cc: body(cc)[0], carry,
+                             L - 1, grow_program)
     row_ids = out.data[:n, d_cols - 1].astype(jnp.int32)
     leaf_id = jnp.zeros(n, jnp.int32).at[row_ids].set(
         out.pos_leaf[:n], unique_indices=True)
@@ -2486,6 +2495,11 @@ class DeviceTreeLearner:
         self._stream_ctx: Optional[dict] = None
         self._stream_top_hint: Optional[np.ndarray] = None
         self._stream_jits: dict = {}
+        # vmap-batched multiclass growth (train_batched): jitted
+        # class-batched grow programs keyed by K, and the per-class leaf
+        # routing of the last batched iteration
+        self._batched_fns: dict = {}
+        self._batched_leaf_ids: Optional[jax.Array] = None
 
     def pack_codes(self, host_codes: np.ndarray,
                    col_target: Optional[int] = None) -> np.ndarray:
@@ -2567,7 +2581,8 @@ class DeviceTreeLearner:
             min_data_in_leaf=int(cfg.min_data_in_leaf),
             min_sum_hessian=float(cfg.min_sum_hessian_in_leaf),
             min_gain_to_split=float(cfg.min_gain_to_split),
-            bynode_k=bynode_k, use_pallas=self._use_pallas)
+            bynode_k=bynode_k, use_pallas=self._use_pallas,
+            grow_program=str(getattr(cfg, "grow_program", "per_split")))
 
     def _feature_mask(self, rng: np.random.RandomState) -> np.ndarray:
         frac = self.config.feature_fraction
@@ -2611,6 +2626,7 @@ class DeviceTreeLearner:
         with telem.phase("grow_dispatch"):
             rec, rec_cat, leaf_id, n_splits, _ = self._run_grow(
                 grad, hess, w, base_mask, key)
+        telemetry.note_grow_dispatches(1.0, trees=1.0)
 
         self.last_leaf_id = leaf_id
         self._leaf_id_host = None
@@ -2626,6 +2642,104 @@ class DeviceTreeLearner:
             log.warning("No further splits with positive gain")
         with telem.phase("tree_replay"):
             return self.replay_tree(rec_h, k, rec_cat_h)
+
+    # -- vmap-batched multiclass growth --------------------------------
+    def supports_batched_k(self) -> bool:
+        """Whether train_batched can grow all K per-class trees of one
+        boosting iteration as ONE batched device program. Requires the
+        fused-tree growth program (the fixed-trip scan is what makes the
+        whole-tree program vmappable — a data-dependent while_loop has
+        no batch rule), the masked strategy (one shared dense code
+        buffer; the packed strategies' LRU pool ladder is per-tree
+        state), and resident data."""
+        return (type(self) is DeviceTreeLearner
+                and self.strategy == "masked"
+                and self._shard is None
+                and str(getattr(self.config, "grow_program",
+                                "per_split")) == "fused_tree")
+
+    def _batched_grow_fn(self, num_class: int):
+        """jit(vmap(grow_tree)) over the class axis, cached per K. The
+        code buffer and row weights are shared (in_axes=None); per-class
+        gradients, hessians, feature masks, and RNG keys are batched —
+        so per-class quant scales (derived in-program from grad/hess and
+        the key) ride as batched operands automatically."""
+        fn = self._batched_fns.get(num_class)
+        if fn is not None:
+            return fn
+        statics = self._statics()
+        meta = (self.f_numbins, self.f_missing, self.f_default,
+                self.f_monotone, self.f_penalty, self.f_categorical,
+                self.f_col, self.f_base, self.f_elide, self.hist_idx)
+        quant_bits, hist_chunk = self.quant_bits, self.hist_chunk
+
+        def one(codes_t, g, h, w, base_mask, key):
+            return grow_tree(codes_t, g, h, w, base_mask, *meta, key,
+                             quant_bits=quant_bits, hist_chunk=hist_chunk,
+                             **statics)
+
+        fn = jax.jit(jax.vmap(one, in_axes=(None, 0, 0, None, 0, 0)))
+        self._batched_fns[num_class] = fn
+        return fn
+
+    def train_batched(self, grad: jax.Array, hess: jax.Array,
+                      bag_indices: Optional[np.ndarray] = None,
+                      iter_seed0: int = 0) -> List[Tree]:
+        """Grow the K per-class trees of one boosting iteration as ONE
+        batched device dispatch (large-K multiclass: K trees/iteration
+        used to cost K grow dispatches + K host syncs).
+
+        Seeds match train() exactly: class k uses
+        iter_seed = iter_seed0 + k for both the feature-fraction
+        RandomState and the PRNGKey, so the batched program is
+        bit-identical to the per-class loop. Per-class leaf routing
+        lands in self._batched_leaf_ids; the caller installs row k as
+        last_leaf_id before each per-class score update."""
+        cfg = self.config
+        n = self.dataset.num_data
+        K = int(grad.shape[0])
+        if bag_indices is None:
+            if self._ones_w is None:
+                self._ones_w = jnp.ones(n, jnp.float32)
+            w = self._ones_w
+            self._bag_mask_host = None
+        else:
+            wv = np.zeros(n, dtype=np.float32)
+            wv[bag_indices] = 1.0
+            w = jnp.asarray(wv)
+            self._bag_mask_host = wv > 0
+        masks = np.stack([
+            self._feature_mask(np.random.RandomState(
+                (cfg.feature_fraction_seed + iter_seed0 + k) % (2**31 - 1)))
+            for k in range(K)])
+        base_masks = jnp.asarray(masks)
+        keys = jnp.stack([jax.random.PRNGKey(iter_seed0 + k)
+                          for k in range(K)])
+        fn = self._batched_grow_fn(K)
+        with telem.phase("grow_fused"):
+            rec, rec_cat, leaf_ids, n_splits, _ = fn(
+                self.codes_t, grad, hess, w, base_masks, keys)
+        telemetry.note_grow_dispatches(1.0, trees=float(K))
+        self._batched_leaf_ids = leaf_ids
+        self.last_leaf_id = None
+        self._leaf_id_host = None
+        with telem.phase("host_sync"):
+            if rec_cat is None:
+                rec_h, ks = jax.device_get((rec, n_splits))
+                rec_cat_h = None
+            else:
+                rec_h, rec_cat_h, ks = jax.device_get(
+                    (rec, rec_cat, n_splits))
+        trees = []
+        with telem.phase("tree_replay"):
+            for k in range(K):
+                kk = int(ks[k])
+                if kk == 0:
+                    log.warning("No further splits with positive gain")
+                trees.append(self.replay_tree(
+                    rec_h[k], kk,
+                    None if rec_cat_h is None else rec_cat_h[k]))
+        return trees
 
     def _grow_fn_kwargs(self, trivial_weights: bool = False):
         """(grow fn, strategy-specific kwargs) for the packed strategies.
@@ -2991,7 +3105,9 @@ class DeviceTreeLearner:
         the compacted (top_k + other_k)-row subset.
 
         Returns step(score_row, base_mask, tree_key, bag_key, shrinkage)
-        -> (new_score_row, rec, leaf_id, num_splits).
+        -> (new_score_row, rec, rec_cat, leaf_id, num_splits, finite) —
+        `finite` is the in-program on_nonfinite sentry reduction over the
+        updated score row, so guarded runs cost no extra dispatch.
         """
         statics = self._statics()
         n = self.dataset.num_data
@@ -3095,7 +3211,13 @@ class DeviceTreeLearner:
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
             delta = jnp.where(k > 0, delta, jnp.zeros_like(delta))
-            return score_row + delta, rec, rec_cat, leaf_id, k
+            new_score = score_row + delta
+            # in-program non-finite sentry: any NaN/inf gradient or leaf
+            # output propagates into the updated score, so one reduction
+            # INSIDE the program covers the whole fused iteration and a
+            # guarded run adds zero extra dispatches
+            finite = jnp.all(jnp.isfinite(new_score))
+            return new_score, rec, rec_cat, leaf_id, k, finite
 
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
             # read self.codes_* at CALL time like the DP/FP wrappers, so

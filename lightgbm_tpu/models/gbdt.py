@@ -34,7 +34,7 @@ from ..resilience import faults
 from ..telemetry import counters as telem_counters
 from ..telemetry import recorder as telem
 from ..utils import log
-from ..utils.envs import pipeline_env
+from ..utils.envs import flag, pipeline_env
 from .serial_learner import SerialTreeLearner
 from .tree import Tree
 
@@ -482,6 +482,24 @@ class GBDT:
                 and self.config.pos_bagging_fraction >= 1.0
                 and self.config.neg_bagging_fraction >= 1.0)
 
+    def _batched_k_eligible(self) -> bool:
+        """Whether this iteration's K per-class trees can grow as one
+        vmap-batched device program (DeviceTreeLearner.train_batched).
+        Plain multiclass GBDT only — DART/GOSS/RF keep the per-class
+        loop — and every class must actually train this iteration.
+        LGBM_TPU_NO_VMAP_K is the escape hatch."""
+        if (self.__class__ is not GBDT
+                or self.num_tree_per_iteration <= 1
+                or flag("LGBM_TPU_NO_VMAP_K")):
+            return False
+        if (self.objective is None
+                or self.objective.is_renew_tree_output
+                or self.train_set.num_features == 0
+                or not all(self._class_need_train)):
+            return False
+        sup = getattr(self.learner, "supports_batched_k", None)
+        return bool(sup and sup())
+
     def _train_one_iter_fused(self) -> bool:
         """One boosting iteration as one device program + one small fetch
         (see DeviceTreeLearner.make_fused_step)."""
@@ -517,17 +535,19 @@ class GBDT:
             (cfg.bagging_seed + (self.iter // freq)) % (2**31 - 1))
         score_before = self.score_updater.score
         with telem.phase("grow_dispatch"):
-            new_score, rec, rec_cat, leaf_id, k_dev = fused_step(
+            new_score, rec, rec_cat, leaf_id, k_dev, finite_dev = fused_step(
                 score_before[0], base_mask, tree_key, bag_key,
                 jnp.float32(self.shrinkage_rate))
+        telemetry.note_grow_dispatches(1.0, trees=1.0)
 
         if self._sentry_enabled():
-            # one reduction lane over the updated score row: any
-            # non-finite gradient or leaf output propagates into it, so
-            # this single flag covers the whole fused iteration
-            from ..resilience import sentries
+            # the finite flag is computed INSIDE the fused program (one
+            # reduction over the updated score row — any non-finite
+            # gradient or leaf output propagates into it), so guarding
+            # the iteration adds zero extra dispatches; the bool() here
+            # is the policy decision's unavoidable host sync
             with telem.phase("sentry"):
-                finite = sentries.all_finite(new_score)
+                finite = bool(finite_dev)
             if not finite:
                 act = self._apply_nonfinite_policy("fused iteration outputs")
                 if act == "retry" and not self._sentry_retrying:
@@ -679,14 +699,31 @@ class GBDT:
 
         with telem.phase("bagging"):
             bag_indices = self._bagging(self.iter)
+        batched_trees = None
+        if self._batched_k_eligible():
+            # vmap-batched multiclass: all K per-class trees of this
+            # iteration grow as ONE batched device program (per-class
+            # seeds derived exactly as the per-class loop derives them,
+            # so the models are bit-identical)
+            batched_trees = self.learner.train_batched(
+                grad, hess, bag_indices,
+                iter_seed0=self.iter * self.num_tree_per_iteration)
         should_continue = False
         sentry_dropped = False
         for k in range(self.num_tree_per_iteration):
             new_tree = Tree(2)
             if self._class_need_train[k] and self.train_set.num_features > 0:
-                new_tree = self.learner.train(
-                    grad[k], hess[k], bag_indices,
-                    iter_seed=self.iter * self.num_tree_per_iteration + k)
+                if batched_trees is not None:
+                    new_tree = batched_trees[k]
+                    # _update_score routes by last_leaf_id: install class
+                    # k's routing row from the batched program
+                    self.learner.last_leaf_id = \
+                        self.learner._batched_leaf_ids[k]
+                    self.learner._leaf_id_host = None
+                else:
+                    new_tree = self.learner.train(
+                        grad[k], hess[k], bag_indices,
+                        iter_seed=self.iter * self.num_tree_per_iteration + k)
                 if not self._guard_tree(new_tree):
                     new_tree = Tree(2)
                     sentry_dropped = True

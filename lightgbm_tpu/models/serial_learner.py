@@ -29,6 +29,7 @@ from ..ops import fused as fused_ops
 from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
 from ..ops import split as split_ops
+from .. import telemetry
 from ..telemetry import recorder as telem
 from ..utils import log
 from ..utils.envs import use_pallas_env
@@ -88,6 +89,9 @@ class SerialTreeLearner:
         self._hist_chunk = int(config.hist_chunk_size or 0)
         self._gh_packed = None
         self._gh_scales = None
+        # per-tree hoisted device masks (reset at every train() entry)
+        self._meta_cache = None
+        self._cat_mask_cache = None
         self._mono_enabled = bool(np.any(np.asarray(self.f_monotone) != 0))
         # feature_contri gain multipliers (reference FeatureMetainfo penalty)
         contri = config.feature_contri or []
@@ -255,6 +259,9 @@ class SerialTreeLearner:
         self._numerical_mask_np = base_mask  # node-level resample below
 
         tree = Tree(cfg.num_leaves)
+        # per-tree hoisted caches (base_mask changes per tree)
+        self._meta_cache = None
+        self._cat_mask_cache = None
         root_cost = self._cegb_cost(bag_cnt)
         if self._quant_bits:
             # per-iteration (per-class: each class's tree quantizes its
@@ -291,6 +298,7 @@ class SerialTreeLearner:
                     bucket=_bucket(bag_cnt, self.max_bucket),
                     hist_chunk=self._hist_chunk,
                     use_pallas=self._use_pallas, **self._scan_args())
+        telemetry.note_grow_dispatches(1.0)
         with telem.phase("host_sync"):
             totals = jax.device_get(totals_dev)
         root = _LeafState(0, bag_cnt, float(totals[0]), float(totals[1]), 0)
@@ -322,12 +330,26 @@ class SerialTreeLearner:
 
         self.indices_buf = indices_buf
         self.leaves = leaves
+        # the host loop pays ~num_leaves growth-program dispatches per
+        # tree — the O(leaves) baseline the fused device program beats
+        telemetry.note_grow_dispatches(0.0, trees=1.0)
         return tree
 
     def _fused_meta(self, base_mask, rng):
+        # per-tree constant unless per-node feature resampling is on:
+        # rebuilding it per split paid a fresh base-mask H2D plus two
+        # device mask ops for every split in the tree. Caching is
+        # rng-neutral — _node_feature_mask only draws from rng when
+        # feature_fraction_bynode is active, exactly when we skip the
+        # cache. train() clears the cache at tree start.
+        if self._meta_cache is not None:
+            return self._meta_cache
         mask = self._node_feature_mask(base_mask, rng) & (self.f_categorical == 0)
-        return (self.f_numbins, self.f_missing, self.f_default, mask,
+        meta = (self.f_numbins, self.f_missing, self.f_default, mask,
                 self.f_monotone, self._feature_penalty)
+        if not (0.0 < self.config.feature_fraction_bynode < 1.0):
+            self._meta_cache = meta
+        return meta
 
     def _cegb_cost(self, count: int) -> Optional[np.ndarray]:
         if not self._cegb_enabled:
@@ -343,7 +365,14 @@ class SerialTreeLearner:
     def _merge_categorical(self, st: "_LeafState", base_mask, rng) -> None:
         """Categorical split search runs as a separate (rarer) program and
         merges with the numerical winner on host."""
-        feature_mask = jnp.asarray(base_mask) & (self.f_categorical == 1)
+        # base_mask is fixed for the whole tree, so the categorical
+        # device mask is too (hoisted out of the split loop; train()
+        # clears the cache at tree start)
+        if self._cat_mask_cache is None:
+            self._cat_mask_cache = (jnp.asarray(base_mask)
+                                    & (self.f_categorical == 1))
+        feature_mask = self._cat_mask_cache
+        telemetry.note_grow_dispatches(1.0)
         cres = split_ops.find_best_split_categorical(
             self._hist_f32(st.hist), jnp.float32(st.sum_grad),
             jnp.float32(st.sum_hess),
@@ -396,6 +425,7 @@ class SerialTreeLearner:
             self._cegb_feature_used[inner_f] = True
         else:
             child_costs = None
+        telemetry.note_grow_dispatches(1.0)
         with telem.phase("partition"):
             if self._quant_bits:
                 out = fused_ops.fused_split_step_q(

@@ -273,3 +273,40 @@ def fused_root_step_q(
                         feature_meta, jnp.float32(-jnp.inf),
                         jnp.float32(jnp.inf), scan_kwargs, root_cost)
     return hist_q, totals, res
+
+
+# ---------------------------------------------------------------------------
+# whole-tree split-loop formulation (models/device_learner.py growth cores)
+
+def run_split_loop(cond, body, state, num_steps: int,
+                   program: str = "per_split"):
+    """Run a growth core's leaf-wise split loop under the selected
+    `grow_program` formulation.
+
+    ``per_split`` is the classic data-dependent ``lax.while_loop`` —
+    exits the moment no leaf has positive gain. ``fused_tree`` is a
+    fixed-trip ``lax.scan`` over ``num_steps`` (= num_leaves - 1, the
+    most splits a tree can take) whose body is gated by ``lax.cond``.
+    Both lower to ONE device program per tree; the scan form has a
+    STATIC trip count, which is what makes the whole-tree program
+    batchable with ``vmap`` (large-K multiclass: K trees, one dispatch)
+    and gives XLA a loop it can fully unroll/schedule.
+
+    Bit-exactness: unbatched ``lax.cond`` executes only the taken
+    branch, so once ``cond(state)`` goes False the identity arm carries
+    the state through the remaining trips untouched — ``k`` stops
+    advancing and the split records can never be overwritten; the
+    result is bit-identical to the while_loop form. Under ``vmap`` the
+    cond lowers to a select that runs both arms; the speculative body
+    arm only writes into the carry COPY of an already-stopped tree,
+    which the select discards (XLA clamps dynamic-slice indices, so
+    garbage state cannot fault).
+    """
+    if program != "fused_tree":
+        return jax.lax.while_loop(cond, body, state)
+
+    def _trip(st, _):
+        return jax.lax.cond(cond(st), body, lambda s: s, st), None
+
+    out, _ = jax.lax.scan(_trip, state, None, length=num_steps)
+    return out

@@ -1212,8 +1212,11 @@ class DeviceDataParallelTreeLearner(DeviceTreeLearner):
             leaf_id = leaf_id_pad[:n]
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
-            return (score_row + delta, rec, rec_cat if has_cat else None,
-                    leaf_id, k)
+            new_score = score_row + delta
+            # in-program sentry reduction (see the serial step contract)
+            finite = jnp.all(jnp.isfinite(new_score))
+            return (new_score, rec, rec_cat if has_cat else None,
+                    leaf_id, k, finite)
 
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
             obj_bufs = tuple(getattr(objective, k) for k in obj_keys)
@@ -1378,8 +1381,11 @@ class DeviceFeatureParallelTreeLearner(DeviceTreeLearner):
                                              g, h, w, base_mask, tree_key)
             lv = leaf_values_from_rec(rec, k, L)
             delta = jnp.take(lv, jnp.clip(leaf_id, 0, L - 1)) * shrinkage
-            return (score_row + delta, rec, rec_cat if has_cat else None,
-                    leaf_id, k)
+            new_score = score_row + delta
+            # in-program sentry reduction (see the serial step contract)
+            finite = jnp.all(jnp.isfinite(new_score))
+            return (new_score, rec, rec_cat if has_cat else None,
+                    leaf_id, k, finite)
 
         def step(score_row, base_mask, tree_key, bag_key, shrinkage):
             obj_bufs = tuple(getattr(objective, k) for k in obj_keys)

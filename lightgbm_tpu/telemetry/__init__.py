@@ -46,7 +46,8 @@ __all__ = ["counters", "recorder", "spans", "span", "events", "watchdogs",
            "aggregate", "bundle", "clock", "timeline", "mode", "set_mode",
            "enabled", "resolve_mode", "configure", "dump_trace",
            "telemetry_summary", "phase_breakdown", "prometheus_text",
-           "record_iteration", "reset", "xla_trace_active"]
+           "record_iteration", "reset", "xla_trace_active",
+           "note_grow_dispatches"]
 
 MODES = ("off", "summary", "trace")
 _mode = "off"
@@ -151,6 +152,23 @@ def dump_trace(path: str) -> str:
     first so the XLA timeline is flushed next to the host spans."""
     _xla_trace_stop()
     return spans.dump_trace(path)
+
+
+def note_grow_dispatches(dispatches: float, trees: float = 0.0) -> None:
+    """Growth-program dispatch accounting (the O(leaves)->O(1) fused
+    growth acceptance metric, ROADMAP item 5a): bump the raw
+    `grow_dispatches` / `grow_trees` counters and refresh the derived
+    `grow_dispatches_per_tree` gauge. Device learners hold the gauge at
+    O(1) (one whole-tree program, <= 3 with replay bookkeeping); the
+    serial host loop pays ~num_leaves per tree. Counted unconditionally
+    (low frequency, forensic) like the collective-retry counters."""
+    counters.incr("grow_dispatches", dispatches)
+    if trees:
+        counters.incr("grow_trees", trees)
+        counters.set_gauge(
+            "grow_dispatches_per_tree",
+            counters.get("grow_dispatches")
+            / max(counters.get("grow_trees"), 1.0))
 
 
 def telemetry_summary() -> dict:

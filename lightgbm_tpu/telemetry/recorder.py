@@ -11,9 +11,13 @@ and tools/profile_iter.py).
 Canonical phase names, so breakdowns from different paths diff cleanly:
 
     boost_avg   gradient   quantize   bagging    hist      split
-    partition   grow_dispatch         host_sync  tree_replay
+    partition   grow_dispatch         grow_fused host_sync tree_replay
     score_update            sentry    collective eval      stream_wait
     dist_hist_exchange
+
+`grow_fused` is the vmap-batched multiclass dispatch: all K per-class
+trees of one iteration as ONE batched whole-tree program
+(device_learner.train_batched, `grow_program=fused_tree`).
 
 `stream_wait` is the out-of-core pipeline's blocking H2D residue
 (io/stream.py): near-zero means the double buffer hid the transfers.

@@ -73,6 +73,52 @@ def test_trace_safety_static_and_metadata_branches_clean():
     assert r.active == [], [f.render() for f in r.active]
 
 
+# scan-carry idiom (fused growth): lax.scan/while_loop bodies run under
+# trace even when the enclosing function never jits — every parameter
+# (carry, xs, index) is a tracer
+
+_SCAN_BAD = """
+    from jax import lax
+
+    def grow(state, num_steps):
+        def step(carry, _):
+            score, k = carry
+            if k > 0:                    # traced carry: concretizes
+                score = score + 1.0
+            return (score, k + 1), None
+        carry, _ = lax.scan(step, state, None, length=num_steps)
+        return carry
+"""
+
+_SCAN_GOOD = """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def grow(state, num_steps, use_bias=True):
+        def step(carry, _):
+            score, k = carry
+            if use_bias:                 # closed-over static: trace-time
+                score = score + 0.5
+            score = jnp.where(k > 0, score + 1.0, score)
+            return lax.cond(k < 4, lambda c: c, lambda c: c,
+                            (score, k + 1)), None
+        carry, _ = lax.scan(step, state, None, length=num_steps)
+        return carry
+"""
+
+
+def test_trace_safety_flags_python_if_on_scan_carry():
+    r = _run_src(_SCAN_BAD, "trace-safety")
+    msgs = [f.message for f in r.active]
+    assert any("`if` on a traced value" in m and "lax.scan body" in m
+               for m in msgs), msgs
+
+
+def test_trace_safety_scan_carry_cond_and_static_closure_clean():
+    r = _run_src(_SCAN_GOOD, "trace-safety")
+    assert r.active == [], [f.render() for f in r.active]
+
+
 # ---------------------------------------------------------------------------
 # collective-discipline
 

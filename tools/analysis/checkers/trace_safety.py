@@ -15,6 +15,14 @@ static-argnames conventions:
   static_argnames=(...))`` (and the bare ``partial`` spelling),
   ``jax.jit(fn, ...)`` / ``shard_map(fn, ...)`` where ``fn`` names a
   def in the same module.
+* loop bodies: functions handed to ``lax.scan`` / ``lax.while_loop`` /
+  ``lax.fori_loop`` (by name or inline lambda) are traced with EVERY
+  parameter tainted — the carry/xs/index are tracers even when the
+  enclosing function never jits. This is what keeps the fused-growth
+  scan bodies (``grow_program=fused_tree``) honest: branching a split
+  decision on the carried leaf state must go through ``lax.cond``/
+  ``jnp.where``, never a Python ``if``. Closed-over statics stay
+  clean because only parameters seed the taint.
 * parameters NOT named in ``static_argnames`` start tainted; taint
   propagates through assignments; ``.shape``/``.ndim``/``.dtype``/
   ``.size``/``.aval`` reads and ``len()`` are static under jit and
@@ -39,6 +47,9 @@ STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
 STATIC_CALLS = {"len", "isinstance", "type", "getattr", "hasattr",
                 "id", "repr", "str", "format"}
 FLAG_CASTS = {"bool", "int", "float"}
+# lax loop combinators whose function-valued args run under trace with
+# every parameter a tracer: arg index -> role
+LOOP_BODY_ARGS = {"scan": (0,), "while_loop": (0, 1), "fori_loop": (2,)}
 
 
 def _static_argnames(call: ast.Call) -> Set[str]:
@@ -118,6 +129,22 @@ def _collect_jit_functions(tree: ast.AST
             elif isinstance(target, ast.Name):
                 for d in defs_by_name.get(target.id, []):
                     add(d, _static_argnames(node), kind)
+    # loop-body forms: lax.scan(body, ...), lax.while_loop(cond, body,
+    # ...), lax.fori_loop(lo, hi, body, ...) — the carry/xs/index
+    # parameters are tracers, so every parameter starts tainted
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        last = dotted_name(node.func).rsplit(".", 1)[-1]
+        for idx in LOOP_BODY_ARGS.get(last, ()):
+            if idx >= len(node.args):
+                continue
+            target = node.args[idx]
+            if isinstance(target, ast.Lambda):
+                add(target, set(), f"lax.{last} body")
+            elif isinstance(target, ast.Name):
+                for d in defs_by_name.get(target.id, []):
+                    add(d, set(), f"lax.{last} body")
     return out
 
 
@@ -274,8 +301,9 @@ def check(project: Project) -> Iterable[Finding]:
     out: List[Finding] = []
     for src in project.files:
         tree = src.tree
-        if tree is None or "jit" not in src.text and \
-                "shard_map" not in src.text:
+        if tree is None or not any(
+                key in src.text for key in
+                ("jit", "shard_map", "scan", "while_loop", "fori_loop")):
             continue
         for fn, statics, how in _collect_jit_functions(tree):
             out.extend(_check_fn(src.path, fn, statics, how))

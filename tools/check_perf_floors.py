@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Perf-floor regression guard: host-stable ratios must not regress.
+
+Companion to tools/check_tier1_dots.py — where that gate pins test
+*count*, this one pins the perf ratios the optimisation PRs bought.
+Absolute throughput (rows/s) varies wildly across hosts, so the gate
+only promotes RATIOS that are stable on a single host class:
+
+    hist_int8_speedup         bf16 2-pass vs int8 histogram kernel time
+                              (tools/microbench_hist2.py, `int8_speedup`)
+    rows_q_speedup            3-word f32 vs 1-word packed row partition
+                              (tools/microbench_rows.py, `q_speedup`)
+    stream_overlap_fraction   fraction of H2D bytes the double-buffered
+                              out-of-core pipeline hid behind compute
+                              (tools/microbench_stream.py, chunked run)
+    grow_dispatches_per_tree  growth-program dispatches per tree with
+                              `grow_program=fused_tree` (in-process
+                              probe over the telemetry counter; the
+                              single-program growth contract pins this
+                              to <= 3 regardless of host)
+
+Usage: python tools/check_perf_floors.py [--update] [--baseline PATH]
+       --update re-measures and rewrites the baseline (value + derived
+       floor/ceiling); default mode re-measures and compares against
+       the committed baseline. PERF_METRICS=a,b restricts to a subset
+       (the others are checked against nothing and skipped loudly).
+Exit:  0 ok, 1 regression, 2 unmeasurable (a bench failed to produce
+       its metric, or the baseline is missing/unreadable)
+
+Sizes are deliberately small (the reference CI host is a 1-core CPU
+box); override with PERF_HIST_ROWS/REPS, PERF_ROWS_ROWS/REPS,
+PERF_STREAM_ROWS/TREES when gating on real accelerators.
+"""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
+
+# tolerance bands: floors are value*(1-tol) so a committed baseline
+# survives normal same-host jitter; the dispatch ceiling is an absolute
+# contract (ISSUE 17) and does not scale with the measured value.
+_HIST_ROWS = os.environ.get("PERF_HIST_ROWS", "131072")
+_HIST_REPS = os.environ.get("PERF_HIST_REPS", "3")
+_ROWS_ROWS = os.environ.get("PERF_ROWS_ROWS", "131072")
+_ROWS_REPS = os.environ.get("PERF_ROWS_REPS", "3")
+_STREAM_ROWS = os.environ.get("PERF_STREAM_ROWS", "120000")
+_STREAM_TREES = os.environ.get("PERF_STREAM_TREES", "2")
+
+METRICS = {
+    "hist_int8_speedup": {
+        "kind": "floor", "tol": 0.30,
+        "cmd": [sys.executable, os.path.join(HERE, "microbench_hist2.py"),
+                _HIST_ROWS, _HIST_REPS],
+        "extract": lambda obj: obj.get("int8_speedup"),
+    },
+    "rows_q_speedup": {
+        "kind": "floor", "tol": 0.30,
+        "cmd": [sys.executable, os.path.join(HERE, "microbench_rows.py"),
+                _ROWS_ROWS, _ROWS_REPS],
+        "extract": lambda obj: obj.get("q_speedup"),
+    },
+    "stream_overlap_fraction": {
+        "kind": "floor", "tol": 0.50,
+        "cmd": [sys.executable, os.path.join(HERE, "microbench_stream.py"),
+                _STREAM_ROWS, _STREAM_TREES],
+        "env": {"STREAM_LEAVES": os.environ.get("PERF_STREAM_LEAVES", "63")},
+        "extract": lambda obj: (obj.get("chunked") or {}).get(
+            "overlap_fraction"),
+    },
+    "grow_dispatches_per_tree": {
+        "kind": "ceiling", "ceiling": 3.0,
+        "extract": None,        # in-process probe, see below
+    },
+}
+
+
+def _last_json_line(text: str):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _run_bench(spec) -> float:
+    env = dict(os.environ)
+    env.update(spec.get("env", {}))
+    proc = subprocess.run(spec["cmd"], capture_output=True, text=True,
+                          env=env, cwd=REPO)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{' '.join(spec['cmd'][1:])} exited {proc.returncode}")
+    obj = _last_json_line(proc.stdout)
+    if obj is None:
+        raise RuntimeError("no JSON line in bench output")
+    val = spec["extract"](obj)
+    if val is None:
+        raise RuntimeError("bench JSON missing the gated metric")
+    return float(val)
+
+
+def _measure_dispatches_per_tree() -> float:
+    """Train a small fused_tree model in-process and read the gauge."""
+    import numpy as np
+
+    from lightgbm_tpu import telemetry
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Dataset
+    from lightgbm_tpu.models.device_learner import DeviceTreeLearner
+    from lightgbm_tpu.models.gbdt import create_boosting
+    from lightgbm_tpu.telemetry import counters
+
+    r = np.random.RandomState(7)
+    x = r.randn(4096, 10).astype(np.float32)
+    y = ((x[:, 0] + 0.5 * x[:, 3] + r.randn(4096) * 0.3) > 0
+         ).astype(np.float64)
+    cfg = Config({"objective": "binary", "num_leaves": 15, "max_bin": 63,
+                  "metric": "none", "verbosity": -1,
+                  "grow_program": "fused_tree"})
+    ds = Dataset(x, config=cfg, label=y)
+    bst = create_boosting(cfg, ds)
+    if not isinstance(bst.learner, DeviceTreeLearner):
+        raise RuntimeError(
+            f"probe needs the device learner, got "
+            f"{type(bst.learner).__name__}")
+    telemetry.reset()
+    for _ in range(4):
+        bst.train_one_iter()
+    _ = bst.models          # flush any pipelined fused iteration
+    val = counters.get("grow_dispatches_per_tree")
+    if counters.get("grow_trees") <= 0:
+        raise RuntimeError("probe trained no trees")
+    return float(val)
+
+
+def measure(name: str) -> float:
+    spec = METRICS[name]
+    if name == "grow_dispatches_per_tree":
+        return _measure_dispatches_per_tree()
+    return _run_bench(spec)
+
+
+def main(argv) -> int:
+    update = "--update" in argv
+    path = DEFAULT_BASELINE
+    if "--baseline" in argv:
+        path = argv[argv.index("--baseline") + 1]
+    subset = [s for s in os.environ.get("PERF_METRICS", "").split(",") if s]
+    names = [n for n in METRICS if not subset or n in subset]
+
+    measured = {}
+    for name in names:
+        try:
+            measured[name] = measure(name)
+            print(f"perf_floors: measured {name} = {measured[name]:.4f}")
+        except Exception as exc:
+            print(f"perf_floors: cannot measure {name}: {exc}",
+                  file=sys.stderr)
+            return 2
+
+    if update:
+        try:
+            with open(path) as fh:
+                baseline = json.load(fh)
+        except (OSError, ValueError):
+            baseline = {"metrics": {}}
+        import jax
+        baseline["backend"] = jax.default_backend()
+        mets = baseline.setdefault("metrics", {})
+        for name, val in measured.items():
+            spec = METRICS[name]
+            ent = {"value": round(val, 4), "kind": spec["kind"]}
+            if spec["kind"] == "floor":
+                ent["floor"] = round(val * (1.0 - spec["tol"]), 4)
+                ent["tol"] = spec["tol"]
+            else:
+                ent["ceiling"] = spec["ceiling"]
+            mets[name] = ent
+        with open(path, "w") as fh:
+            json.dump(baseline, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"perf_floors: baseline written to {path}")
+        return 0
+
+    try:
+        with open(path) as fh:
+            baseline = json.load(fh)
+    except (OSError, ValueError) as exc:
+        print(f"perf_floors: cannot read baseline {path}: {exc} "
+              "(run with --update to create it)", file=sys.stderr)
+        return 2
+
+    failures = 0
+    for name, val in measured.items():
+        ent = baseline.get("metrics", {}).get(name)
+        if ent is None:
+            print(f"perf_floors: {name} has no committed baseline — "
+                  "skipped (run --update to pin it)", file=sys.stderr)
+            continue
+        if ent.get("kind") == "ceiling":
+            bound = float(ent["ceiling"])
+            ok = val <= bound
+            rel = "<="
+        else:
+            bound = float(ent["floor"])
+            ok = val >= bound
+            rel = ">="
+        if ok:
+            print(f"perf_floors: ok — {name} {val:.4f} {rel} {bound:.4f} "
+                  f"(baseline {ent.get('value')})")
+        else:
+            print(f"perf_floors: REGRESSION — {name} {val:.4f} violates "
+                  f"{rel} {bound:.4f} (baseline {ent.get('value')})",
+                  file=sys.stderr)
+            failures += 1
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -86,7 +86,7 @@ for it in range(ITERS):
     shr = jnp.float32(bst.shrinkage_rate)
     t = mark("arg_put", t)
 
-    new_score, rec, rec_cat, leaf_id, k_dev = fused_step(
+    new_score, rec, rec_cat, leaf_id, k_dev, _finite = fused_step(
         bst.score_updater.score[0], base_mask, tree_key, bag_key, shr)
     t = mark("dispatch", t)
 

@@ -75,11 +75,18 @@ sys.stderr.write(
     f"{breakdown['wall_s']/max(breakdown['iterations'],1)*1e3:9.1f} ms/iter"
     f"  coverage={breakdown['coverage']}\n")
 
+from lightgbm_tpu.telemetry import counters as _counters  # noqa: E402
+
 print(json.dumps({
     "profile_iter": {
         "rows": N, "features": F, "iters": ITERS,
         "backend": jax.default_backend(),
         "learner": type(bst.learner).__name__,
+        "grow_program": str(getattr(cfg, "grow_program", "per_split")),
         "loop_wall_s": round(wall, 3),
+        "grow_dispatches": _counters.get("grow_dispatches"),
+        "grow_trees": _counters.get("grow_trees"),
+        "grow_dispatches_per_tree": round(
+            _counters.get("grow_dispatches_per_tree"), 3),
         "phase_breakdown": breakdown,
     }}))
