@@ -150,7 +150,12 @@ def checkpoint(directory: str, checkpoint_freq: int = 1, keep_last: int = 3,
                     DistributedCheckpointManager)
                 state["mgr"] = DistributedCheckpointManager(
                     directory, keep_last, prefix)
-            path = state["mgr"].save(env.model, history=history)
+            # target_rounds rides every checkpoint so a preempted or
+            # replacement process can resume with num_boost_round=None
+            # and still finish the run's ORIGINAL budget
+            path = state["mgr"].save(
+                env.model, history=history,
+                extra_meta={"target_rounds": int(env.end_iteration)})
             from .telemetry import events as telem_events
             telem_events.emit("checkpoint", iteration=env.iteration,
                               path=path)

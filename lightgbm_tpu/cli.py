@@ -73,7 +73,28 @@ def run(argv=None) -> int:
     return 0
 
 
+# every spelling the config surface accepts for the boosting budget; a
+# resumed run only adopts the checkpoint's recorded target_rounds when
+# NONE of these was given explicitly (an explicit budget always wins)
+_NUM_ITER_ALIASES = ("num_iterations", "num_iteration", "n_iter",
+                     "num_tree", "num_trees", "num_round", "num_rounds",
+                     "num_boost_round", "n_estimators")
+
+
 def _init_network(cfg: Config) -> None:
+    if os.environ.get("LGBM_TPU_REJOIN", "") == "1":
+        # replacement process (elastic rejoin): skip the machines-list
+        # bring-up entirely and knock on a survivor's rejoin listener;
+        # the ack carries coordinator/world/rank and the survivor group
+        # meets us at its next durable checkpoint (docs/Reliability.md)
+        contact = os.environ.get("LGBM_TPU_REJOIN_CONTACT", "").strip()
+        if not contact:
+            log.fatal("LGBM_TPU_REJOIN=1 requires "
+                      "LGBM_TPU_REJOIN_CONTACT=host:port (a survivor's "
+                      "supervision listener)")
+        from .distributed import supervisor
+        supervisor.rejoin_as_replacement(contact)
+        return
     if cfg.num_machines > 1:
         from .parallel import network
         machines = cfg.machines
@@ -96,6 +117,12 @@ def _init_network(cfg: Config) -> None:
 
 def _train(params: Dict[str, str], cfg: Config) -> None:
     _init_network(cfg)
+    # graceful preemption: SIGTERM/SIGINT arms a flag that the boosting
+    # loop checks at the next iteration boundary (emergency checkpoint,
+    # exit code 76); installed on EVERY rank so the group vote sees any
+    # rank's signal (resilience/preempt.py)
+    from .resilience import preempt
+    preempt.install_handlers()
     if not cfg.data:
         log.fatal("No training data: set data=<file>")
     t0 = time.time()
@@ -119,6 +146,7 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
         from .engine import _load_init_model
         _load_init_model(booster, cfg.input_model)
     ckpt_dir = cfg.output_model + ".ckpt"
+    resume_meta = None
     if cfg.resume:
         # resume=auto resumes from the run's own checkpoint directory;
         # any other value is a checkpoint file or directory path.
@@ -127,7 +155,8 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
         from .distributed.checkpoint import restore_for_resume
         src = (ckpt_dir if str(cfg.resume).lower() in ("auto", "true", "1")
                else cfg.resume)
-        restore_for_resume(booster, src)
+        data = restore_for_resume(booster, src)
+        resume_meta = data.meta or {}
         log.info("Resumed training at iteration %d",
                  booster.current_iteration())
     mgr = None
@@ -138,11 +167,41 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
         mgr = DistributedCheckpointManager(ckpt_dir,
                                            keep_last=cfg.snapshot_keep)
     num_iters = cfg.num_iterations
+    if resume_meta is not None and resume_meta.get("target_rounds") \
+            and not any(k in params for k in _NUM_ITER_ALIASES):
+        # the checkpoint (emergency-preempt or periodic) recorded the
+        # run's original budget: a bare `resume=auto` relaunch finishes
+        # THAT run, not the config default
+        num_iters = int(resume_meta["target_rounds"])
+        log.info("resume: continuing to the checkpoint's recorded "
+                 "target of %d rounds", num_iters)
     metric_freq = max(1, cfg.metric_freq)
     snapshot_freq = cfg.snapshot_freq
     t0 = time.time()
     from .distributed import supervisor
     from .resilience import faults
+
+    def _emergency_exit(booster, mgr, it):
+        """Graceful-preemption exit (mirrors engine._preempt_exit):
+        checkpoint at THIS iteration boundary, stamp target_rounds, and
+        leave with the contract exit code 76."""
+        from . import telemetry
+        from .distributed.checkpoint import DistributedCheckpointManager
+        m = mgr or DistributedCheckpointManager(
+            ckpt_dir, keep_last=cfg.snapshot_keep)
+        path = m.save(booster,
+                      extra_meta={"target_rounds": int(num_iters),
+                                  "preempted": True,
+                                  "preempt_reason": preempt.reason()})
+        telemetry.events.emit("preempt", phase="exit", iteration=int(it),
+                              path=path or ckpt_dir,
+                              exit_code=preempt.PREEMPT_EXIT_CODE)
+        telemetry.events.flush()
+        log.warning("preempted (%s): emergency checkpoint at iteration "
+                    "%d -> %s; exiting %d (resume=auto continues to "
+                    "round %d)", preempt.reason(), it, path or ckpt_dir,
+                    preempt.PREEMPT_EXIT_CODE, num_iters)
+        raise SystemExit(preempt.PREEMPT_EXIT_CODE)
 
     def _boost_loop(booster, mgr):
         sup = supervisor.active()
@@ -151,6 +210,11 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
             faults.kill_point(it)
             if sup is not None:
                 sup.check()
+            # collective payloads this iteration carry this epoch
+            # (io/distributed.py epoch fence)
+            faults.set_epoch(it)
+            if preempt.group_requested():
+                _emergency_exit(booster, mgr, it)   # never returns
             t_it = time.time()
             stop = booster.update()
             log.info("%.6f seconds elapsed, finished iteration %d",
@@ -162,11 +226,40 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
             if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
                 _write_snapshot(booster, cfg, it + 1)
             if mgr is not None and (it + 1) % cfg.checkpoint_freq == 0:
-                mgr.save(booster)
+                mgr.save(booster,
+                         extra_meta={"target_rounds": int(num_iters)})
             if stop:
                 break
+        faults.set_epoch(-1)
+
+    def _rebuild_for_world():
+        """Fresh Dataset/Booster for the CURRENT world after a re-form
+        (CLI ingest re-reads the file; single-host construction is the
+        byte path a fresh resumed run would take), restored from the
+        last rank-0 checkpoint."""
+        ts = Dataset(cfg.data, params=params)
+        ts.construct()
+        b = Booster(params=params, train_set=ts)
+        for i, vpath in enumerate(cfg.valid or []):
+            vset = ts.create_valid(vpath)
+            b.add_valid(vset, f"valid_{i + 1}" if i else "valid_1")
+        from .distributed.checkpoint import (DistributedCheckpointManager,
+                                             restore_for_resume)
+        restore_for_resume(b, ckpt_dir)
+        m = DistributedCheckpointManager(ckpt_dir,
+                                         keep_last=cfg.snapshot_keep)
+        return b, m
 
     try:
+        _boost_loop(booster, mgr)
+    except supervisor.RejoinSignal as rj:
+        # a replacement knocked and every member reached the same
+        # durable checkpoint: re-form the group at world+1 and resume
+        del booster
+        new_world = supervisor.expand_after_rejoin(rj.info)
+        booster, mgr = _rebuild_for_world()
+        log.warning("re-formed at %d process(es): resuming at iteration "
+                    "%d", new_world, booster.current_iteration())
         _boost_loop(booster, mgr)
     except Exception as exc:
         rf = supervisor.classify_failure(exc)
@@ -176,25 +269,17 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
             log.warning("on_rank_failure=shrink without checkpoint_freq: "
                         "nothing to resume from")
             raise
-        # shrink-and-resume: tear the dead group down, rebuild the
-        # dataset for the surviving world (CLI ingest re-reads the
-        # file; single-host construction is the byte path a fresh
-        # resumed run would take), restore the last rank-0 checkpoint,
-        # and finish the boosting budget (docs/Reliability.md)
+        # shrink-and-resume: tear the dead group down, restore the last
+        # rank-0 checkpoint, finish the budget (docs/Reliability.md)
         del exc
         del booster
         new_world = supervisor.shrink_after_failure(rf)
-        train_set = Dataset(cfg.data, params=params)
-        train_set.construct()
-        booster = Booster(params=params, train_set=train_set)
-        for i, vpath in enumerate(cfg.valid or []):
-            vset = train_set.create_valid(vpath)
-            booster.add_valid(vset, f"valid_{i + 1}" if i else "valid_1")
-        from .distributed.checkpoint import (DistributedCheckpointManager,
-                                             restore_for_resume)
-        restore_for_resume(booster, ckpt_dir)
-        mgr = DistributedCheckpointManager(ckpt_dir,
-                                           keep_last=cfg.snapshot_keep)
+        # rejoin grace window: a replacement arriving within
+        # LGBM_TPU_REJOIN_WAIT_MS turns kill->replace into ONE re-form
+        info = supervisor.poll_rejoin_window()
+        if info is not None:
+            new_world = supervisor.expand_after_rejoin(info)
+        booster, mgr = _rebuild_for_world()
         log.warning("recovered: resuming at iteration %d with %d "
                     "process(es)", booster.current_iteration(), new_world)
         _boost_loop(booster, mgr)
@@ -438,7 +523,9 @@ def _gateway(params: Dict[str, str], block: bool = True):
     (comma-separated base URLs when running without a manifest),
     gateway_retries, gateway_backoff_ms, gateway_eject_s,
     gateway_health_period_s, gateway_timeout_ms, gateway_transform
-    (explicit ``.transform.json`` path for raw CSV/JSON ingestion).
+    (explicit ``.transform.json`` path for raw CSV/JSON ingestion),
+    gateway_hedge_ms (tail-latency hedging: duplicate a /predict to a
+    second replica after this many ms without an answer; 0 = off).
     """
     from .fleet.gateway import FleetGateway, run_gateway_server
     replicas = [u for u in
@@ -462,7 +549,8 @@ def _gateway(params: Dict[str, str], block: bool = True):
         backoff_s=float(params.get("gateway_backoff_ms", 50.0)) / 1e3,
         eject_s=float(params.get("gateway_eject_s", 2.0)),
         health_period_s=float(params.get("gateway_health_period_s", 0.5)),
-        timeout_s=float(params.get("gateway_timeout_ms", 10000.0)) / 1e3)
+        timeout_s=float(params.get("gateway_timeout_ms", 10000.0)) / 1e3,
+        hedge_s=float(params.get("gateway_hedge_ms", 0.0)) / 1e3)
     return run_gateway_server(
         gateway, host=params.get("gateway_host", "127.0.0.1"),
         port=int(params.get("gateway_port", 8088)),

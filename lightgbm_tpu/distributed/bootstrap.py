@@ -93,8 +93,12 @@ def _initialize_supervised(coordinator_address: str, num_processes: int,
         _jd.global_state.service = xe.get_distributed_runtime_service(
             f"[::]:{port}", int(num_processes),
             heartbeat_interval=inert_s, max_missing_heartbeats=10)
+    # init_timeout doubles as the elastic-rejoin wait: a replacement
+    # process blocks here until the existing members reach their
+    # re-form boundary and rank 0 starts the new service
+    init_timeout = int(os.environ.get("LGBM_TPU_INIT_TIMEOUT_S", 60))
     client = xe.get_distributed_runtime_client(
-        coordinator_address, int(process_id), init_timeout=60,
+        coordinator_address, int(process_id), init_timeout=init_timeout,
         heartbeat_interval=inert_s, max_missing_heartbeats=10,
         shutdown_on_destruction=False, use_compression=True)
     client.connect()

@@ -48,29 +48,61 @@ class DistributedCheckpointManager:
     def __init__(self, directory: str, keep_last: int = 3,
                  prefix: str = "ckpt"):
         self.directory = directory
+        self._keep_last = keep_last
+        self._prefix = prefix
+        self._writer_rank = bootstrap.rank()
         self._writer = (CheckpointManager(directory, keep_last, prefix)
-                        if bootstrap.rank() == 0 else None)
+                        if self._writer_rank == 0 else None)
 
-    def save(self, booster, history: Optional[list] = None) -> str:
+    def _current_writer(self) -> Optional[CheckpointManager]:
+        """Write duty follows the CURRENT rank, not the rank at
+        construction: an elastic shrink renumbers survivors (the first
+        survivor of a dead coordinator BECOMES rank 0), and the duty —
+        and its rotation state — must move with the number or the
+        shrunken group trains on with nobody writing."""
+        r = bootstrap.rank()
+        if r != self._writer_rank:
+            self._writer_rank = r
+            self._writer = (CheckpointManager(self.directory,
+                                              self._keep_last,
+                                              self._prefix)
+                            if r == 0 else None)
+        return self._writer
+
+    def save(self, booster, history: Optional[list] = None,
+             extra_meta=None) -> str:
         path = ""
+        writer = self._current_writer()
         if bootstrap.is_distributed():
             # capture is a collective (row-sharded scores are gathered
             # across processes), so EVERY rank runs it; only rank 0 has
             # a writer
             from ..resilience.checkpoint import capture
-            meta, arrays = capture(booster, history)
-            if self._writer is not None:
-                path = self._writer.save_captured(meta, arrays)
-        elif self._writer is not None:
-            path = self._writer.save(booster, history=history)
+            meta, arrays = capture(booster, history,
+                                   extra_meta=extra_meta)
+            if writer is not None:
+                path = writer.save_captured(meta, arrays)
+        elif writer is not None:
+            path = writer.save(booster, history=history,
+                               extra_meta=extra_meta)
         # every rank blocks until rank 0's write is durable — a kill
         # after the barrier can always resume from this iteration
         bootstrap.barrier("ckpt_save")
+        # elastic rejoin (opt-in LGBM_TPU_ELASTIC_REJOIN=1): a durable
+        # checkpoint is the one boundary the group can safely re-form
+        # at N+1 — every member raises the same RejoinSignal (the
+        # rendezvous is itself a collective when distributed) and the
+        # engine re-bootstraps + resumes from the file just written
+        from . import supervisor
+        info = supervisor.rendezvous_pending_rejoin()
+        if info is not None:
+            raise supervisor.RejoinSignal(info)
         return path
 
     def latest(self) -> Optional[CheckpointData]:
-        if self._writer is not None:
-            return self._writer.latest()
+        writer = self._current_writer()
+        if writer is not None:
+            return writer.latest()
         return None
 
 

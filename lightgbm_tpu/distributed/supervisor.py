@@ -58,15 +58,33 @@ from ..telemetry import counters as telem_counters
 from ..telemetry import events as telem_events
 from ..utils import log
 
-__all__ = ["RankFailure", "Supervisor", "classify_failure",
+__all__ = ["RankFailure", "RejoinSignal", "Supervisor", "classify_failure",
            "shrink_after_failure", "start_supervision", "active",
-           "stop_supervision"]
+           "stop_supervision", "derive_regroup", "expand_after_rejoin",
+           "rejoin_as_replacement", "rendezvous_pending_rejoin",
+           "await_rejoin_request", "poll_rejoin_window"]
 
 # request: the 12-byte magic. response: magic + struct.pack("<d",
 # time.time()) — liveness is "the event loop answered"; the stamp makes
 # every probe a free clock-offset sample (telemetry/clock.py)
 _MAGIC = b"lgbm-tpu-hb1"
 _STAMP_LEN = 8
+# rejoin request: same 12-byte slot so one listener serves both wires.
+# Body is a 4-byte-length-prefixed pickle dict; the reply is a length-
+# prefixed pickle ack naming the coordinator the re-formed group will
+# rendezvous on (see rejoin_as_replacement / expand_after_rejoin).
+_REJOIN_MAGIC = b"lgbm-tpu-rj1"
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    """Read exactly n bytes (short on EOF — callers length-check)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            break
+        buf += chunk
+    return buf
 
 # error-text signatures a dead gloo peer produces in the survivor; all
 # are catchable XlaRuntimeError / RuntimeError, measured on the probed
@@ -97,6 +115,23 @@ class RankFailure(RuntimeError):
         super().__init__(f"rank failure ({who}): {self.reason}")
 
 
+class RejoinSignal(Exception):
+    """A replacement process is waiting to join and the group just made
+    a checkpoint durable — the one boundary re-forming at N+1 is safe.
+    Raised SYMMETRICALLY on every member (the rendezvous that produces
+    it is itself a collective when distributed); ``info`` is the ack the
+    newcomer already holds: coordinator address, new world size, the
+    newcomer's rank, heartbeat period. Not an error — control flow the
+    training loops catch to run ``expand_after_rejoin`` and resume from
+    the checkpoint just written."""
+
+    def __init__(self, info: dict):
+        self.info = dict(info)
+        super().__init__(
+            f"elastic rejoin pending: world -> {self.info.get('world')} "
+            f"via {self.info.get('coordinator')}")
+
+
 class Supervisor:
     """Per-rank heartbeat responder + peer prober.
 
@@ -120,6 +155,9 @@ class Supervisor:
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self.port: int = 0
+        # acks already issued to replacement processes, waiting for the
+        # group to reach a safe re-form boundary (one at a time)
+        self._pending_rejoin: List[dict] = []
 
     # -- lifecycle ------------------------------------------------------
     def start_listener(self, port: int = 0) -> int:
@@ -139,9 +177,10 @@ class Supervisor:
         return self.port
 
     def _serve_loop(self) -> None:
-        # accept, read the magic, echo it back with a wall-clock stamp,
-        # close. Any failure on a single connection is the prober's
-        # problem, not ours.
+        # accept, read a 12-byte magic, dispatch: heartbeat probes get
+        # the magic echoed back with a wall-clock stamp; rejoin requests
+        # get a length-prefixed pickle ack. Any failure on a single
+        # connection is the dialer's problem, not ours.
         while not self._stop.is_set():
             srv = self._listener
             if srv is None:
@@ -153,17 +192,59 @@ class Supervisor:
             try:
                 with conn:
                     conn.settimeout(self._timeout_s)
-                    buf = b""
-                    while len(buf) < len(_MAGIC):
-                        chunk = conn.recv(len(_MAGIC) - len(buf))
-                        if not chunk:
-                            break
-                        buf += chunk
+                    buf = _recv_exact(conn, len(_MAGIC))
                     if buf == _MAGIC:
                         conn.sendall(_MAGIC
                                      + struct.pack("<d", time.time()))
+                    elif buf == _REJOIN_MAGIC:
+                        self._answer_rejoin(conn)
             except OSError:
                 continue
+
+    def _answer_rejoin(self, conn: socket.socket) -> None:
+        """Serve one rejoin request: record the pending ack (one at a
+        time — a second request while one is pending is refused) and
+        reply with the rendezvous the re-formed group will meet at."""
+        conn.settimeout(5.0)
+        ln = _recv_exact(conn, 4)
+        if len(ln) < 4:
+            return
+        try:
+            req = pickle.loads(_recv_exact(conn, struct.unpack("<I", ln)[0]))
+        except Exception:   # noqa: BLE001 — garbage on the wire
+            return
+        with self._lock:
+            busy = bool(self._pending_rejoin)
+        if busy:
+            ack = {"error": "a rejoin is already pending"}
+        else:
+            try:
+                ack = _build_rejoin_ack(req, self.heartbeat_ms)
+            except Exception as exc:   # noqa: BLE001 — refusal, not crash
+                ack = {"error": str(exc)}
+        if "error" not in ack:
+            with self._lock:
+                self._pending_rejoin.append(ack)
+            telem_events.emit("rejoin_request",
+                              host=str(req.get("host", "")),
+                              coordinator=ack["coordinator"],
+                              new_world=ack["world"])
+            log.warning("rejoin request from %s: group will re-form at "
+                        "world %d via %s at the next safe boundary",
+                        req.get("host", "?"), ack["world"],
+                        ack["coordinator"])
+        payload = pickle.dumps(ack, protocol=4)
+        conn.sendall(struct.pack("<I", len(payload)) + payload)
+
+    def drain_pending_rejoin(self) -> List[dict]:
+        with self._lock:
+            out = list(self._pending_rejoin)
+            self._pending_rejoin = []
+        return out
+
+    def has_pending_rejoin(self) -> bool:
+        with self._lock:
+            return bool(self._pending_rejoin)
 
     def start_prober(self) -> None:
         t = threading.Thread(target=self._probe_loop, daemon=True,
@@ -210,7 +291,16 @@ class Supervisor:
         from ..io.distributed import _allgather_host_bytes
         from . import bootstrap
         sup = cls(bootstrap.rank(), {}, heartbeat_ms, max_misses)
-        sup.start_listener()
+        # LGBM_TPU_REJOIN_PORT pins THIS rank's listener so a future
+        # replacement process has a known address to dial (the heartbeat
+        # listener doubles as the rejoin endpoint); ephemeral otherwise.
+        # Fall back to ephemeral on a bind collision so co-located ranks
+        # sharing an environment never fail bring-up.
+        try:
+            sup.start_listener(
+                int(os.environ.get("LGBM_TPU_REJOIN_PORT", "0") or 0))
+        except OSError:
+            sup.start_listener()
         me = (sup.rank, _advertise_host(), sup.port)
         entries = [pickle.loads(c) for c in _allgather_host_bytes(
             pickle.dumps(me, protocol=4))]
@@ -355,6 +445,8 @@ def _advertise_host() -> str:
 
 # -- module singleton ---------------------------------------------------
 _active: Optional[Supervisor] = None
+_last_hb_ms: float = 0.0      # last armed heartbeat period (rejoin acks)
+_rejoin_gen: int = 0          # completed rejoins (coordinator-port salt)
 
 
 def active() -> Optional[Supervisor]:
@@ -368,7 +460,7 @@ def start_supervision(heartbeat_ms: float, collective_timeout_ms: float = 0
     heartbeat supervisor. No-ops single-process or when
     ``heartbeat_ms <= 0`` — the opt-in that keeps the single-host path
     byte-identical."""
-    global _active
+    global _active, _last_hb_ms
     from ..resilience import faults
     from . import bootstrap
     if not bootstrap.is_distributed():
@@ -377,6 +469,7 @@ def start_supervision(heartbeat_ms: float, collective_timeout_ms: float = 0
         faults.set_collective_timeout_ms(collective_timeout_ms)
     if not heartbeat_ms or heartbeat_ms <= 0:
         return None
+    _last_hb_ms = float(heartbeat_ms)
     if _active is not None:
         return _active
     _active = Supervisor.for_group(heartbeat_ms=heartbeat_ms)
@@ -421,6 +514,102 @@ def classify_failure(exc: BaseException,
 
 
 # -- shrink-and-resume ---------------------------------------------------
+def derive_regroup(world: int, dead, old_rank: int, old_coord: str,
+                   peer_hosts: Dict[int, Tuple[str, int]], my_host: str
+                   ) -> Tuple[int, int, str]:
+    """Pure derivation of the re-formed group's shape after a failure:
+    ``(survivors, new_rank, new_coordinator)`` (coordinator "" when the
+    group degrades to single-host). New rank = index in the sorted
+    survivor list, new coordinator = FIRST survivor's heartbeat host —
+    which is how a dead rank 0 hands coordination (and thereby rank-0
+    checkpoint-write duty) to the lowest surviving rank — and new port
+    = old coordinator port + number of dead ranks (the immortalized old
+    service keeps the old port bound, so the offset also avoids a bind
+    collision). No cross-host agreement protocol is needed: every input
+    here is already identical on every survivor when the shrink
+    starts."""
+    dead = sorted(set(int(r) for r in dead))
+    surviving = [r for r in range(world) if r not in set(dead)]
+    survivors = world - len(dead) if dead else 1
+    if survivors <= 1:
+        return 1, 0, ""
+    lead = surviving[0]
+    if lead == old_rank:
+        lead_host = my_host
+    elif lead in peer_hosts:
+        lead_host = peer_hosts[lead][0]
+    elif lead == 0 and old_coord:
+        lead_host = old_coord.rsplit(":", 1)[0]
+    else:
+        log.fatal(
+            "cannot re-form a %d-survivor group: no dialable "
+            "address for the new coordinator (rank %d) — heartbeat "
+            "supervision (dist_heartbeat_ms > 0) is required for "
+            "multi-survivor shrink", survivors, lead)
+    if not old_coord:
+        log.fatal("cannot re-form: old coordinator address unknown")
+    new_port = int(old_coord.rsplit(":", 1)[1]) + len(dead)
+    return survivors, surviving.index(old_rank), f"{lead_host}:{new_port}"
+
+
+def _teardown_backend() -> None:
+    """Validated in-process teardown of a live jax process group (order
+    matters — shared by shrink and elastic-rejoin expansion):
+
+    1. forget the cached mesh/identity so nothing re-dispatches onto
+       the dead topology through the bootstrap cache;
+    2. next backend must come up WITHOUT gloo first (re-forming paths
+       re-select gloo right before rejoining);
+    3. drop the dead runtime client/backend;
+    4. purge every cache that interns old Device objects (the Mesh
+       intern dict is global and never evicted);
+    5. detach the coordination client/service (and the preemption sync
+       manager — jax.distributed.initialize refuses to run again while
+       one is attached) from jax's global state WITHOUT destroying
+       them: their destructors (and jax's atexit shutdown) join
+       heartbeat/error-polling threads blocked on dead peer sockets and
+       abort the process. Immortalize via an extra refcount and let the
+       OS reclaim the sockets at exit."""
+    import ctypes
+    import gc
+
+    import jax
+    from jax._src import distributed as _jd
+
+    from . import bootstrap
+
+    bootstrap._state.update({"initialized": False, "num_processes": 1,
+                             "rank": 0, "mesh": None, "mesh_axis": None})
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "none")
+    except Exception:  # pragma: no cover - flag absent on this backend
+        pass
+    from jax.extend import backend as jeb
+    jeb.clear_backends()
+    try:
+        from jax._src import mesh as _mesh_mod
+        _mesh_mod._mesh_object_dict.clear()
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
+    jax.clear_caches()
+    for obj in (getattr(_jd.global_state, "client", None),
+                getattr(_jd.global_state, "service", None),
+                getattr(_jd.global_state, "preemption_sync_manager",
+                        None)):
+        if obj is not None:
+            ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
+    _jd.global_state.client = None
+    _jd.global_state.service = None
+    try:
+        _jd.global_state.preemption_sync_manager = None
+    except Exception:  # pragma: no cover - field absent on this jax
+        pass
+    _jd.global_state.num_processes = 1
+    _jd.global_state.process_id = 0
+    _jd.global_state.coordinator_address = None
+    gc.collect()
+
+
 def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
     """Tear down the dead process group and continue with the survivors.
 
@@ -444,8 +633,6 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
     (engine.train does); this function leaves the deadline off so the
     rendezvous cannot be killed by a stale timeout.
     """
-    import gc
-
     import jax
     from jax._src import distributed as _jd
 
@@ -456,34 +643,15 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
     if world <= 1:
         return 1
     dead = list(failure.ranks) if failure is not None else []
-    survivors = world - len(dead) if dead else 1
     # capture everything the re-bootstrap derives its addresses from
     # BEFORE teardown wipes jax's global state and the supervisor
     old_rank = int(getattr(_jd.global_state, "process_id", 0) or 0)
     old_coord = str(getattr(_jd.global_state, "coordinator_address", "")
                     or "")
-    surviving = [r for r in range(world) if r not in set(dead)]
-    new_coord = ""
-    if survivors > 1:
-        sup = _active
-        peer_hosts = dict(sup._peers) if sup is not None else {}
-        lead = surviving[0]
-        if lead == old_rank:
-            lead_host = _advertise_host()
-        elif lead in peer_hosts:
-            lead_host = peer_hosts[lead][0]
-        elif lead == 0 and old_coord:
-            lead_host = old_coord.rsplit(":", 1)[0]
-        else:
-            log.fatal(
-                "cannot re-form a %d-survivor group: no dialable "
-                "address for the new coordinator (rank %d) — heartbeat "
-                "supervision (dist_heartbeat_ms > 0) is required for "
-                "multi-survivor shrink", survivors, lead)
-        if not old_coord:
-            log.fatal("cannot re-form: old coordinator address unknown")
-        new_port = int(old_coord.rsplit(":", 1)[1]) + len(dead)
-        new_coord = f"{lead_host}:{new_port}"
+    sup = _active
+    peer_hosts = dict(sup._peers) if sup is not None else {}
+    survivors, new_rank, new_coord = derive_regroup(
+        world, dead, old_rank, old_coord, peer_hosts, _advertise_host())
 
     # freeze the dying world's evidence BEFORE any teardown: after
     # stop_supervision/clear_backends the prober state, ring and
@@ -505,52 +673,8 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
     if failure is not None:
         failure.__traceback__ = None
 
-    # --- validated teardown recipe (order matters) ---------------------
-    # 1. forget the cached mesh/identity so nothing re-dispatches onto
-    #    the dead topology through the bootstrap cache
-    bootstrap._state.update({"initialized": False, "num_processes": 1,
-                             "rank": 0, "mesh": None, "mesh_axis": None})
-    # 2. next backend must come up WITHOUT gloo first (the re-forming
-    #    path re-selects gloo right before rejoining)
-    try:
-        jax.config.update("jax_cpu_collectives_implementation", "none")
-    except Exception:  # pragma: no cover - flag absent on this backend
-        pass
-    # 3. drop the dead runtime client/backend
-    from jax.extend import backend as jeb
-    jeb.clear_backends()
-    # 4. purge every cache that interns old Device objects (the Mesh
-    #    intern dict is global and never evicted)
-    try:
-        from jax._src import mesh as _mesh_mod
-        _mesh_mod._mesh_object_dict.clear()
-    except Exception:  # pragma: no cover - jax internals moved
-        pass
-    jax.clear_caches()
-    # 5. detach the coordination client/service (and the preemption
-    #    sync manager — jax.distributed.initialize refuses to run again
-    #    while one is attached) from jax's global state WITHOUT
-    #    destroying them: their destructors (and jax's atexit shutdown)
-    #    join heartbeat/error-polling threads blocked on dead peer
-    #    sockets and abort the process. Immortalize via an extra
-    #    refcount and let the OS reclaim the sockets at exit.
-    import ctypes
-    for obj in (getattr(_jd.global_state, "client", None),
-                getattr(_jd.global_state, "service", None),
-                getattr(_jd.global_state, "preemption_sync_manager",
-                        None)):
-        if obj is not None:
-            ctypes.pythonapi.Py_IncRef(ctypes.py_object(obj))
-    _jd.global_state.client = None
-    _jd.global_state.service = None
-    try:
-        _jd.global_state.preemption_sync_manager = None
-    except Exception:  # pragma: no cover - field absent on this jax
-        pass
-    _jd.global_state.num_processes = 1
-    _jd.global_state.process_id = 0
-    _jd.global_state.coordinator_address = None
-    gc.collect()
+    # validated teardown recipe (order matters — see _teardown_backend)
+    _teardown_backend()
 
     # deadline off either way: single-host needs none, and the
     # multi-survivor rendezvous must not be killed by a stale timeout
@@ -562,10 +686,12 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
         telem_counters.set_gauge("dist_rank", 0)
         log.warning("shrink complete: continuing single-host on %d "
                     "device(s)", len(jax.devices()))
+        # a replacement must still find an open door after the
+        # supervisor died with the group (elastic rejoin, opt-in)
+        _restart_rejoin_listener()
         return 1
 
     # --- multi-survivor: re-form the group on a fresh port -------------
-    new_rank = surviving.index(old_rank)
     log.warning("re-forming process group: rank %d -> rank %d of %d "
                 "(coordinator %s)", old_rank, new_rank, survivors,
                 new_coord)
@@ -575,3 +701,220 @@ def shrink_after_failure(failure: Optional[RankFailure] = None) -> int:
     log.warning("shrink complete: continuing with %d process(es) on %d "
                 "device(s)", survivors, len(jax.devices()))
     return survivors
+
+
+# -- elastic rejoin ------------------------------------------------------
+# The grow half of the survival story (ROADMAP "survive"): a replacement
+# process started with LGBM_TPU_REJOIN=1 dials a survivor's heartbeat
+# endpoint (LGBM_TPU_REJOIN_CONTACT=host:port), receives an ack naming
+# the coordinator the re-formed group will meet at, and blocks in
+# bootstrap until the existing members reach a safe boundary — either
+# the post-shrink grace window (poll_rejoin_window) or the next durable
+# checkpoint (DistributedCheckpointManager.save -> RejoinSignal). The
+# whole lane is opt-in via LGBM_TPU_ELASTIC_REJOIN=1, set on EVERY
+# member (the rendezvous is a collective).
+
+def _rank0_host() -> str:
+    """Dialable host of the CURRENT rank 0 (the rank that will own the
+    re-formed group's coordination service and checkpoint writes)."""
+    from . import bootstrap
+    if bootstrap.rank() == 0:
+        return _advertise_host()
+    try:
+        from jax._src import distributed as _jd
+        coord = str(getattr(_jd.global_state, "coordinator_address", "")
+                    or "")
+        if coord:
+            return coord.rsplit(":", 1)[0]
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
+    return _advertise_host()
+
+
+def _build_rejoin_ack(req: dict, heartbeat_ms: float) -> dict:
+    """The rendezvous a replacement process should bootstrap toward.
+    Coordinator = current rank 0's host on a deterministic port derived
+    from LGBM_TPU_REJOIN_PORT (+1 per completed rejoin, so repeated
+    grow/shrink cycles never collide with an immortalized old service);
+    the newcomer takes rank = old world (existing members keep their
+    ranks, so scores/shards restored from the checkpoint stay put)."""
+    port_env = os.environ.get("LGBM_TPU_REJOIN_PORT", "").strip()
+    if not port_env:
+        raise RuntimeError(
+            "rejoin needs LGBM_TPU_REJOIN_PORT set on the survivor to "
+            "derive a deterministic coordinator port")
+    from . import bootstrap
+    world = bootstrap.process_count()
+    return {"coordinator": f"{_rank0_host()}:"
+                           f"{int(port_env) + 1 + _rejoin_gen}",
+            "world": world + 1, "rank": world,
+            "heartbeat_ms": float(heartbeat_ms), "gen": _rejoin_gen,
+            "peer_host": str(req.get("host", ""))}
+
+
+def _restart_rejoin_listener() -> None:
+    """After a shrink to single-host the supervisor died with the group
+    — but a replacement must still be able to dial something. With
+    elastic rejoin armed and LGBM_TPU_REJOIN_PORT set, bring up a
+    listener-only Supervisor on that fixed port (no peers, no prober)
+    and make it the active one so `check()` keeps working."""
+    global _active
+    port = os.environ.get("LGBM_TPU_REJOIN_PORT", "").strip()
+    if not port or os.environ.get("LGBM_TPU_ELASTIC_REJOIN", "") != "1":
+        return
+    if _active is not None:
+        return
+    sup = Supervisor(0, {}, heartbeat_ms=_last_hb_ms or 500.0)
+    try:
+        sup.start_listener(int(port))
+    except OSError as exc:  # pragma: no cover - port raced away
+        log.warning("could not re-arm rejoin listener on port %s: %s",
+                    port, exc)
+        return
+    _active = sup
+    log.warning("rejoin listener re-armed on port %s", port)
+
+
+def rendezvous_pending_rejoin() -> Optional[dict]:
+    """The one pending rejoin ack every member agrees on, or None.
+
+    Distributed, each member contributes its locally-received acks over
+    the all-gather lane so EVERY rank returns the same answer (the
+    newcomer only ever dialed one of them); single-host it is a plain
+    local drain. Gated on LGBM_TPU_ELASTIC_REJOIN=1 — the gather is a
+    real collective, so the flag must be set symmetrically."""
+    if os.environ.get("LGBM_TPU_ELASTIC_REJOIN", "") != "1":
+        return None
+    sup = _active
+    local: List[dict] = sup.drain_pending_rejoin() if sup is not None \
+        else []
+    from . import bootstrap
+    if bootstrap.is_distributed():
+        from ..io.distributed import _allgather_host_bytes
+        chunks = _allgather_host_bytes(pickle.dumps(local, protocol=4))
+        merged = [a for c in chunks for a in pickle.loads(c)]
+    else:
+        merged = local
+    if not merged:
+        return None
+    merged.sort(key=lambda a: (int(a.get("gen", 0)),
+                               str(a.get("coordinator", ""))))
+    return merged[0]
+
+
+def await_rejoin_request(timeout_s: float) -> bool:
+    """Block (poll) until a rejoin request is pending on THIS process's
+    listener, or the window closes. Does not drain — the rendezvous
+    does."""
+    deadline = time.time() + max(0.0, float(timeout_s))
+    while True:
+        sup = _active
+        if sup is not None and sup.has_pending_rejoin():
+            return True
+        if time.time() >= deadline:
+            return False
+        time.sleep(0.02)
+
+
+def poll_rejoin_window() -> Optional[dict]:
+    """Post-shrink grace window: give an already-launched replacement a
+    bounded chance (LGBM_TPU_REJOIN_WAIT_MS) to rejoin BEFORE any
+    shrunken-world iteration runs. Expanding here keeps every trained
+    iteration at the original world size — which is exactly what makes
+    kill -> rejoin parity-exact against the never-killed run. Returns
+    the agreed ack or None (continue shrunken)."""
+    if os.environ.get("LGBM_TPU_ELASTIC_REJOIN", "") != "1":
+        return None
+    wait_ms = float(os.environ.get("LGBM_TPU_REJOIN_WAIT_MS", "0") or 0)
+    if wait_ms <= 0:
+        return None
+    have = await_rejoin_request(wait_ms / 1e3)
+    from . import bootstrap
+    if not have and not bootstrap.is_distributed():
+        log.warning("no replacement dialed in within the %g ms rejoin "
+                    "window; continuing shrunken", wait_ms)
+        return None
+    # distributed survivors must ALL enter the rendezvous collective,
+    # pending or not — only one of them took the newcomer's call
+    return rendezvous_pending_rejoin()
+
+
+def expand_after_rejoin(info: dict) -> int:
+    """Existing-member half of the re-form at N+1: tear down whatever
+    backend is live (single-host after a shrink, or the N-member group
+    at a checkpoint boundary), re-bootstrap at the ack's coordinator
+    with our EXISTING rank, and re-arm supervision. The caller resumes
+    training from the last durable checkpoint (the resume broadcast is
+    the newcomer's state transfer)."""
+    global _rejoin_gen
+    from ..resilience import faults
+    from . import bootstrap
+    my_rank = bootstrap.rank()
+    new_world = int(info["world"])
+    hb_ms = float(info.get("heartbeat_ms", 0.0) or _last_hb_ms)
+    log.warning("elastic rejoin: re-forming %d -> %d (coordinator %s, "
+                "keeping rank %d)", new_world - 1, new_world,
+                info["coordinator"], my_rank)
+    stop_supervision()
+    _teardown_backend()
+    faults.set_collective_timeout_ms(0)
+    bootstrap.initialize(info["coordinator"], new_world, my_rank,
+                         supervise=True)
+    _rejoin_gen = max(_rejoin_gen, int(info.get("gen", 0))) + 1
+    telem_counters.incr("rejoins")
+    telem_events.emit("rejoin", role="member", rank=my_rank,
+                      new_world=new_world,
+                      coordinator=info["coordinator"])
+    if hb_ms > 0:
+        start_supervision(hb_ms)
+    log.warning("rejoin complete: world %d, rank %d", new_world, my_rank)
+    return new_world
+
+
+def rejoin_as_replacement(contact: str, timeout_s: float = 60.0) -> dict:
+    """Newcomer half: dial a survivor's heartbeat endpoint (retrying
+    while the survivor is still tearing down), send the length-prefixed
+    rejoin request, then bootstrap into the re-formed group at the
+    ack's coordinator/world/rank. The bootstrap blocks until the
+    existing members reach their re-form boundary (bounded by
+    LGBM_TPU_INIT_TIMEOUT_S). State arrives via the ordinary resume
+    broadcast, so the caller just enters train(resume_from=...)."""
+    host, _, port = str(contact).rpartition(":")
+    req = pickle.dumps({"host": _advertise_host(), "pid": os.getpid()},
+                       protocol=4)
+    deadline = time.time() + max(1.0, float(timeout_s))
+    while True:
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=2.0) as s:
+                s.settimeout(5.0)
+                s.sendall(_REJOIN_MAGIC + struct.pack("<I", len(req))
+                          + req)
+                ln = _recv_exact(s, 4)
+                if len(ln) < 4:
+                    raise OSError("short rejoin ack")
+                ack = pickle.loads(
+                    _recv_exact(s, struct.unpack("<I", ln)[0]))
+            break
+        except (OSError, pickle.UnpicklingError, EOFError) as exc:
+            if time.time() >= deadline:
+                raise RankFailure(
+                    (), f"rejoin contact {contact} unreachable: {exc}")
+            time.sleep(0.1)
+    if not isinstance(ack, dict) or "error" in ack:
+        raise RuntimeError(f"rejoin refused by {contact}: {ack}")
+    log.warning("rejoining as rank %d of %d via %s", ack["rank"],
+                ack["world"], ack["coordinator"])
+    from ..resilience import faults
+    from . import bootstrap
+    faults.set_collective_timeout_ms(0)
+    bootstrap.initialize(ack["coordinator"], int(ack["world"]),
+                         int(ack["rank"]), supervise=True)
+    telem_counters.incr("rejoins")
+    telem_events.emit("rejoin", role="replacement", rank=int(ack["rank"]),
+                      new_world=int(ack["world"]),
+                      coordinator=ack["coordinator"])
+    hb = float(ack.get("heartbeat_ms", 0.0))
+    if hb > 0:
+        start_supervision(hb)
+    return ack

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -33,12 +34,24 @@ def train(params: Dict[str, Any], train_set: Dataset,
     run (the same value the interrupted run was started with), and the
     checkpointed eval history re-seeds `evals_result` and the
     early-stopping state so `best_iteration` matches an uninterrupted
-    run. See docs/Reliability.md."""
+    run. See docs/Reliability.md.
+
+    ``num_boost_round=None`` (resume only) means "finish the budget the
+    checkpoint records": emergency-preempt checkpoints stamp the run's
+    original ``target_rounds`` into the manifest, so a relaunch after
+    exit code 76 needs no operator input."""
     params = copy.deepcopy(params or {})
     if fobj is not None:
         params["objective"] = "none"
-    num_boost_round = int(params.pop("num_boost_round",
-                          params.pop("num_iterations", num_boost_round)))
+    num_boost_round = params.pop("num_boost_round",
+                                 params.pop("num_iterations",
+                                            num_boost_round))
+    if num_boost_round is not None:
+        num_boost_round = int(num_boost_round)
+    elif resume_from is None:
+        raise ValueError("num_boost_round=None is only meaningful with "
+                         "resume_from (the checkpoint records the "
+                         "original target)")
     if early_stopping_rounds is None:
         early_stopping_rounds = params.pop("early_stopping_round",
                                            params.pop("early_stopping_rounds", None))
@@ -99,7 +112,6 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 
     begin_iteration = init_iteration = booster.current_iteration()
-    end_iteration = init_iteration + num_boost_round
     if resume_from is not None:
         # distributed/: rank 0 resolves + broadcasts the checkpoint
         # bytes, non-zero ranks wait at the resume barrier; collapses
@@ -107,6 +119,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
         from .distributed.checkpoint import restore_for_resume
         data = restore_for_resume(booster, resume_from)
         init_iteration = booster.current_iteration()
+        if num_boost_round is None:
+            target = (data.meta or {}).get("target_rounds")
+            if target is None:
+                raise ValueError(
+                    f"num_boost_round=None but the checkpoint at "
+                    f"{resume_from!r} does not record target_rounds; "
+                    f"pass the run's original total explicitly")
+            num_boost_round = int(target)
         # resume finishes the ORIGINAL run: num_boost_round is the total
         begin_iteration, end_iteration = 0, num_boost_round
         replayed = _replay_history(
@@ -114,58 +134,104 @@ def train(params: Dict[str, Any], train_set: Dataset,
             end_iteration, cbs)
         if replayed is not None:      # stopping point predates checkpoint
             return replayed
+    else:
+        end_iteration = init_iteration + num_boost_round
 
     from .distributed import supervisor as _supervisor
-    from .resilience import faults
+    from .resilience import faults, preempt
     sup = _supervisor.active()
     evaluation_result_list = []
+    # epoch-fenced iteration retry (opt-in LGBM_TPU_ITER_RETRY=1): a
+    # transient collective failure aborts the WHOLE iteration, which is
+    # then replayed from captured pre-iteration state, instead of the
+    # failed dispatch being retried blind (docs/Reliability.md)
+    fence_on = os.environ.get("LGBM_TPU_ITER_RETRY", "") == "1"
+
+    def _one_iteration(i):
+        """One boosting iteration: before-callbacks through
+        after-callbacks. Factored out so the epoch-fenced retry path can
+        replay it as a unit; EarlyStopException propagates to the outer
+        loop."""
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=begin_iteration,
+                end_iteration=end_iteration,
+                evaluation_result_list=None))
+        stop = booster.update(fobj=fobj)
+        results = []
+        if reduced_valid_sets or booster._gbdt.train_metrics:
+            # recorder phase OUTSIDE the iteration bracket: eval cost
+            # lands in the run totals, not in any iteration's wall
+            with telem.phase("eval"):
+                results = (booster.eval_train(feval)
+                           + booster.eval_valid(feval))
+        # per-iteration pure-delay fault site (delay_ms clause). It
+        # sits AFTER update() — whose in-program collectives are a
+        # sync point that would absorb the delay into every rank's
+        # wall — and BEFORE the aggregation gather, so a delayed
+        # rank arrives measurably late: the straggler harness's
+        # whole signal
+        faults.sleep_point("train_iter")
+        # flight recorder: metrics ride the staged iteration record;
+        # the fleet aggregator gathers per-rank summaries to rank 0
+        # on its period (a collective — same schedule on every rank)
+        telemetry.events.attach_metrics(results)
+        telemetry.aggregate.maybe_tick(i)
+        for cb in cbs_after:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=begin_iteration,
+                end_iteration=end_iteration,
+                evaluation_result_list=results))
+        return stop, results
+
     try:
         for i in range(init_iteration, end_iteration):
-            # chaos boundary (kill_rank@iter=) then liveness poll: one
-            # attribute read + one lock acquire per iteration, nothing
-            # on the device path — the float loop stays byte-identical
+            # chaos boundary (kill_rank@iter= / preempt@iter=) then
+            # liveness poll: one attribute read + one lock acquire per
+            # iteration, nothing on the device path — the float loop
+            # stays byte-identical
             faults.kill_point(i)
             if sup is not None:
                 sup.check()
-            for cb in cbs_before:
-                cb(callback_mod.CallbackEnv(
-                    model=booster, params=params, iteration=i,
-                    begin_iteration=begin_iteration,
-                    end_iteration=end_iteration,
-                    evaluation_result_list=None))
-            stop = booster.update(fobj=fobj)
-            evaluation_result_list = []
-            if reduced_valid_sets or booster._gbdt.train_metrics:
-                # recorder phase OUTSIDE the iteration bracket: eval cost
-                # lands in the run totals, not in any iteration's wall
-                with telem.phase("eval"):
-                    evaluation_result_list = (booster.eval_train(feval)
-                                              + booster.eval_valid(feval))
-            # per-iteration pure-delay fault site (delay_ms clause). It
-            # sits AFTER update() — whose in-program collectives are a
-            # sync point that would absorb the delay into every rank's
-            # wall — and BEFORE the aggregation gather, so a delayed
-            # rank arrives measurably late: the straggler harness's
-            # whole signal
-            faults.sleep_point("train_iter")
-            # flight recorder: metrics ride the staged iteration record;
-            # the fleet aggregator gathers per-rank summaries to rank 0
-            # on its period (a collective — same schedule on every rank)
-            telemetry.events.attach_metrics(evaluation_result_list)
-            telemetry.aggregate.maybe_tick(i)
+            # every collective payload this iteration carries this epoch
+            # in its frame header (io/distributed.py): a rank replaying
+            # a different iteration is caught as EpochDesyncError, not
+            # as silent state divergence
+            faults.set_epoch(i)
+            if preempt.group_requested():
+                # never returns: emergency checkpoint + SystemExit(76).
+                # The check sits at the iteration boundary so every rank
+                # checkpoints the SAME round (group_requested is a
+                # collective vote when distributed)
+                _preempt_exit(booster, cbs, i, end_iteration)
             try:
-                for cb in cbs_after:
-                    cb(callback_mod.CallbackEnv(
-                        model=booster, params=params, iteration=i,
-                        begin_iteration=begin_iteration,
-                        end_iteration=end_iteration,
-                        evaluation_result_list=evaluation_result_list))
+                if fence_on:
+                    stop, evaluation_result_list = _fenced_iteration(
+                        booster, i, _one_iteration)
+                else:
+                    stop, evaluation_result_list = _one_iteration(i)
             except callback_mod.EarlyStopException as e:
                 booster.best_iteration = e.best_iteration + 1
                 evaluation_result_list = e.best_score
                 break
             if stop:
                 break
+    except _supervisor.RejoinSignal as rj:
+        # a replacement rank knocked and every member reached the same
+        # durable checkpoint: re-form the group at world+1 and resume
+        del booster
+        return _regrow_after_rejoin(
+            rj, params, train_set, num_boost_round, cbs,
+            dict(valid_sets=valid_sets, valid_names=valid_names,
+                 fobj=fobj, feval=feval, feature_name=feature_name,
+                 categorical_feature=categorical_feature,
+                 early_stopping_rounds=early_stopping_rounds,
+                 evals_result=evals_result, verbose_eval=verbose_eval,
+                 learning_rates=learning_rates,
+                 keep_training_booster=keep_training_booster,
+                 callbacks=callbacks))
     except Exception as exc:
         # peer-death triage: only failures the supervision layer can
         # attribute to a dead rank enter recovery; everything else
@@ -189,6 +255,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
                  keep_training_booster=keep_training_booster,
                  callbacks=callbacks))
     finally:
+        # epochs are an in-training contract only; -1 = "not inside an
+        # iteration" so post-training collectives frame consistently
+        faults.set_epoch(-1)
         # the last staged iteration record (metrics attached) must land
         # in the JSONL even when a callback raises
         telemetry.events.flush()
@@ -213,6 +282,7 @@ def _recover_after_rank_failure(rf, params, train_set, num_boost_round,
     what makes the recovered run bit-identical to a fresh train resumed
     from that same checkpoint."""
     from .distributed import ingest, supervisor
+    from .resilience import faults
     on_failure = str(params.get("on_rank_failure", "raise")).lower()
     ckpt_dir = next((getattr(cb, "_ckpt_dir") for cb in cbs
                      if getattr(cb, "_ckpt_dir", None)), None)
@@ -224,12 +294,127 @@ def _recover_after_rank_failure(rf, params, train_set, num_boost_round,
         raise rf
     log.warning("recovering from %s: shrink + resume from %s", rf,
                 ckpt_dir)
+    # recovery collectives happen OUTSIDE any iteration: drop the
+    # in-training epoch stamp now (not in train()'s finally, which only
+    # runs after this whole recovery returns) so the re-form allgather
+    # frames at -1 exactly like a freshly joining replacement does
+    faults.set_epoch(-1)
     supervisor.shrink_after_failure(rf)
+    # elastic rejoin grace window (LGBM_TPU_REJOIN_WAIT_MS): give a
+    # replacement a beat to knock before committing to the shrunken
+    # world, so kill -> replace costs ONE re-form instead of two
+    info = supervisor.poll_rejoin_window()
+    if info is not None:
+        supervisor.expand_after_rejoin(info)
     inner = getattr(train_set, "_inner", train_set)
     if getattr(inner, "_reshard", None) is not None:
         train_set = ingest.reshard(train_set)
     return train(params, train_set, num_boost_round=num_boost_round,
                  resume_from=ckpt_dir, **train_kwargs)
+
+
+def _regrow_after_rejoin(rj, params, train_set, num_boost_round, cbs,
+                         train_kwargs):
+    """Re-form at world+1 after a RejoinSignal (raised by every member
+    at the same durable checkpoint) and resume from that checkpoint.
+    Mirrors _recover_after_rank_failure: expand_after_rejoin tears the
+    old group down and re-bootstraps with the replacement in, ingest is
+    re-sharded for the grown world, and the ordinary resume path makes
+    the run bit-identical to an uninterrupted N+1-rank run resumed from
+    the same checkpoint."""
+    from .distributed import ingest, supervisor
+    from .resilience import faults
+    ckpt_dir = next((getattr(cb, "_ckpt_dir") for cb in cbs
+                     if getattr(cb, "_ckpt_dir", None)), None)
+    if ckpt_dir is None:  # pragma: no cover - save() implies a manager
+        raise RuntimeError("RejoinSignal without a checkpoint callback")
+    # same epoch reset as _recover_after_rank_failure: the incoming
+    # replacement frames the re-form collectives at -1
+    faults.set_epoch(-1)
+    new_world = supervisor.expand_after_rejoin(rj.info)
+    log.warning("group re-formed at world=%d: resuming from %s",
+                new_world, ckpt_dir)
+    inner = getattr(train_set, "_inner", train_set)
+    if getattr(inner, "_reshard", None) is not None:
+        train_set = ingest.reshard(train_set)
+    return train(params, train_set, num_boost_round=num_boost_round,
+                 resume_from=ckpt_dir, **train_kwargs)
+
+
+def _preempt_exit(booster, cbs, iteration, end_iteration):
+    """Graceful-preemption exit: write an emergency checkpoint at this
+    iteration boundary and leave with the contract exit code 76
+    (resilience/preempt.py). The checkpoint stamps ``target_rounds`` so
+    ``resume=auto`` / ``num_boost_round=None`` continues to the round
+    count the ORIGINAL run was asked for. Distributed, every rank
+    reaches here at the same iteration (the preempt vote is a
+    collective), so the rank-0 write + barrier inside the manager keep
+    the group consistent. SystemExit is a BaseException: it sails past
+    the rank-failure triage handler while the telemetry flush in the
+    train() finally still runs."""
+    from .distributed.checkpoint import DistributedCheckpointManager
+    from .resilience import preempt
+    ckpt_dir = next((getattr(cb, "_ckpt_dir") for cb in cbs
+                     if getattr(cb, "_ckpt_dir", None)), None) \
+        or os.environ.get("LGBM_TPU_PREEMPT_DIR", "").strip() \
+        or "preempt.ckpt"
+    history = next((getattr(cb, "_ckpt_history") for cb in cbs
+                    if getattr(cb, "_ckpt_history", None) is not None),
+                   None)
+    path = DistributedCheckpointManager(ckpt_dir).save(
+        booster, history=history,
+        extra_meta={"target_rounds": int(end_iteration),
+                    "preempted": True,
+                    "preempt_reason": preempt.reason()})
+    telemetry.events.emit("preempt", phase="exit", iteration=int(iteration),
+                          path=path or ckpt_dir,
+                          exit_code=preempt.PREEMPT_EXIT_CODE)
+    telemetry.events.flush()
+    telemetry.bundle.maybe_capture("preempt", iteration=int(iteration),
+                                   why=preempt.reason())
+    log.warning("preempted (%s): emergency checkpoint at iteration %d -> "
+                "%s; exiting %d (resume continues to round %d)",
+                preempt.reason(), iteration, path or ckpt_dir,
+                preempt.PREEMPT_EXIT_CODE, end_iteration)
+    raise SystemExit(preempt.PREEMPT_EXIT_CODE)
+
+
+def _fenced_iteration(booster, iteration, run_one):
+    """Epoch-fenced iteration retry (LGBM_TPU_ITER_RETRY=1): capture the
+    pre-iteration rollback surface (scores + bagging RNG + tree count),
+    run the iteration under an iteration_fence — which turns
+    run_collective's internal retry OFF so a TransientCollectiveError
+    aborts the iteration — and replay the WHOLE iteration from the
+    capture. Bounded by LGBM_TPU_ITER_RETRIES (default 2) full-iteration
+    replays; exhaustion re-raises for the rank-failure triage."""
+    from .resilience import faults
+    gbdt = booster._gbdt
+    snap = gbdt.capture_state()          # materializes in-flight trees
+    ntrees = len(gbdt.models)
+    budget = int(os.environ.get("LGBM_TPU_ITER_RETRIES", 2))
+    attempt = 0
+    while True:
+        try:
+            with faults.iteration_fence():
+                return run_one(iteration)
+        except faults.TransientCollectiveError:
+            attempt += 1
+            telemetry.counters.incr("iter_retries")
+            telemetry.events.emit("iter_retry", iteration=int(iteration),
+                                  attempt=attempt)
+            if attempt > budget:
+                log.warning("iteration %d still failing after %d "
+                            "epoch-fenced replays", iteration, budget)
+                raise
+            log.warning("transient collective failure: rolling iteration "
+                        "%d back and replaying it (%d/%d)", iteration,
+                        attempt, budget)
+            # drop trees the aborted attempt appended, then restore the
+            # captured scalar/score/RNG state — together the exact
+            # pre-iteration boundary
+            del gbdt.models[ntrees:]
+            gbdt.invalidate_ensemble_cache()
+            gbdt.restore_state(snap)
 
 
 def _replay_history(booster, params, history, evals_result, es_cb,

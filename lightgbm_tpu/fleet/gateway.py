@@ -21,6 +21,12 @@ replica runs.
   the next replica in the rotation after a short backoff; replica
   *application* errors (4xx/5xx with a JSON body) pass through
   untouched — a 429 shed decision is load signal, not retry fodder.
+* **Tail-latency hedging** — with ``gateway_hedge_ms`` set, a
+  ``/predict`` still unanswered after that delay is duplicated to a
+  second replica (deterministically the next WRR pick) and the FIRST
+  answer wins; the loser is discarded. Counted as
+  ``gateway_hedged_requests`` / ``gateway_hedge_wins`` (wins = the
+  backup answered first — the straggler-shielding signal).
 * **Edge transforms** — with a `serving.transforms.EdgeTransform`
   attached (auto-discovered from the manifest stable model's
   ``.transform.json`` sidecar), ``POST /predict`` additionally accepts
@@ -34,6 +40,7 @@ replica states, manifest rev), ``GET /gateway`` (config snapshot).
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 import urllib.error
@@ -85,7 +92,8 @@ class FleetGateway:
                  manifest_path: Optional[str] = None,
                  transform=None, retries: int = 1,
                  backoff_s: float = 0.05, eject_s: float = 2.0,
-                 health_period_s: float = 0.5, timeout_s: float = 10.0):
+                 health_period_s: float = 0.5, timeout_s: float = 10.0,
+                 hedge_s: float = 0.0):
         self.manifest_path = manifest_path
         self.transform = transform
         self.retries = int(retries)
@@ -93,6 +101,7 @@ class FleetGateway:
         self.eject_s = float(eject_s)
         self.health_period_s = float(health_period_s)
         self.timeout_s = float(timeout_s)
+        self.hedge_s = float(hedge_s)
         self.manifest_rev = 0
         self._lock = threading.Lock()
         self._replicas: Dict[str, Replica] = {}
@@ -169,11 +178,39 @@ class FleetGateway:
     def predict(self, payload: dict) -> tuple:
         """Forward one predict; returns (http_status, body_dict). Only
         connect-level failures are retried (against a different
-        replica, after backoff); application errors pass through."""
+        replica, after backoff); application errors pass through. With
+        ``hedge_s > 0`` a slow answer is raced against a second
+        replica (first answer wins)."""
         telem_counters.incr("gateway_requests")
         payload = self._transform_payload(payload)
         data = json.dumps(payload).encode()
-        tried: set = set()
+        if self.hedge_s > 0:
+            return self._predict_hedged(data)
+        return self._predict_serial(data)
+
+    def _dispatch_one(self, replica: Replica, data: bytes) -> tuple:
+        """One POST to one replica. ('answer', status, body) covers
+        everything the replica actually said — 429 (shed) / 5xx are its
+        call and pass through; ('connect_error', replica, reason) means
+        the replica never answered."""
+        try:
+            req = urllib.request.Request(
+                replica.url + "/predict", data=data,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s) as resp:
+                return "answer", resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            try:
+                return "answer", exc.code, json.loads(exc.read())
+            except Exception:   # noqa: BLE001
+                return "answer", exc.code, {"error": f"http_{exc.code}"}
+        except Exception as exc:   # noqa: BLE001 — connect failure
+            return "connect_error", replica, str(exc)
+
+    def _predict_serial(self, data: bytes, tried=None) -> tuple:
+        tried = set(tried or ())
         last_error = "no replica available"
         for attempt in range(self.retries + 1):
             replica = self.pick(exclude=tried)
@@ -186,25 +223,62 @@ class FleetGateway:
             if attempt > 0:
                 telem_counters.incr("gateway_retries")
                 time.sleep(self.backoff_s * attempt)
-            try:
-                req = urllib.request.Request(
-                    replica.url + "/predict", data=data,
-                    headers={"Content-Type": "application/json"},
-                    method="POST")
-                with urllib.request.urlopen(
-                        req, timeout=self.timeout_s) as resp:
-                    return resp.status, json.loads(resp.read())
-            except urllib.error.HTTPError as exc:
-                # the replica answered: 429 (shed) / 5xx are its call
-                try:
-                    return exc.code, json.loads(exc.read())
-                except Exception:   # noqa: BLE001
-                    return exc.code, {"error": f"http_{exc.code}"}
-            except Exception as exc:   # noqa: BLE001 — connect failure
-                last_error = f"{replica.url}: {exc}"
-                tried.add(replica.url)
-                self._eject(replica, f"connect_error: {exc}")
+            kind, a, b = self._dispatch_one(replica, data)
+            if kind == "answer":
+                return a, b
+            last_error = f"{replica.url}: {b}"
+            tried.add(replica.url)
+            self._eject(replica, f"connect_error: {b}")
         return 502, {"error": f"all replicas failed ({last_error})"}
+
+    def _predict_hedged(self, data: bytes) -> tuple:
+        """Hedged dispatch: primary pick fires immediately; if no
+        answer lands within hedge_s, the NEXT deterministic pick gets a
+        duplicate and the first answer wins. Lanes always report (a
+        connect failure is a report, and ejects), so the collect loop
+        terminates without its own deadline; if every lane connect-
+        fails, fall back to the serial retry path with those replicas
+        excluded."""
+        primary = self.pick()
+        if primary is None:
+            telem_counters.incr("gateway_no_replica")
+            return 503, {"error": "no routable replica"}
+        answers: queue.Queue = queue.Queue()
+
+        def _lane(which: str, replica: Replica) -> None:
+            answers.put((which, replica, self._dispatch_one(replica,
+                                                            data)))
+
+        threading.Thread(target=_lane, args=("primary", primary),
+                         daemon=True, name="lgbm-tpu-gw-hedge0").start()
+        outstanding, hedged, tried = 1, False, set()
+        while outstanding:
+            try:
+                which, replica, res = answers.get(
+                    timeout=None if hedged else self.hedge_s)
+            except queue.Empty:
+                # the hedge fires exactly once: duplicate to the next
+                # deterministic pick (None when only one replica is
+                # routable — then just keep waiting on the primary)
+                hedged = True
+                backup = self.pick(exclude={primary.url})
+                if backup is not None:
+                    telem_counters.incr("gateway_hedged_requests")
+                    telem_events.emit("gateway_hedge", primary=primary.url,
+                                      backup=backup.url)
+                    threading.Thread(
+                        target=_lane, args=("backup", backup),
+                        daemon=True, name="lgbm-tpu-gw-hedge1").start()
+                    outstanding += 1
+                continue
+            outstanding -= 1
+            if res[0] == "answer":
+                if which == "backup":
+                    telem_counters.incr("gateway_hedge_wins")
+                return res[1], res[2]
+            tried.add(replica.url)
+            self._eject(replica, f"connect_error: {res[2]}")
+        return self._predict_serial(data, tried=tried)
 
     def _transform_payload(self, payload: dict) -> dict:
         """Edge featurization: raw CSV text / JSON rows (with nulls)
@@ -333,7 +407,11 @@ class FleetGateway:
                     "gateway_ejections":
                         telem_counters.get("gateway_ejections"),
                     "gateway_no_replica":
-                        telem_counters.get("gateway_no_replica")},
+                        telem_counters.get("gateway_no_replica"),
+                    "gateway_hedged_requests":
+                        telem_counters.get("gateway_hedged_requests"),
+                    "gateway_hedge_wins":
+                        telem_counters.get("gateway_hedge_wins")},
                 "transform": (self.transform.describe()
                               if self.transform is not None else None)}
 
@@ -342,7 +420,7 @@ class FleetGateway:
                 "retries": self.retries, "backoff_s": self.backoff_s,
                 "eject_s": self.eject_s,
                 "health_period_s": self.health_period_s,
-                "timeout_s": self.timeout_s}
+                "timeout_s": self.timeout_s, "hedge_s": self.hedge_s}
 
 
 class _GatewayHandler(BaseHTTPRequestHandler):

@@ -65,11 +65,29 @@ def new_manifest(models: Optional[Dict[str, str]] = None,
 
 def load_manifest(path: str) -> Optional[dict]:
     """None (not an error) on missing/unreadable/foreign files — a
-    follower keeps polling through a mid-write race or an empty path."""
+    follower keeps polling through a mid-write race or an empty path.
+
+    A file that READS but does not PARSE is a different animal: our own
+    writes are atomic (save_manifest), so truncated JSON means a
+    non-atomic writer or a torn copy landed in the artifact's place.
+    Still None — the follower keeps the previously applied revision,
+    which is the safe state — but counted (``manifest_torn``) and
+    evented so the fleet operator sees the corruption instead of a
+    silently frozen rollout."""
     try:
-        with open(path, encoding="utf-8") as fh:
-            m = json.load(fh)
-    except (OSError, ValueError):
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except OSError:
+        return None
+    try:
+        m = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        telem_counters.incr("manifest_torn")
+        telem_events.emit("manifest_torn", path=str(path),
+                          size_bytes=len(raw))
+        log.warning("manifest: %s is torn/unparseable (%d bytes); "
+                    "keeping the previously applied revision", path,
+                    len(raw))
         return None
     if not isinstance(m, dict) or m.get("format") != MANIFEST_FORMAT:
         return None

@@ -27,6 +27,7 @@ row partition.
 from __future__ import annotations
 
 import pickle
+import struct
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -58,6 +59,33 @@ def feature_slice(num_features: int, rank: int, num_processes: int
     return begin, begin + base + (1 if rank < rem else 0)
 
 
+_EPOCH_HEADER = struct.Struct("<q")
+
+
+def _frame_payload(payload: bytes, epoch: int) -> bytes:
+    """Prefix the iteration-epoch sequence number (resilience/faults.py
+    ``current_epoch``) so every payload crossing the lane names the
+    boosting iteration its sender was on."""
+    return _EPOCH_HEADER.pack(int(epoch)) + payload
+
+
+def _deframe_chunks(chunks: List[bytes], local_epoch: int) -> List[bytes]:
+    """Strip + verify the epoch header on every rank's chunk. A mismatch
+    means two ranks met inside a collective on DIFFERENT iterations —
+    typed ``EpochDesyncError`` with both epochs named, instead of
+    silently exchanging stale payloads."""
+    from ..resilience.faults import EpochDesyncError
+    out: List[bytes] = []
+    for rank, chunk in enumerate(chunks):
+        if len(chunk) < _EPOCH_HEADER.size:
+            raise EpochDesyncError(local_epoch, -(2 ** 62), rank)
+        remote = _EPOCH_HEADER.unpack_from(chunk)[0]
+        if remote != int(local_epoch):
+            raise EpochDesyncError(local_epoch, remote, rank)
+        out.append(chunk[_EPOCH_HEADER.size:])
+    return out
+
+
 def _allgather_host_bytes(payload: bytes) -> List[bytes]:
     """All-gather arbitrary host bytes across processes via a padded u8
     device array (the role of Network::Allgather on serialized mappers,
@@ -66,11 +94,16 @@ def _allgather_host_bytes(payload: bytes) -> List[bytes]:
     deadline (``dist_collective_timeout_ms``) and jittered retry with
     every other cross-rank lane — a dead peer surfaces as a typed
     ``CollectiveTimeout``/transport error here instead of a silent hang
-    mid-ingest."""
+    mid-ingest. Every payload carries the iteration-epoch header; ranks
+    meeting here on different boosting iterations fail typed
+    (``EpochDesyncError``) rather than mixing stale bytes."""
     from ..resilience import faults
-    return faults.run_collective(
-        lambda: _allgather_host_bytes_inner(payload),
+    epoch = faults.current_epoch()
+    framed = _frame_payload(payload, epoch)
+    chunks = faults.run_collective(
+        lambda: _allgather_host_bytes_inner(framed),
         site="allgather_bytes")
+    return _deframe_chunks(chunks, epoch)
 
 
 def _allgather_host_bytes_inner(payload: bytes) -> List[bytes]:

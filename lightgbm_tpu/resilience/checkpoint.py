@@ -162,11 +162,18 @@ class CheckpointData:
         return int(self.meta.get("iteration", 0))
 
 
-def capture(booster, history: Optional[list] = None
+def capture(booster, history: Optional[list] = None,
+            extra_meta: Optional[Dict[str, Any]] = None
             ) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
     """-> (meta, arrays) ready for write_checkpoint_file. Accessing the
     model list first materializes any in-flight fused iteration, so the
-    capture is always at a consistent iteration boundary."""
+    capture is always at a consistent iteration boundary.
+
+    ``extra_meta`` merges caller context into the manifest — e.g. the
+    run's original round budget (``target_rounds``) so a resume after
+    preemption finishes the right count, or ``preempted=True`` marking
+    an emergency checkpoint. Reserved keys (format/version/iteration/
+    checksums) cannot be overridden."""
     gbdt = _gbdt_of(booster)
     st = gbdt.capture_state()
     model_text = gbdt.save_model_to_string(0, -1)
@@ -208,7 +215,8 @@ def capture(booster, history: Optional[list] = None
         version = VERSION
     arrays["state_json"] = np.array(json.dumps(state_json))
     arrays["history_json"] = np.array(json.dumps(history or []))
-    meta = {
+    meta = dict(extra_meta or {})
+    meta.update({
         "format": FORMAT,
         "version": version,
         "min_reader_version": version,
@@ -216,13 +224,13 @@ def capture(booster, history: Optional[list] = None
         "num_class": int(gbdt.num_class),
         "num_trees": len(gbdt.models),
         "params_sha256": _params_hash(gbdt),
-    }
+    })
     return meta, arrays
 
 
-def save_checkpoint(path: str, booster, history: Optional[list] = None
-                    ) -> str:
-    meta, arrays = capture(booster, history)
+def save_checkpoint(path: str, booster, history: Optional[list] = None,
+                    extra_meta: Optional[Dict[str, Any]] = None) -> str:
+    meta, arrays = capture(booster, history, extra_meta=extra_meta)
     write_checkpoint_file(path, meta, arrays)
     return path
 
@@ -340,8 +348,10 @@ class CheckpointManager:
         out.sort()
         return out
 
-    def save(self, booster, history: Optional[list] = None) -> str:
-        return self.save_captured(*capture(booster, history))
+    def save(self, booster, history: Optional[list] = None,
+             extra_meta: Optional[Dict[str, Any]] = None) -> str:
+        return self.save_captured(*capture(booster, history,
+                                           extra_meta=extra_meta))
 
     def save_captured(self, meta: Dict[str, Any],
                       arrays: Dict[str, np.ndarray]) -> str:

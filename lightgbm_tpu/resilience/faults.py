@@ -30,6 +30,11 @@ Fault spec grammar (env ``LGBM_TPU_FAULT_SPEC`` or ``faults.install``):
                                     verb behind the two-process kill
                                     harness (install the spec only in
                                     the victim rank's environment)
+    preempt@iter=3                  arm the graceful-preemption flag
+                                    (resilience/preempt.py) at boosting
+                                    iteration 3 — deterministic stand-in
+                                    for a SIGTERM eviction notice: the
+                                    loop checkpoints and exits 76
     fail_request@version=v2,n=5     fail the first 5 serving batches
                                     answered by model version v2 (omit
                                     version= to hit all versions; p=
@@ -61,19 +66,39 @@ from ..telemetry import events as telem_events
 from ..telemetry import recorder as telem
 from ..utils import log
 
-__all__ = ["TransientCollectiveError", "CollectiveTimeout", "FaultPlan",
+__all__ = ["TransientCollectiveError", "CollectiveTimeout",
+           "EpochDesyncError", "FaultPlan",
            "install", "clear", "active_plan", "run_collective",
            "sleep_point", "kill_point", "request_point", "jittered_delay",
-           "set_collective_timeout_ms", "collective_timeout_ms"]
+           "set_collective_timeout_ms", "collective_timeout_ms",
+           "set_epoch", "current_epoch", "iteration_fence", "fence_active"]
 
 _GLOBAL_KNOBS = ("seed", "delay_ms")
 _KNOWN = ("nan_grad", "inf_grad", "fail_collective", "kill_rank",
-          "fail_request")
+          "fail_request", "preempt")
 
 
 class TransientCollectiveError(RuntimeError):
     """A collective failed in a way worth retrying (injected here; the
     real-world analogs are preempted hosts and dropped DCN links)."""
+
+
+class EpochDesyncError(RuntimeError):
+    """Two ranks met inside a collective while on DIFFERENT boosting
+    iterations. Exchanging payloads across an epoch skew silently mixes
+    stale histograms into a fresh iteration — this typed error (both
+    epochs named) is raised by the wire framing in io/distributed.py
+    instead. Not transient: a desync means the retry/rollback choreo-
+    graphy itself diverged, so blind retry would re-fail identically."""
+
+    def __init__(self, local_epoch: int, remote_epoch: int, rank: int):
+        self.local_epoch = int(local_epoch)
+        self.remote_epoch = int(remote_epoch)
+        self.rank = int(rank)
+        super().__init__(
+            f"collective epoch desync: local iteration epoch "
+            f"{self.local_epoch} but rank {self.rank} sent epoch "
+            f"{self.remote_epoch}")
 
 
 class CollectiveTimeout(RuntimeError):
@@ -263,6 +288,20 @@ class FaultPlan:
             return int(c.args.get("code", 137))
         return None
 
+    def preempt_at(self, iteration: int) -> bool:
+        """True when a ``preempt@iter=`` clause fires at this boosting
+        iteration (one-shot). Pure decision logic; arming the actual
+        flag (resilience/preempt.py) happens in `kill_point`."""
+        for c in self.clauses:
+            if c.name != "preempt" or c.fired:
+                continue
+            if "iter" not in c.args or iteration != int(c.args["iter"]):
+                continue
+            c.fired = True
+            self.events.append(f"preempt@iter={iteration}")
+            return True
+        return False
+
 
 # -- global plan -------------------------------------------------------
 _plan: Optional[FaultPlan] = None
@@ -320,6 +359,12 @@ def kill_point(iteration: int) -> None:
     plan = active_plan()
     if plan is None:
         return
+    if plan.preempt_at(iteration):
+        # deterministic eviction notice: same flag, same downstream
+        # path (checkpoint + exit 76) as a real SIGTERM
+        from . import preempt
+        telem_events.emit("fault", fault="preempt", iteration=iteration)
+        preempt.arm(f"fault:preempt@iter={iteration}")
     code = plan.kill_code(iteration)
     if code is not None:
         telem_events.emit("fault", fault="kill_rank", iteration=iteration,
@@ -337,6 +382,52 @@ def kill_point(iteration: int) -> None:
 def _retry_budget():
     return (int(os.environ.get("LGBM_TPU_COLLECTIVE_RETRIES", 3)),
             float(os.environ.get("LGBM_TPU_RETRY_BASE_MS", 10.0)) / 1e3)
+
+
+# -- iteration epoch + fence --------------------------------------------
+# The boosting loop stamps the current iteration here; the wire framing
+# (io/distributed.py _allgather_host_bytes) carries it in every payload
+# header so ranks meeting inside a collective can verify they are on the
+# SAME iteration (EpochDesyncError otherwise). -1 = outside any loop
+# (bootstrap, ingest, resume) — still exchanged and still compared:
+# lockstep ranks agree on -1 exactly like they agree on an iteration.
+_epoch = -1
+_fence_depth = 0
+
+
+def set_epoch(n: int) -> None:
+    """Stamp the iteration-epoch sequence number (engine/cli loops)."""
+    global _epoch
+    _epoch = int(n)
+
+
+def current_epoch() -> int:
+    return _epoch
+
+
+class iteration_fence:
+    """Context manager marking "this code runs inside one boosting
+    iteration whose caller can retry the WHOLE iteration from captured
+    pre-iteration state". While active, ``run_collective`` re-raises
+    TransientCollectiveError immediately instead of retrying the single
+    dispatch blind — a mid-iteration transient leaves partially-applied
+    per-dispatch state (histogram shards on some ranks, not others), so
+    the iteration-level rollback (scores + RNG, PR 4) is the only retry
+    that is actually consistent."""
+
+    def __enter__(self):
+        global _fence_depth
+        _fence_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _fence_depth
+        _fence_depth -= 1
+        return False
+
+
+def fence_active() -> bool:
+    return _fence_depth > 0
 
 
 def jittered_delay(delay_s: float, rng) -> float:
@@ -440,6 +531,14 @@ def run_collective(fn, site: str = "collective",
                     return _call_with_deadline(fn, site, deadline_ms)
                 return fn()
         except TransientCollectiveError as exc:
+            if _fence_depth > 0:
+                # epoch-fenced mode: the engine retries the iteration
+                # from its captured pre-iteration state; retrying the
+                # single dispatch here would race that rollback
+                log.warning("transient failure at %s under an iteration "
+                            "fence: aborting the iteration for "
+                            "epoch-level retry (%s)", site, exc)
+                raise
             attempt += 1
             telem_counters.incr("collective_retries")
             if attempt > budget:

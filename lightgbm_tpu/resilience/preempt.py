@@ -1,0 +1,153 @@
+"""Graceful preemption: SIGTERM/SIGINT -> checkpoint -> exit 76.
+
+Cloud TPU fleets evict hosts with a SIGTERM and a short grace window.
+The stock outcome is the worst one: training dies mid-iteration and the
+run restarts from whatever the last *periodic* checkpoint captured.
+This module turns the notice into a clean, resumable exit:
+
+* ``install_handlers`` arms SIGTERM/SIGINT to set a process-wide flag —
+  nothing else happens in signal context (the handler is async-signal
+  constrained; all real work runs at the next iteration boundary).
+* The training loops (engine.train, cli._train) poll the flag at the
+  same per-iteration site as ``faults.kill_point``/``sup.check``. When
+  set, they write an *emergency checkpoint* through the ordinary
+  rank-0 ``DistributedCheckpointManager`` path (atomic file + checksum
+  + barrier) and exit with ``PREEMPT_EXIT_CODE`` (76) — a documented,
+  launcher-visible contract: 76 means "checkpointed cleanly, relaunch
+  with resume=auto" (docs/Reliability.md).
+* Distributed, the flag is propagated over the existing
+  ``_allgather_host_bytes`` lane (one byte per rank per iteration) so
+  every rank checkpoints at the SAME iteration boundary even when only
+  one host received the eviction notice. The vote is strictly opt-in
+  (handlers installed, or ``LGBM_TPU_PREEMPT_SYNC=1``) and must be
+  armed symmetrically on every rank — it is itself a collective.
+* The fault verb ``preempt@iter=N`` (resilience/faults.py) arms the
+  flag deterministically for tests, through the same code path a real
+  SIGTERM takes.
+
+The emergency checkpoint records the run's original round target
+(``target_rounds`` in the manifest) so ``resume=auto`` finishes the
+right budget without the operator restating it.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+from ..telemetry import counters as telem_counters
+from ..telemetry import events as telem_events
+from ..utils import log
+
+__all__ = ["PREEMPT_EXIT_CODE", "install_handlers", "arm", "requested",
+           "reason", "clear", "sync_enabled", "group_requested"]
+
+# exit-code contract (documented in docs/Reliability.md): the process
+# wrote a durable emergency checkpoint and can be resumed bit-identically
+# with resume=auto. Chosen clear of the shell (126/127/128+n) and
+# sysexits ranges actually emitted by this stack.
+PREEMPT_EXIT_CODE = 76
+
+_requested = threading.Event()
+_installed = False
+_reason = ""
+
+
+def _on_signal(signum, frame) -> None:   # pragma: no cover - signal ctx
+    # async-signal context: set the flag, nothing else. The iteration
+    # boundary does the checkpointing with a full Python stack.
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:
+        name = str(signum)
+    arm(f"signal:{name}")
+
+
+def install_handlers() -> bool:
+    """Arm SIGTERM/SIGINT to request a graceful preemption. Idempotent;
+    returns False (and stays un-armed) off the main thread, where
+    CPython refuses signal.signal. ``LGBM_TPU_NO_SIGNAL_HANDLERS=1``
+    disables installation entirely: a harness that owns the process's
+    signal disposition (pytest under a watchdog timeout, notebook
+    kernels) must keep it — a swallowed harness SIGTERM would otherwise
+    arm the flag and turn every later train() in the process into an
+    exit-76."""
+    global _installed
+    if os.environ.get("LGBM_TPU_NO_SIGNAL_HANDLERS", "") == "1":
+        return False
+    if _installed:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+    except ValueError:   # pragma: no cover - non-main interpreter thread
+        return False
+    _installed = True
+    return True
+
+
+def arm(why: str = "requested") -> None:
+    """Set the preemption flag (signal handler, fault verb, or tests).
+    First arm wins; re-arming is a no-op."""
+    global _reason
+    if _requested.is_set():
+        return
+    _reason = str(why)
+    _requested.set()
+    telem_counters.incr("preempts")
+    telem_events.emit("preempt", phase="armed", reason=_reason)
+    log.warning("preemption requested (%s): will checkpoint and exit %d "
+                "at the next iteration boundary", _reason,
+                PREEMPT_EXIT_CODE)
+
+
+def requested() -> bool:
+    """Local flag only — no collective. One Event read."""
+    return _requested.is_set()
+
+
+def reason() -> str:
+    return _reason
+
+
+def clear() -> None:
+    """Reset the flag (tests; a resumed process starts clean anyway)."""
+    global _reason
+    _requested.clear()
+    _reason = ""
+
+
+def sync_enabled() -> bool:
+    """Whether the per-iteration distributed preempt vote is armed.
+    True when this process installed signal handlers or when
+    ``LGBM_TPU_PREEMPT_SYNC=1``. The vote is a collective: every rank
+    must answer it on every iteration, so whichever arming is used must
+    be applied on ALL ranks (cli._train installs handlers on every
+    rank; harnesses set the env var on every rank)."""
+    return _installed or os.environ.get("LGBM_TPU_PREEMPT_SYNC", "") == "1"
+
+
+def group_requested() -> bool:
+    """True when ANY rank has the preemption flag set.
+
+    Single-process (or with the vote un-armed) this is the local flag —
+    zero overhead. Distributed with the vote armed, each rank
+    contributes one byte over the ``_allgather_host_bytes`` lane so all
+    ranks agree on the SAME iteration boundary to checkpoint at; the
+    payload rides the iteration-epoch header like every other lane
+    user, so a desynced rank fails typed instead of checkpointing a
+    mixed iteration."""
+    local = _requested.is_set()
+    if not sync_enabled():
+        return local
+    from ..distributed import bootstrap
+    if not bootstrap.is_distributed():
+        return local
+    from ..io.distributed import _allgather_host_bytes
+    votes = _allgather_host_bytes(b"\x01" if local else b"\x00")
+    hit = any(v[:1] == b"\x01" for v in votes)
+    if hit and not local:
+        arm("peer")
+    return hit

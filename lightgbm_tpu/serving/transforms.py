@@ -46,7 +46,12 @@ _MISSING_TOKENS = {"", "na", "nan", "null", "none", "?"}
 def capture_transform(dataset) -> dict:
     """Record the fitted bin mappers of a constructed Dataset, keyed by
     raw feature column. Unused/trivial columns carry no mapper — the
-    transform passes them through untouched (no tree can test them)."""
+    transform passes them through untouched (no tree can test them).
+    Accepts either the inner io.dataset.Dataset or the public
+    basic.Dataset wrapper (the CLI holds the wrapper; its mappers live
+    on the constructed ``_inner``)."""
+    if hasattr(dataset, "construct"):
+        dataset = dataset.construct()._inner
     mappers: Dict[str, dict] = {}
     for f in getattr(dataset, "used_features", []):
         mappers[str(int(f))] = dataset.bin_mappers[f].to_dict()

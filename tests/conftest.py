@@ -22,6 +22,14 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["LGBM_TPU_NO_COMP_CACHE"] = "1"
 os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
+# The suite runs under a watchdog timeout that ends it with SIGTERM.
+# In-process CLI tests would otherwise install the graceful-preemption
+# handlers (resilience/preempt.py) into the PYTEST process — the
+# watchdog's SIGTERM would then be swallowed, arm the preempt flag, and
+# turn every subsequent training test into an exit-76 cascade. Tests
+# that exercise the handlers delete this var via monkeypatch.
+os.environ["LGBM_TPU_NO_SIGNAL_HANDLERS"] = "1"
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

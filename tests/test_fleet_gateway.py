@@ -319,3 +319,129 @@ def test_serve_storm_two_replica_smoke(trained):
     sf = point["shed_fraction"]
     assert point["shed"]["shadow"] > 0
     assert sf["shadow"] >= sf["versioned"] >= sf["pinned"]
+
+
+# ---------------------------------------------------------------------------
+# hedging: tail-latency duplicate to the next deterministic pick
+# ---------------------------------------------------------------------------
+
+def _stalled_listener():
+    """A TCP endpoint that accepts connections and never answers — the
+    shape of a replica wedged in a GC/compile pause (connect succeeds,
+    the response never comes)."""
+    import socket
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    held = []
+
+    def run():
+        while True:
+            try:
+                conn, _ = srv.accept()
+                held.append(conn)           # hold open, never respond
+            except OSError:
+                return
+
+    threading.Thread(target=run, daemon=True).start()
+    return srv, held, "http://127.0.0.1:%d" % srv.getsockname()[1]
+
+
+def test_gateway_hedges_past_stalled_replica(trained):
+    """With gateway_hedge_ms armed, a stalled primary does not cost the
+    client the full request timeout: the hedge fires at hedge_s, the
+    duplicate goes to the NEXT deterministic pick, and the first answer
+    wins — counted as a hedged request and a hedge win."""
+    from lightgbm_tpu.telemetry import counters as telem_counters
+    bst, _, x = trained
+    reg = ModelRegistry()
+    reg.load(bst, version="v1")
+    app = ServingApp(reg, max_batch=16, max_delay_ms=2.0)
+    httpd, live = _serve(app)
+    srv, held, stalled = _stalled_listener()
+    try:
+        # weight 9 vs 1: the first smooth-WRR pick is the stalled one
+        gw = FleetGateway(replicas=[{"url": stalled, "weight": 9.0},
+                                    {"url": live, "weight": 1.0}],
+                          hedge_s=0.08, timeout_s=5.0)
+        hedged0 = telem_counters.get("gateway_hedged_requests")
+        wins0 = telem_counters.get("gateway_hedge_wins")
+        t0 = time.monotonic()
+        code, body = gw.predict({"rows": x[:2].tolist()})
+        elapsed = time.monotonic() - t0
+        assert code == 200 and len(body["predictions"]) == 2
+        assert elapsed < 4.0            # answered well inside timeout_s
+        assert telem_counters.get("gateway_hedged_requests") == hedged0 + 1
+        assert telem_counters.get("gateway_hedge_wins") == wins0 + 1
+        # the surface a dashboard scrapes reports the same story
+        assert gw.stats()["counters"]["gateway_hedge_wins"] >= wins0 + 1
+        assert gw.config()["hedge_s"] == 0.08
+    finally:
+        srv.close()
+        for c in held:
+            c.close()
+        httpd.shutdown()
+        httpd.server_close()
+        app.close()
+
+
+def test_gateway_hedge_idle_when_primary_is_fast(trained):
+    """A fast primary never triggers the hedge — no duplicate load on
+    the fleet, counters untouched."""
+    from lightgbm_tpu.telemetry import counters as telem_counters
+    bst, _, x = trained
+    reg = ModelRegistry()
+    reg.load(bst, version="v1")
+    app = ServingApp(reg, max_batch=16, max_delay_ms=2.0)
+    httpd, live = _serve(app)
+    try:
+        gw = FleetGateway(replicas=[live], hedge_s=5.0)
+        hedged0 = telem_counters.get("gateway_hedged_requests")
+        code, body = gw.predict({"rows": x[:2].tolist()})
+        assert code == 200 and len(body["predictions"]) == 2
+        assert telem_counters.get("gateway_hedged_requests") == hedged0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# manifest: torn reads never tear the fleet
+# ---------------------------------------------------------------------------
+
+def test_manifest_torn_read_keeps_previous_revision(trained, tmp_path):
+    """Regression: a truncated manifest (reader raced a non-atomic
+    writer, or the publisher crashed mid-write) must not throw the
+    follower or blank its replica set — the previously applied revision
+    stays live and the torn read is counted."""
+    from lightgbm_tpu.telemetry import counters as telem_counters
+    bst, _, _ = trained
+    v1 = str(tmp_path / "v1.txt")
+    bst.save_model(v1)
+    mpath = str(tmp_path / "manifest.json")
+    app = ServingApp(ModelRegistry(), max_batch=16, start=False)
+    follower = ManifestFollower(app, mpath, poll_s=0.1)
+    ManifestPublisher(mpath).seed({"v1": v1}, stable="v1")
+    assert follower.poll_once() is True
+    assert app.registry.latest == "v1"
+
+    with open(mpath, "rb") as f:
+        full = f.read()
+    with open(mpath, "wb") as f:
+        f.write(full[: len(full) // 2])         # torn: half a JSON doc
+    torn0 = telem_counters.get("manifest_torn")
+    assert follower.poll_once() is False        # no-op, no exception
+    assert app.registry.latest == "v1"          # previous rev kept
+    assert telem_counters.get("manifest_torn") == torn0 + 1
+    # the gateway's manifest adoption path rides the same loader (the
+    # ctor's initial adoption attempt counts a torn read of its own)
+    gw = FleetGateway(manifest_path=mpath)
+    assert gw.refresh_manifest() is False
+    assert telem_counters.get("manifest_torn") == torn0 + 3
+
+    with open(mpath, "wb") as f:                # writer finishes later
+        f.write(full)
+    assert follower.poll_once() is False        # same rev: converged
+    assert gw.refresh_manifest() is True
+    app.close()

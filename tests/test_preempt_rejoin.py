@@ -1,0 +1,337 @@
+"""Preemption-safe training: graceful SIGTERM -> checkpoint -> exit 76,
+the iteration-epoch collective fence, epoch-fenced whole-iteration
+retry, coordinator-death regroup derivation, rejoin-ack contract, and
+the chaos soak acceptance gate (tools/chaos_soak.py).
+
+Fast tests pin every host-side piece in-process; the preempt
+acceptance (preempt@iter=3 -> exit 76 -> resume=auto finishes the
+original round budget bit-identically) runs the victim as a real
+subprocess so SystemExit(76) is observed as a process exit code, the
+way a launcher sees it. The full soak is slow+chaos-tagged.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_binary
+from lightgbm_tpu import engine
+from lightgbm_tpu.distributed import supervisor as sv
+from lightgbm_tpu.distributed.checkpoint import DistributedCheckpointManager
+from lightgbm_tpu.io.distributed import _frame_payload, _deframe_chunks
+from lightgbm_tpu.resilience import faults, preempt
+from lightgbm_tpu.telemetry import counters as telem_counters
+
+BASE = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    preempt.clear()
+    yield
+    faults.clear()
+    preempt.clear()
+
+
+def _model_str(bst):
+    return bst._gbdt.save_model_to_string(0, -1)
+
+
+# ---------------------------------------------------------------------------
+# fast: the preempt fault verb + flag lifecycle
+# ---------------------------------------------------------------------------
+
+def test_preempt_verb_parses_and_fires_once():
+    plan = faults.FaultPlan("preempt@iter=3")
+    assert not plan.preempt_at(0)
+    assert plan.preempt_at(3)
+    assert not plan.preempt_at(3)               # fires exactly once
+    assert "preempt@iter=3" in plan.events
+
+
+def test_kill_point_arms_preempt_flag():
+    """preempt@iter= goes through kill_point — the same per-iteration
+    boundary a real SIGTERM is polled at."""
+    faults.install("preempt@iter=2")
+    faults.kill_point(0)
+    faults.kill_point(1)
+    assert not preempt.requested()
+    faults.kill_point(2)
+    assert preempt.requested()
+    assert "preempt@iter=2" in preempt.reason()
+
+
+def test_arm_first_wins_and_clear_resets():
+    preempt.arm("eviction-notice")
+    preempt.arm("second-notice")                # re-arm is a no-op
+    assert preempt.requested()
+    assert preempt.reason() == "eviction-notice"
+    preempt.clear()
+    assert not preempt.requested()
+    assert preempt.reason() == ""
+
+
+def test_group_requested_is_local_when_single_process(monkeypatch):
+    """No collective machinery single-process: group view == local flag,
+    with or without the vote armed via env."""
+    assert preempt.group_requested() is False
+    monkeypatch.setenv("LGBM_TPU_PREEMPT_SYNC", "1")
+    assert preempt.sync_enabled()
+    assert preempt.group_requested() is False
+    preempt.arm("test")
+    assert preempt.group_requested() is True
+
+
+def test_sigterm_handler_arms_flag(monkeypatch):
+    """install_handlers + a real SIGTERM set the flag without doing any
+    work in signal context."""
+    monkeypatch.delenv("LGBM_TPU_NO_SIGNAL_HANDLERS", raising=False)
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    try:
+        assert preempt.install_handlers()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert preempt.requested()
+        assert preempt.reason() == "signal:SIGTERM"
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+        preempt._installed = False
+
+
+# ---------------------------------------------------------------------------
+# fast: iteration-epoch frame header on the host-bytes lane
+# ---------------------------------------------------------------------------
+
+def test_epoch_frame_roundtrip():
+    chunks = [_frame_payload(b"rank0", 7), _frame_payload(b"rank1", 7)]
+    assert _deframe_chunks(chunks, 7) == [b"rank0", b"rank1"]
+    # empty payloads still carry (and shed) the header
+    assert _deframe_chunks([_frame_payload(b"", -1)], -1) == [b""]
+
+
+def test_epoch_mismatch_raises_typed_desync():
+    chunks = [_frame_payload(b"a", 5), _frame_payload(b"b", 6)]
+    with pytest.raises(faults.EpochDesyncError) as ei:
+        _deframe_chunks(chunks, 5)
+    assert "5" in str(ei.value) and "6" in str(ei.value)
+
+
+def test_truncated_chunk_raises_typed_desync():
+    with pytest.raises(faults.EpochDesyncError):
+        _deframe_chunks([b"\x01"], 0)           # shorter than the header
+
+
+def test_fence_disables_in_dispatch_retry():
+    """Inside an iteration fence a transient collective failure aborts
+    the dispatch (typed) instead of being retried blind; outside the
+    fence the pre-existing in-dispatch retry behavior is untouched."""
+    faults.install("fail_collective@n=1", seed=0)
+    assert not faults.fence_active()
+    with faults.iteration_fence():
+        assert faults.fence_active()
+        with pytest.raises(faults.TransientCollectiveError):
+            faults.run_collective(lambda: "ok", site="unit")
+    assert not faults.fence_active()
+    # the one-shot clause already fired: clean dispatch afterwards
+    assert faults.run_collective(lambda: "ok", site="unit") == "ok"
+
+
+def test_engine_iter_retry_replays_iteration_bit_identical(monkeypatch):
+    """LGBM_TPU_ITER_RETRY=1 end to end: the host data-parallel
+    learner's histogram allreduce fails transiently inside the fence,
+    the whole iteration is rolled back and replayed, and the final
+    model is bit-identical to an unfaulted run."""
+    monkeypatch.setenv("LGBM_TPU_HOST_LEARNER", "1")
+    monkeypatch.setenv("LGBM_TPU_ITER_RETRY", "1")
+    x, y = make_binary(n=512, f=8)
+    params = dict(BASE, tree_learner="data", num_leaves=5)
+
+    clean = engine.train(params, lgb.Dataset(x, y, free_raw_data=False),
+                         num_boost_round=3, verbose_eval=False)
+    before = telem_counters.get("iter_retries")
+    faults.install("fail_collective@n=1", seed=3)
+    bst = engine.train(params, lgb.Dataset(x, y, free_raw_data=False),
+                       num_boost_round=3, verbose_eval=False)
+    assert any(e.startswith("fail_collective")
+               for e in faults.active_plan().events)
+    assert telem_counters.get("iter_retries") == before + 1
+    assert bst.num_trees() == 3
+    assert _model_str(bst) == _model_str(clean)
+
+
+# ---------------------------------------------------------------------------
+# fast: coordinator-death regroup + checkpoint-write duty transfer
+# ---------------------------------------------------------------------------
+
+def test_derive_regroup_coordinator_death_hands_duty_down():
+    """Rank 0 dies in a 3-rank group: the lowest survivor (old rank 1)
+    becomes rank 0 AND the new coordinator host — checkpoint-write duty
+    moves with the rank."""
+    survivors, new_rank, new_coord = sv.derive_regroup(
+        world=3, dead=[0], old_rank=1, old_coord="10.0.0.1:9000",
+        peer_hosts={0: ("10.0.0.1", 9100), 2: ("10.0.0.3", 9102)},
+        my_host="10.0.0.2")
+    assert (survivors, new_rank) == (2, 0)
+    assert new_coord == "10.0.0.2:9001"         # old port + 1 dead rank
+    # the other survivor derives the SAME group from its own seat
+    survivors, new_rank, new_coord = sv.derive_regroup(
+        world=3, dead=[0], old_rank=2, old_coord="10.0.0.1:9000",
+        peer_hosts={0: ("10.0.0.1", 9100), 1: ("10.0.0.2", 9101)},
+        my_host="10.0.0.3")
+    assert (survivors, new_rank) == (2, 1)
+    assert new_coord == "10.0.0.2:9001"
+
+
+def test_derive_regroup_single_survivor_degrades_clean():
+    assert sv.derive_regroup(2, [0], 1, "10.0.0.1:9000", {},
+                             "10.0.0.2") == (1, 0, "")
+
+
+def test_checkpoint_writer_follows_current_rank(tmp_path, monkeypatch):
+    """DistributedCheckpointManager re-derives write duty from the
+    CURRENT rank at each save: after a shrink renumbers survivors, the
+    new rank 0 starts writing and a demoted writer stops."""
+    from lightgbm_tpu.distributed import checkpoint as dckpt
+    mgr = DistributedCheckpointManager(str(tmp_path))
+    assert mgr._writer_rank == 0
+    assert mgr._current_writer() is not None    # rank 0 owns the file
+    monkeypatch.setattr(dckpt.bootstrap, "rank", lambda: 1)
+    assert mgr._current_writer() is None        # duty moved away
+    assert mgr._writer_rank == 1
+    monkeypatch.setattr(dckpt.bootstrap, "rank", lambda: 0)
+    assert mgr._current_writer() is not None    # promoted back: writes
+    assert mgr._writer_rank == 0
+
+
+# ---------------------------------------------------------------------------
+# fast: rejoin-ack contract
+# ---------------------------------------------------------------------------
+
+def test_build_rejoin_ack_contract(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_REJOIN_PORT", "18700")
+    ack = sv._build_rejoin_ack({"host": "10.9.9.9"}, heartbeat_ms=250.0)
+    # newcomer takes rank = old world; members keep their ranks
+    assert ack["world"] == 2 and ack["rank"] == 1
+    host, port = ack["coordinator"].rsplit(":", 1)
+    assert int(port) == 18700 + 1 + sv._rejoin_gen
+    assert ack["heartbeat_ms"] == 250.0
+    assert ack["peer_host"] == "10.9.9.9"
+
+
+def test_build_rejoin_ack_requires_fixed_port(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_REJOIN_PORT", raising=False)
+    with pytest.raises(RuntimeError):
+        sv._build_rejoin_ack({}, 250.0)
+
+
+def test_rendezvous_is_gated_and_drains_nothing_by_default(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_ELASTIC_REJOIN", raising=False)
+    assert sv.rendezvous_pending_rejoin() is None
+    monkeypatch.setenv("LGBM_TPU_ELASTIC_REJOIN", "1")
+    assert sv.rendezvous_pending_rejoin() is None   # no listener, no acks
+
+
+# ---------------------------------------------------------------------------
+# acceptance (tier-1): preempt@iter -> exit 76 -> resume=auto parity
+# ---------------------------------------------------------------------------
+
+def test_preempt_exit_76_then_resume_finishes_target_rounds(tmp_path):
+    """The whole graceful-preemption contract on one process: a victim
+    run armed with preempt@iter=3 writes an emergency checkpoint and
+    exits 76 (launcher-visible); resume=auto with NO restated round
+    budget reads target_rounds from the manifest and finishes the run
+    bit-identical to an uninterrupted one."""
+    ckpt = str(tmp_path / "preempt.ckpt")
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['LGBM_TPU_NO_COMP_CACHE'] = '1'\n"
+        "os.environ['LGBM_TPU_FAULT_SPEC'] = 'preempt@iter=3'\n"
+        f"os.environ['LGBM_TPU_PREEMPT_DIR'] = {ckpt!r}\n"
+        "import numpy as np\n"
+        "import lightgbm_tpu as lgb\n"
+        "r = np.random.RandomState(11)\n"
+        "x = r.randn(300, 6)\n"
+        "logit = x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]\n"
+        "y = (logit + r.randn(300) * 0.5 > 0).astype(np.float64)\n"
+        "lgb.train({'objective': 'binary', 'num_leaves': 7,\n"
+        "           'verbosity': -1},\n"
+        "          lgb.Dataset(x, y, free_raw_data=False),\n"
+        "          num_boost_round=6, verbose_eval=False)\n"
+        "raise SystemExit(99)   # unreachable: preempt exits first\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    p = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == preempt.PREEMPT_EXIT_CODE, p.stderr[-3000:]
+
+    # the emergency checkpoint is durable and names the original budget
+    data = DistributedCheckpointManager(ckpt).latest()
+    assert data is not None
+    assert data.iteration == 3
+    assert data.meta["target_rounds"] == 6
+    assert data.meta["preempted"] is True
+    assert "preempt@iter=3" in data.meta["preempt_reason"]
+
+    x, y = make_binary(n=300, f=6, seed=11)
+    resumed = lgb.train(dict(BASE), lgb.Dataset(x, y, free_raw_data=False),
+                        num_boost_round=None, verbose_eval=False,
+                        resume_from=ckpt)
+    clean = lgb.train(dict(BASE), lgb.Dataset(x, y, free_raw_data=False),
+                      num_boost_round=6, verbose_eval=False)
+    assert resumed.num_trees() == 6             # budget honored, not 6+3
+    assert _model_str(resumed) == _model_str(clean)
+
+
+def test_resume_without_target_rounds_is_a_typed_error(tmp_path):
+    """num_boost_round=None is only meaningful against a checkpoint
+    that recorded the budget — and meaningless without resume_from."""
+    with pytest.raises(ValueError, match="num_boost_round=None"):
+        x, y = make_binary(n=100, f=4)
+        lgb.train(dict(BASE), lgb.Dataset(x, y), num_boost_round=None,
+                  verbose_eval=False)
+
+
+# ---------------------------------------------------------------------------
+# slow: the deterministic chaos soak gate (tools/chaos_soak.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_soak_all_episodes_hold_invariants():
+    """Acceptance: the seeded soak schedule (preempt, iter_retry,
+    rejoin, serve episodes) runs end to end, every invariant holds, and
+    the one-line JSON report says so."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_soak.py"),
+         "--seed", "1"],
+        env=env, capture_output=True, text=True, timeout=580)
+    assert p.returncode == 0, (p.stdout + "\n" + p.stderr)[-4000:]
+    line = [ln for ln in p.stdout.splitlines()
+            if ln.startswith("{") and '"chaos_soak"' in ln][-1]
+    rep = json.loads(line)["chaos_soak"]
+    assert rep["ok"], rep
+    assert rep["seed"] == 1
+    episodes = {e["episode"]: e for e in rep["episodes"]}
+    assert set(episodes) == {"preempt", "iter_retry", "rejoin", "serve"}
+    assert all(e["ok"] for e in episodes.values()), episodes
+    assert episodes["preempt"]["exit_codes"] == [76, 76]
+    assert episodes["preempt"]["resume_parity"]
+    assert episodes["iter_retry"]["iter_retries"] >= 1
+    assert episodes["iter_retry"]["parity"]
+    assert episodes["rejoin"]["world_after"] == 2
+    assert episodes["rejoin"]["parity"]
+    assert episodes["serve"]["hedge_wins"] >= 1
+    assert episodes["serve"]["torn_detected"]

@@ -80,7 +80,12 @@ def test_span_nesting_and_export_roundtrip(tmp_path):
         doc = json.load(fh)
     evs = {e["name"]: e for e in doc["traceEvents"]}
     assert {"outer", "inner_a", "inner_b"} <= set(evs)
+    # dump_trace prepends a process_name metadata row (ph == "M") so
+    # multi-rank dumps label themselves in the trace viewer
+    assert evs["process_name"]["ph"] == "M"
     for ev in evs.values():
+        if ev["ph"] == "M":
+            continue
         assert ev["ph"] == "X" and ev["dur"] >= 0 and "ts" in ev
         assert ev["pid"] == os.getpid()
     outer, ia, ib = evs["outer"], evs["inner_a"], evs["inner_b"]

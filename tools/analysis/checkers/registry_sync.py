@@ -1,6 +1,6 @@
-"""registry-sync: code registries <-> docs/Observability.md tables.
+"""registry-sync: code registries <-> docs tables.
 
-Three bidirectional syncs, one rule: a name in code but not in the docs
+Four bidirectional syncs, one rule: a name in code but not in the docs
 is telemetry nobody knows to query; a documented name no code produces
 is a dashboard lying about coverage.
 
@@ -14,6 +14,10 @@ is a dashboard lying about coverage.
   ``counters.incr/set_gauge/add_seconds("name")`` calls vs the
   ``| counter / gauge | meaning |`` table. This is the new one: ~30
   counters had no lint at all before this rule.
+* fault-grammar **verbs** — the ``_KNOWN`` tuple in
+  ``resilience/faults.py`` vs the ``| verb | effect |`` table in
+  docs/Reliability.md: every accepted chaos verb stays documented, and
+  the doc never advertises a verb the parser rejects.
 
 All extraction lives in ``tools.analysis.docs_tables`` (single home for
 the docs-table parsing the two old lints each reimplemented).
@@ -28,6 +32,8 @@ from .. import docs_tables as dt
 
 RULE = "registry-sync"
 DOC_REL = "docs/Observability.md"
+RELIABILITY_DOC_REL = "docs/Reliability.md"
+FAULTS_REL = "lightgbm_tpu/resilience/faults.py"
 PKG_PREFIX = "lightgbm_tpu/"
 
 
@@ -65,33 +71,54 @@ def counter_sets(project: Project) -> Tuple[Set[str], Set[str]]:
             dt.doc_first_column(doc, dt.COUNTER_HEADER))
 
 
+def fault_verb_sets(project: Project) -> Tuple[Set[str], Set[str]]:
+    path = project.doc_path(RELIABILITY_DOC_REL)
+    doc = ""
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            doc = f.read()
+    faults_text = next((f.text for f in project.files
+                        if f.path == FAULTS_REL), "")
+    return (dt.fault_verbs(faults_text),
+            dt.doc_first_column(doc, dt.FAULT_VERB_HEADER))
+
+
 _SYNCS = (
     ("phase", phase_sets, 'phase("...") recorder call',
-     "| Phase | Where |"),
+     "| Phase | Where |", DOC_REL),
     ("event kind", event_sets, '.emit("...") call',
-     "| kind | emitted by |"),
+     "| kind | emitted by |", DOC_REL),
     ("counter", counter_sets, "counters.incr/set_gauge/add_seconds call",
-     "| counter / gauge | meaning |"),
+     "| counter / gauge | meaning |", DOC_REL),
+    ("fault verb", fault_verb_sets,
+     "_KNOWN registry entry (resilience/faults.py)",
+     "| verb | effect |", RELIABILITY_DOC_REL),
 )
 
 
-@register(RULE, "recorder phases, event kinds, and telemetry counters "
-                "stay in sync with the docs/Observability.md tables")
+@register(RULE, "recorder phases, event kinds, telemetry counters, and "
+                "fault verbs stay in sync with their docs tables")
 def check(project: Project) -> Iterable[Finding]:
     out: List[Finding] = []
-    doc, have_doc = _doc_text(project)
+    _, have_doc = _doc_text(project)
     if not have_doc:
         return [Finding(RULE, DOC_REL, 0, "docs/Observability.md missing")]
-    for what, fn, code_desc, table in _SYNCS:
+    if not os.path.exists(project.doc_path(RELIABILITY_DOC_REL)):
+        # the fault-verb sync only binds where the verb registry exists
+        # (fixture projects carry neither faults.py nor Reliability.md)
+        if any(f.path == FAULTS_REL for f in project.files):
+            return [Finding(RULE, RELIABILITY_DOC_REL, 0,
+                            "docs/Reliability.md missing")]
+    for what, fn, code_desc, table, doc_rel in _SYNCS:
         code, docs = fn(project)
         for name in sorted(code - docs):
             out.append(Finding(
-                RULE, DOC_REL, 0,
+                RULE, doc_rel, 0,
                 f"{what} `{name}` is produced in code ({code_desc}) but "
                 f"missing from the `{table}` table"))
         for name in sorted(docs - code):
             out.append(Finding(
-                RULE, DOC_REL, 0,
+                RULE, doc_rel, 0,
                 f"{what} `{name}` is documented in the `{table}` table "
                 f"but never produced by any {code_desc}"))
     return out

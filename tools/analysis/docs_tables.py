@@ -28,6 +28,10 @@ COUNTER_CALL = re.compile(
     r"counters\s*\.\s*(?:incr|set_gauge|add_seconds)\(\s*"
     r"[\"']([a-z0-9_]+)[\"']")
 
+# the fault grammar's verb registry: the _KNOWN tuple in
+# resilience/faults.py (single source of truth for accepted verbs)
+FAULT_VERB_TUPLE = re.compile(r"_KNOWN\s*=\s*\(([^)]*)\)")
+
 # emitted via events.iteration_record(), not a literal emit() call
 EVENT_EXEMPT = {"iteration"}
 # gauges injected by counters.snapshot() itself rather than a literal
@@ -62,6 +66,16 @@ def doc_first_column(doc_text: str, header_pattern: str) -> Set[str]:
     return names
 
 
+def fault_verbs(faults_text: str) -> Set[str]:
+    """Verb names out of the ``_KNOWN = (...)`` tuple in
+    resilience/faults.py."""
+    m = FAULT_VERB_TUPLE.search(faults_text)
+    if not m:
+        return set()
+    return set(re.findall(r"[\"']([a-z0-9_]+)[\"']", m.group(1)))
+
+
 PHASE_HEADER = r"^\|\s*Phase\s*\|\s*Where\s*\|"
 EVENT_HEADER = r"^\|\s*kind\s*\|\s*emitted by\s*\|"
 COUNTER_HEADER = r"^\|\s*counter / gauge\s*\|\s*meaning\s*\|"
+FAULT_VERB_HEADER = r"^\|\s*verb\s*\|\s*effect\s*\|"
