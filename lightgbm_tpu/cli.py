@@ -189,10 +189,13 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
         from .distributed.checkpoint import DistributedCheckpointManager
         m = mgr or DistributedCheckpointManager(
             ckpt_dir, keep_last=cfg.snapshot_keep)
+        # allow_rejoin=False: do not let a pending rejoin knock turn
+        # this grace-window exit into a re-form (see engine._preempt_exit)
         path = m.save(booster,
                       extra_meta={"target_rounds": int(num_iters),
                                   "preempted": True,
-                                  "preempt_reason": preempt.reason()})
+                                  "preempt_reason": preempt.reason()},
+                      allow_rejoin=False)
         telemetry.events.emit("preempt", phase="exit", iteration=int(it),
                               path=path or ckpt_dir,
                               exit_code=preempt.PREEMPT_EXIT_CODE)
@@ -205,32 +208,47 @@ def _train(params: Dict[str, str], cfg: Config) -> None:
 
     def _boost_loop(booster, mgr):
         sup = supervisor.active()
-        for it in range(booster.current_iteration(), num_iters):
-            # chaos + liveness boundary, same placement as engine.train
-            faults.kill_point(it)
-            if sup is not None:
-                sup.check()
-            # collective payloads this iteration carry this epoch
-            # (io/distributed.py epoch fence)
-            faults.set_epoch(it)
-            if preempt.group_requested():
-                _emergency_exit(booster, mgr, it)   # never returns
-            t_it = time.time()
-            stop = booster.update()
-            log.info("%.6f seconds elapsed, finished iteration %d",
-                     time.time() - t_it, it + 1)
-            if (it + 1) % metric_freq == 0:
-                for dname, mname, val, _ in booster.eval():
-                    log.info("Iteration:%d, %s %s : %g", it + 1, dname,
-                             mname, val)
-            if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
-                _write_snapshot(booster, cfg, it + 1)
-            if mgr is not None and (it + 1) % cfg.checkpoint_freq == 0:
-                mgr.save(booster,
-                         extra_meta={"target_rounds": int(num_iters)})
-            if stop:
-                break
-        faults.set_epoch(-1)
+        # the distributed preempt vote is agreed once per loop entry (a
+        # collective): asymmetric arming across ranks is detected here
+        # instead of deadlocking the per-iteration allgather
+        preempt.resolve_group_sync()
+        try:
+            for it in range(booster.current_iteration(), num_iters):
+                # chaos + liveness boundary, same placement as
+                # engine.train
+                faults.kill_point(it)
+                if sup is not None:
+                    sup.check()
+                # collective payloads this iteration carry this epoch
+                # (io/distributed.py epoch fence)
+                faults.set_epoch(it)
+                if preempt.group_requested():
+                    _emergency_exit(booster, mgr, it)   # never returns
+                t_it = time.time()
+                stop = booster.update()
+                log.info("%.6f seconds elapsed, finished iteration %d",
+                         time.time() - t_it, it + 1)
+                if (it + 1) % metric_freq == 0:
+                    for dname, mname, val, _ in booster.eval():
+                        log.info("Iteration:%d, %s %s : %g", it + 1,
+                                 dname, mname, val)
+                if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+                    _write_snapshot(booster, cfg, it + 1)
+                if mgr is not None and (it + 1) % cfg.checkpoint_freq == 0:
+                    mgr.save(booster,
+                             extra_meta={"target_rounds": int(num_iters)})
+                if stop:
+                    break
+        finally:
+            # drop the in-training epoch stamp on EVERY exit — normal
+            # completion, RejoinSignal, or a rank failure. The recovery
+            # handlers below run re-form collectives (supervision
+            # allgather, restore broadcast) that a fresh replacement
+            # frames at -1; leaving the failure iteration stamped here
+            # would desync them (EpochDesyncError) against it. Same
+            # contract as engine._recover_after_rank_failure /
+            # _regrow_after_rejoin.
+            faults.set_epoch(-1)
 
     def _rebuild_for_world():
         """Fresh Dataset/Booster for the CURRENT world after a re-form

@@ -70,7 +70,7 @@ class DistributedCheckpointManager:
         return self._writer
 
     def save(self, booster, history: Optional[list] = None,
-             extra_meta=None) -> str:
+             extra_meta=None, allow_rejoin: bool = True) -> str:
         path = ""
         writer = self._current_writer()
         if bootstrap.is_distributed():
@@ -92,11 +92,20 @@ class DistributedCheckpointManager:
         # checkpoint is the one boundary the group can safely re-form
         # at N+1 — every member raises the same RejoinSignal (the
         # rendezvous is itself a collective when distributed) and the
-        # engine re-bootstraps + resumes from the file just written
+        # engine re-bootstraps + resumes from the file just written.
+        # The emergency-preemption paths pass allow_rejoin=False: a
+        # preempting group must exit 76 right after the barrier, not
+        # spend its eviction grace window on a full re-form (the
+        # pending knock is answered by the relaunched run). A flag
+        # rather than preempt.requested() because the local flag can be
+        # racy-asymmetric (a SIGTERM landing between the vote and the
+        # save) while the caller's vote outcome is symmetric — and the
+        # rendezvous is a collective, so the skip must be too.
         from . import supervisor
-        info = supervisor.rendezvous_pending_rejoin()
-        if info is not None:
-            raise supervisor.RejoinSignal(info)
+        if allow_rejoin:
+            info = supervisor.rendezvous_pending_rejoin()
+            if info is not None:
+                raise supervisor.RejoinSignal(info)
         return path
 
     def latest(self) -> Optional[CheckpointData]:

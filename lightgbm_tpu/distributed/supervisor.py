@@ -737,7 +737,11 @@ def _build_rejoin_ack(req: dict, heartbeat_ms: float) -> dict:
     from LGBM_TPU_REJOIN_PORT (+1 per completed rejoin, so repeated
     grow/shrink cycles never collide with an immortalized old service);
     the newcomer takes rank = old world (existing members keep their
-    ranks, so scores/shards restored from the checkpoint stay put)."""
+    ranks, so scores/shards restored from the checkpoint stay put).
+    ``_rejoin_gen`` is kept uniform across the group — survivors bump it
+    in expand_after_rejoin and a replacement adopts it from this ack in
+    rejoin_as_replacement — so ANY member can answer the next knock with
+    a port no previous generation ever bound."""
     port_env = os.environ.get("LGBM_TPU_REJOIN_PORT", "").strip()
     if not port_env:
         raise RuntimeError(
@@ -905,11 +909,18 @@ def rejoin_as_replacement(contact: str, timeout_s: float = 60.0) -> dict:
         raise RuntimeError(f"rejoin refused by {contact}: {ack}")
     log.warning("rejoining as rank %d of %d via %s", ack["rank"],
                 ack["world"], ack["coordinator"])
+    global _rejoin_gen
     from ..resilience import faults
     from . import bootstrap
     faults.set_collective_timeout_ms(0)
     bootstrap.initialize(ack["coordinator"], int(ack["world"]),
                          int(ack["rank"]), supervise=True)
+    # adopt the group's rejoin generation: every member (survivors via
+    # expand_after_rejoin, this newcomer via the ack) lands on gen+1, so
+    # a FUTURE ack built by any member — including this one — derives
+    # the same fresh coordinator port instead of re-offering one bound
+    # by an immortalized old coordination service
+    _rejoin_gen = max(_rejoin_gen, int(ack.get("gen", 0))) + 1
     telem_counters.incr("rejoins")
     telem_events.emit("rejoin", role="replacement", rank=int(ack["rank"]),
                       new_world=int(ack["world"]),

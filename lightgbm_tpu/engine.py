@@ -186,6 +186,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 evaluation_result_list=results))
         return stop, results
 
+    # the distributed preempt vote is agreed once per train() entry (a
+    # collective at a point every rank reaches together): asymmetric
+    # arming is detected and disabled loudly here instead of deadlocking
+    # the per-iteration allgather on the armed ranks only
+    preempt.resolve_group_sync()
     try:
         for i in range(init_iteration, end_iteration):
             # chaos boundary (kill_rank@iter= / preempt@iter=) then
@@ -361,11 +366,15 @@ def _preempt_exit(booster, cbs, iteration, end_iteration):
     history = next((getattr(cb, "_ckpt_history") for cb in cbs
                     if getattr(cb, "_ckpt_history", None) is not None),
                    None)
+    # allow_rejoin=False: a pending rejoin knock must NOT convert this
+    # grace-window exit into a full group re-form — exit 76 immediately
+    # after the barrier; the relaunched run answers the knock
     path = DistributedCheckpointManager(ckpt_dir).save(
         booster, history=history,
         extra_meta={"target_rounds": int(end_iteration),
                     "preempted": True,
-                    "preempt_reason": preempt.reason()})
+                    "preempt_reason": preempt.reason()},
+        allow_rejoin=False)
     telemetry.events.emit("preempt", phase="exit", iteration=int(iteration),
                           path=path or ckpt_dir,
                           exit_code=preempt.PREEMPT_EXIT_CODE)

@@ -40,7 +40,8 @@ from ..telemetry import events as telem_events
 from ..utils import log
 
 __all__ = ["PREEMPT_EXIT_CODE", "install_handlers", "arm", "requested",
-           "reason", "clear", "sync_enabled", "group_requested"]
+           "reason", "clear", "sync_enabled", "resolve_group_sync",
+           "group_requested"]
 
 # exit-code contract (documented in docs/Reliability.md): the process
 # wrote a durable emergency checkpoint and can be resumed bit-identically
@@ -51,6 +52,10 @@ PREEMPT_EXIT_CODE = 76
 _requested = threading.Event()
 _installed = False
 _reason = ""
+# group decision on the per-iteration vote: None until a training loop
+# resolves it collectively (resolve_group_sync); then True/False is THE
+# answer on every rank for that loop's lifetime
+_group_sync = None
 
 
 def _on_signal(signum, frame) -> None:   # pragma: no cover - signal ctx
@@ -114,19 +119,54 @@ def reason() -> str:
 
 def clear() -> None:
     """Reset the flag (tests; a resumed process starts clean anyway)."""
-    global _reason
+    global _reason, _group_sync
     _requested.clear()
     _reason = ""
+    _group_sync = None
 
 
 def sync_enabled() -> bool:
-    """Whether the per-iteration distributed preempt vote is armed.
-    True when this process installed signal handlers or when
-    ``LGBM_TPU_PREEMPT_SYNC=1``. The vote is a collective: every rank
-    must answer it on every iteration, so whichever arming is used must
-    be applied on ALL ranks (cli._train installs handlers on every
-    rank; harnesses set the env var on every rank)."""
+    """This process's LOCAL arming of the per-iteration preempt vote:
+    True when it installed signal handlers or ``LGBM_TPU_PREEMPT_SYNC=1``.
+    The vote itself is a collective, so the group decision is made by
+    ``resolve_group_sync`` (an allgather at training-loop entry), never
+    from this value alone — ``install_handlers`` silently declines off
+    the main thread, so local arming can be asymmetric across ranks."""
     return _installed or os.environ.get("LGBM_TPU_PREEMPT_SYNC", "") == "1"
+
+
+def resolve_group_sync() -> bool:
+    """Agree ONCE, collectively, on whether the per-iteration preempt
+    vote runs — called at training-loop entry (engine.train,
+    cli._boost_loop), a point every rank reaches together.
+
+    Each rank contributes its local ``sync_enabled()`` byte; the vote is
+    enabled only when EVERY rank is armed. On a mismatch (one rank's
+    ``install_handlers`` declined off the main thread, an env var set on
+    some hosts only) the vote is disabled everywhere with a loud warning
+    instead of the armed ranks blocking in the per-iteration allgather
+    until CollectiveTimeout. Single-process (or not distributed) the
+    local value IS the decision."""
+    global _group_sync
+    from ..distributed import bootstrap
+    local = sync_enabled()
+    if not bootstrap.is_distributed():
+        _group_sync = local
+        return _group_sync
+    from ..io.distributed import _allgather_host_bytes
+    votes = _allgather_host_bytes(b"\x01" if local else b"\x00")
+    armed = [v[:1] == b"\x01" for v in votes]
+    _group_sync = all(armed)
+    if not _group_sync and any(armed):
+        unarmed = [i for i, a in enumerate(armed) if not a]
+        telem_events.emit("preempt", phase="vote_disabled",
+                          unarmed_ranks=unarmed)
+        log.warning(
+            "preempt vote disabled: arming is asymmetric (rank(s) %s "
+            "un-armed) — a SIGTERM will only checkpoint the signaled "
+            "rank's group when every rank installs handlers or sets "
+            "LGBM_TPU_PREEMPT_SYNC=1", unarmed)
+    return _group_sync
 
 
 def group_requested() -> bool:
@@ -138,9 +178,12 @@ def group_requested() -> bool:
     ranks agree on the SAME iteration boundary to checkpoint at; the
     payload rides the iteration-epoch header like every other lane
     user, so a desynced rank fails typed instead of checkpointing a
-    mixed iteration."""
+    mixed iteration. Whether the vote runs is the GROUP decision from
+    ``resolve_group_sync`` when one was made (it is a collective:
+    asymmetric local arming must not reach the allgather below)."""
     local = _requested.is_set()
-    if not sync_enabled():
+    enabled = _group_sync if _group_sync is not None else sync_enabled()
+    if not enabled:
         return local
     from ..distributed import bootstrap
     if not bootstrap.is_distributed():

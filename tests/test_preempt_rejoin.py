@@ -89,6 +89,48 @@ def test_group_requested_is_local_when_single_process(monkeypatch):
     assert preempt.group_requested() is True
 
 
+def test_resolve_group_sync_single_process_is_local(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_PREEMPT_SYNC", raising=False)
+    assert preempt.resolve_group_sync() is False
+    monkeypatch.setenv("LGBM_TPU_PREEMPT_SYNC", "1")
+    assert preempt.resolve_group_sync() is True
+
+
+def test_resolve_group_sync_disables_vote_on_asymmetric_arming(monkeypatch):
+    """One rank armed, one not: the group agreement disables the vote
+    everywhere (loudly) instead of the armed rank blocking alone in the
+    per-iteration allgather until CollectiveTimeout."""
+    import lightgbm_tpu.distributed.bootstrap as bootstrap
+    import lightgbm_tpu.io.distributed as iodist
+    monkeypatch.setenv("LGBM_TPU_PREEMPT_SYNC", "1")
+    monkeypatch.setattr(bootstrap, "is_distributed", lambda: True)
+    monkeypatch.setattr(iodist, "_allgather_host_bytes",
+                        lambda payload: [b"\x01", b"\x00"])
+    assert preempt.resolve_group_sync() is False
+
+    def _explode(payload):
+        raise AssertionError("disabled vote must not reach the lane")
+    monkeypatch.setattr(iodist, "_allgather_host_bytes", _explode)
+    assert preempt.group_requested() is False    # local view, no lane
+    preempt.arm("local-notice")
+    assert preempt.group_requested() is True
+
+
+def test_resolve_group_sync_all_armed_runs_the_vote(monkeypatch):
+    import lightgbm_tpu.distributed.bootstrap as bootstrap
+    import lightgbm_tpu.io.distributed as iodist
+    monkeypatch.setenv("LGBM_TPU_PREEMPT_SYNC", "1")
+    monkeypatch.setattr(bootstrap, "is_distributed", lambda: True)
+    monkeypatch.setattr(iodist, "_allgather_host_bytes",
+                        lambda payload: [b"\x01", b"\x01"])
+    assert preempt.resolve_group_sync() is True
+    # the vote runs: a peer's flag arms this rank too
+    monkeypatch.setattr(iodist, "_allgather_host_bytes",
+                        lambda payload: [b"\x00", b"\x01"])
+    assert preempt.group_requested() is True
+    assert preempt.requested() and "peer" in preempt.reason()
+
+
 def test_sigterm_handler_arms_flag(monkeypatch):
     """install_handlers + a real SIGTERM set the flag without doing any
     work in signal context."""
@@ -211,6 +253,52 @@ def test_checkpoint_writer_follows_current_rank(tmp_path, monkeypatch):
     assert mgr._writer_rank == 0
 
 
+def test_emergency_save_skips_rejoin_rendezvous(tmp_path, monkeypatch):
+    """allow_rejoin=False (the emergency-preemption save) exits straight
+    after the barrier even with a rejoin knock pending — a preempting
+    group must spend its eviction grace window on the checkpoint, not on
+    a full re-form. The ordinary periodic save still converts the same
+    pending knock into a RejoinSignal."""
+    x, y = make_binary(n=200, f=4)
+    bst = engine.train(dict(BASE), lgb.Dataset(x, y, free_raw_data=False),
+                       num_boost_round=1, verbose_eval=False)
+    monkeypatch.setattr(sv, "rendezvous_pending_rejoin",
+                        lambda: {"world": 2, "rank": 1,
+                                 "coordinator": "h:1", "gen": 0})
+    mgr = DistributedCheckpointManager(str(tmp_path))
+    path = mgr.save(bst, allow_rejoin=False)
+    assert path                                  # durable, no signal
+    with pytest.raises(sv.RejoinSignal):
+        mgr.save(bst)
+
+
+def test_cli_loop_resets_epoch_on_mid_loop_failure(tmp_path, monkeypatch):
+    """cli._boost_loop drops the in-training epoch stamp on EVERY exit,
+    including a mid-iteration exception: the recovery handlers' re-form
+    collectives (supervision allgather, restore broadcast) must frame at
+    -1 like a fresh replacement process or elastic rejoin desyncs."""
+    from lightgbm_tpu import cli
+    x, y = make_binary(n=300, f=5)
+    data = np.column_stack([y, x])
+    train = tmp_path / "b.train"
+    np.savetxt(train, data, delimiter="\t", fmt="%.6g")
+    real = cli.Booster.update
+    calls = {"n": 0}
+
+    def boom(self, *a, **k):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise RuntimeError("injected mid-loop failure")
+        return real(self, *a, **k)
+    monkeypatch.setattr(cli.Booster, "update", boom)
+    with pytest.raises(RuntimeError, match="injected"):
+        cli.run([f"data={train}", "objective=binary", "num_iterations=4",
+                 f"output_model={tmp_path / 'm.txt'}", "verbosity=-1",
+                 "num_leaves=7"])
+    assert calls["n"] == 2                       # died INSIDE the loop
+    assert faults.current_epoch() == -1
+
+
 # ---------------------------------------------------------------------------
 # fast: rejoin-ack contract
 # ---------------------------------------------------------------------------
@@ -224,6 +312,26 @@ def test_build_rejoin_ack_contract(monkeypatch):
     assert int(port) == 18700 + 1 + sv._rejoin_gen
     assert ack["heartbeat_ms"] == 250.0
     assert ack["peer_host"] == "10.9.9.9"
+
+
+def test_rejoin_ack_carries_gen_and_salts_the_port(monkeypatch):
+    """The generation rides the ack so EVERY member — survivors in
+    expand_after_rejoin, the replacement in rejoin_as_replacement —
+    lands on the same gen, and a future answerer's derived port never
+    re-offers one bound by an immortalized old coordination service."""
+    monkeypatch.setenv("LGBM_TPU_REJOIN_PORT", "18800")
+    old = sv._rejoin_gen
+    try:
+        sv._rejoin_gen = 3
+        ack = sv._build_rejoin_ack({"host": "h"}, 100.0)
+        assert ack["gen"] == 3
+        assert int(ack["coordinator"].rsplit(":", 1)[1]) == 18800 + 1 + 3
+        # both halves of the re-form apply the same bump from that ack
+        survivor_gen = max(sv._rejoin_gen, int(ack["gen"])) + 1
+        replacement_gen = max(0, int(ack.get("gen", 0))) + 1
+        assert survivor_gen == replacement_gen == 4
+    finally:
+        sv._rejoin_gen = old
 
 
 def test_build_rejoin_ack_requires_fixed_port(monkeypatch):
