@@ -683,15 +683,21 @@ class Booster:
         return run(x)
 
     def refit(self, data, label, decay_rate=0.9, **kwargs):
-        """Refit leaf values on new data (reference Booster.refit)."""
-        from .engine import train as _train
-        new_params = dict(self.params)
-        new_params["refit_decay_rate"] = decay_rate
+        """Refit leaf values on new data IN PLACE (reference
+        Booster.refit keeps the handle too). Historically this rebuilt
+        a whole new Booster — training context, predictor caches and
+        all — to change one param; now only a binned Dataset is built
+        for the gradient context and the tree leaves are rewritten in
+        this model with a single ensemble-cache invalidation, so
+        back-to-back refit+predict cycles re-tensorize the ensemble
+        exactly once per refit. Returns self."""
+        self.params["refit_decay_rate"] = decay_rate
         leaf_preds = self.predict(data, pred_leaf=True)
-        new_booster = Booster(new_params, Dataset(data, label))
-        new_booster._gbdt.models = [copy.deepcopy(t) for t in self._gbdt.models]
-        new_booster._gbdt.refit_leaves(leaf_preds, decay_rate)
-        return new_booster
+        ds = Dataset(data, label)
+        ds._update_params(self.params)
+        ds.construct()
+        self._gbdt.refit_leaves_on(ds._inner, leaf_preds, decay_rate)
+        return self
 
     # ------------------------------------------------------------------
     def save_model(self, filename, num_iteration=None,

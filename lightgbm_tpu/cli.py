@@ -61,6 +61,11 @@ def run(argv=None) -> int:
         # fleet front end: gateway_* keys, same dispatch reasoning
         _gateway(params)
         return 0
+    if params.get("task") == "continual":
+        # closed continual-learning loop over an embedded task=serve:
+        # serve_* keys ride along, so dispatch before Config too
+        _continual(params)
+        return 0
     cfg = Config(params)
     if cfg.task in ("train", "refit"):
         _train(params, cfg)
@@ -485,6 +490,13 @@ def _serve(params: Dict[str, str], block: bool = True):
         max_delay_ms=float(params.get("serve_max_delay_ms", 2.0)),
         max_queue_rows=int(params.get("serve_queue_rows", 4096)),
         default_timeout_ms=float(params.get("serve_timeout_ms", 5000.0)))
+    fb_min = int(params.get("feedback_min_labels", 0) or 0)
+    if fb_min > 0:
+        # labeled-feedback promotion gate (POST /feedback): the canary
+        # must accrue fb_min labels and hold AUC within epsilon of stable
+        app.router.feedback_min_labels = fb_min
+        app.router.feedback_auc_epsilon = float(
+            params.get("feedback_auc_epsilon", 0.02))
     t0 = time.time()
     if model_file:
         version = registry.load(model_file)
@@ -573,6 +585,76 @@ def _gateway(params: Dict[str, str], block: bool = True):
         gateway, host=params.get("gateway_host", "127.0.0.1"),
         port=int(params.get("gateway_port", 8088)),
         background=not block)
+
+
+def _continual(params: Dict[str, str], block: bool = True):
+    """task=continual: the closed loop drift → retrain → canary →
+    audited promote, wrapped around an embedded ``task=serve``.
+
+    All ``serve_*`` options apply (the drift monitor needs the model's
+    ``.drift.json`` sidecar to arm — train writes it). Loop options:
+    ``data=<file>`` (the refreshed training extract, RE-READ at every
+    retrain so an operator pipeline can keep it current),
+    ``continual_policy`` (refit/continue/auto), ``continual_cooldown_s``,
+    ``continual_topup_rounds``, ``continual_canary_weight``,
+    ``refit_decay_rate``, ``feedback_min_labels`` /
+    ``feedback_auc_epsilon`` (labeled-feedback promotion gate),
+    ``continual_checkpoint_dir`` (persist every retrained model + drift
+    sidecar), ``continual_poll_s``. See docs/Continual.md.
+    """
+    from .continual.loop import ContinualLoop
+    from .continual.update import continue_training
+    data_path = str(params.get("data", "")).strip()
+    if not data_path:
+        log.fatal("task=continual requires data=<file> — the refreshed "
+                  "training extract re-read at every retrain")
+    policy = str(params.get("continual_policy", "auto")).strip() or "auto"
+    if policy not in ("refit", "continue", "auto"):
+        log.fatal("continual_policy must be one of refit/continue/auto, "
+                  "got %s", policy)
+    httpd = _serve(params, block=False)
+    app = httpd.app
+    decay = float(params.get("refit_decay_rate", 0.9))
+    topup = int(params.get("continual_topup_rounds", 10))
+
+    def retrain(action: str) -> Booster:
+        # start from the version traffic trusts NOW (router stable),
+        # via model text so the served tensors are never mutated while
+        # they are still taking traffic
+        stable = app.router.stable or app.registry.latest
+        prev = Booster(model_str=app.registry.get(stable).gbdt
+                       .save_model_to_string(num_iteration=-1))
+        x, y, _ = _load_matrix(data_path)
+        if action == "refit":
+            return prev.refit(x, y, decay_rate=decay)
+        return continue_training(prev, Dataset(x, label=y),
+                                 num_boost_round=topup)
+
+    loop = ContinualLoop(
+        app.registry, app.router, retrain, policy=policy,
+        cooldown_s=float(params.get("continual_cooldown_s", 30.0)),
+        canary_weight=float(params.get("continual_canary_weight", 0.2)),
+        poll_s=float(params.get("continual_poll_s", 1.0)),
+        checkpoint_dir=(str(params.get("continual_checkpoint_dir", ""))
+                        .strip() or None))
+    loop.start()
+    log.info("continual loop armed (policy %s, cooldown %.1fs, data %s)",
+             policy, loop.cooldown_s, data_path)
+    if not block:
+        return httpd, loop
+    # the serve thread is already running (block=False serve above);
+    # park here until the operator stops the process
+    import threading
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:   # pragma: no cover - operator stop
+        pass
+    finally:
+        loop.stop()
+        httpd.shutdown()
+        app.drain()
+        httpd.server_close()
+        app.close()
 
 
 def _convert_model(params: Dict[str, str], cfg: Config) -> None:

@@ -242,6 +242,9 @@ class Config:
                       "working-set policy and needs boosting=goss "
                       "(got boosting=%s); use stream_mode=chunked for "
                       "plain streaming", self.boosting)
+        if self.continual_policy not in ("refit", "continue", "auto"):
+            log.fatal("continual_policy must be one of refit/continue/auto, "
+                      "got %s", self.continual_policy)
         if self.on_rank_failure not in ("raise", "shrink"):
             log.fatal("on_rank_failure must be one of raise/shrink, "
                       "got %s", self.on_rank_failure)

@@ -1,0 +1,24 @@
+"""Closed-loop continual learning (ROADMAP item 4).
+
+Every ingredient landed in earlier PRs — drift monitors with shipped
+baselines, the hot-swap registry + canary router with decision audit,
+checkpointed training, per-version serving counters — this package is
+the loop that connects them:
+
+* `refit` — `task=refit` reproduced on device: leaf-value refit as ONE
+  jit'd segment-sum over the leaf routes (the host per-leaf loop in
+  `GBDT.refit_leaves` becomes the parity fallback).
+* `update` — incremental continuation: bin fresh raw rows through the
+  FROZEN BinMapper set and append them to a constructed Dataset (and to
+  a live `DeviceDataShard` wire) so an `init_model` warm-start top-up
+  trains on history+fresh without re-binning history.
+* `loop` — the policy daemon: `drift_psi` watchdog fires → refit or
+  warm-continue per `continual_policy` → checkpoint → canary through
+  the fleet router → auto-promote / roll back on the audited gate
+  (extended with the labeled-feedback AUC gate in serving/feedback.py).
+
+See docs/Continual.md.
+"""
+from . import loop, refit, update
+
+__all__ = ["loop", "refit", "update"]

@@ -1,0 +1,151 @@
+"""Device leaf-value refit: `task=refit` as one jit'd segment-sum.
+
+The reference's Refit dispatch (application.cpp) re-estimates leaf
+outputs from new data without touching tree structure. Our host port
+(`GBDT._refit_leaves_host`) walks every (tree, leaf) pair in Python and
+masks rows per leaf — O(T * L) host passes over the row dimension. On
+device the whole thing is one program: the leaf routes `(N, T)` are
+already produced by the existing leaf-routing program
+(`ops.predict.predict_leaf_index_ensemble` via `pred_leaf=True`), so
+per-leaf gradient/hessian sums are a vmap-over-trees `segment_sum`,
+and the (T, L, 3) stats tensor that comes back is tiny.
+
+The shrink/blend arithmetic stays on HOST in float64 over the device
+sums, deliberately: it is O(T*L) scalar work and doing it host-side
+keeps the math bit-identical to the host loop, so the only numeric
+delta between the two paths is f32 pairwise-vs-scatter summation of
+the per-leaf gradients (parity-tested to f32 resolution in
+tests/test_continual_refit.py).
+
+Row-sharded datasets: each rank computes stats over its local rows
+with the SAME program, then the (T, L, 3) tensor — the only
+cross-rank bytes — is psum'd through `faults.run_collective`
+(site="refit_leaf_stats") before the host finish, mirroring how
+histogram reductions are the only wire traffic in training.
+
+One dispatch per refit, counter-asserted via
+`continual_refit_dispatches`.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry import counters as telem_counters
+from ..utils.envs import flag
+
+# stats layout along the last axis of the (T, L, 3) tensor
+STAT_GRAD, STAT_HESS, STAT_COUNT = 0, 1, 2
+
+
+def device_refit_enabled() -> bool:
+    """Device path is the default everywhere (the program is plain XLA,
+    fine on CPU too); LGBM_TPU_HOST_REFIT=1 forces the historical host
+    loop (parity escape hatch)."""
+    return not flag("LGBM_TPU_HOST_REFIT")
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def _leaf_stats_program(leaf_ids, grad, hess, tree_class, num_segments):
+    """ONE dispatch: per-(tree, leaf) sums of gradient/hessian/count.
+
+    leaf_ids   (N, T) int32 — leaf route of every row through every tree
+    grad, hess (K, N) f32   — per-class gradient pair
+    tree_class (T,)   int32 — class index of each tree (ti % K)
+    returns    (T, L, 3) f32 stacked [sum_grad, sum_hess, count]
+    """
+    ids = leaf_ids.T                                   # (T, N)
+    g = jnp.take(grad, tree_class, axis=0)             # (T, N)
+    h = jnp.take(hess, tree_class, axis=0)
+    ones = jnp.ones(ids.shape[1:], dtype=g.dtype)
+
+    def one(i, gg, hh):
+        sg = jax.ops.segment_sum(gg, i, num_segments=num_segments)
+        sh = jax.ops.segment_sum(hh, i, num_segments=num_segments)
+        cnt = jax.ops.segment_sum(ones, i, num_segments=num_segments)
+        return jnp.stack([sg, sh, cnt], axis=-1)
+
+    return jax.vmap(one)(ids, g, h)
+
+
+def leaf_stats(leaf_preds, grad, hess, *, num_tree_per_iteration: int,
+               max_leaves: int) -> np.ndarray:
+    """Host (T, L, 3) float32 stats from one device dispatch."""
+    telem_counters.incr("continual_refit_dispatches")
+    num_trees = int(leaf_preds.shape[1])
+    tree_class = jnp.asarray(
+        np.arange(num_trees) % max(num_tree_per_iteration, 1),
+        dtype=jnp.int32)
+    out = _leaf_stats_program(
+        jnp.asarray(leaf_preds, dtype=jnp.int32),
+        jnp.asarray(grad, dtype=jnp.float32),
+        jnp.asarray(hess, dtype=jnp.float32),
+        tree_class, num_segments=max(int(max_leaves), 1))
+    return np.asarray(jax.device_get(out), dtype=np.float32)
+
+
+def reduce_stats(stats: np.ndarray) -> np.ndarray:
+    """psum the per-rank leaf stats when a process group is active. The
+    (T, L, 3) tensor is the ONLY cross-rank traffic of a sharded refit,
+    and it rides the collective retry/deadline lane like every other
+    cross-rank dispatch."""
+    from ..parallel import network
+    if network.num_machines() <= 1:
+        return stats
+    from ..resilience import faults
+    from jax.experimental import multihost_utils
+    gathered = faults.run_collective(
+        lambda: multihost_utils.process_allgather(jnp.asarray(stats)),
+        site="refit_leaf_stats")
+    return np.asarray(gathered, dtype=np.float32).sum(axis=0)
+
+
+def _threshold_l1(s: float, l1: float) -> float:
+    return math.copysign(max(0.0, abs(s) - l1), s)
+
+
+def apply_leaf_values(models: List, stats: np.ndarray, *, lambda_l1: float,
+                      lambda_l2: float, max_delta_step: float,
+                      decay_rate: float, shrinkage_rate: float) -> None:
+    """Host finish: the reference leaf formula in float64 over the
+    summed stats, written back in place. Leaves no row reached keep
+    their old value (count == 0), matching the host loop's skip."""
+    for ti, tree in enumerate(models):
+        sg = stats[ti, :, STAT_GRAD]
+        sh = stats[ti, :, STAT_HESS]
+        cnt = stats[ti, :, STAT_COUNT]
+        for leaf in range(tree.num_leaves):
+            if cnt[leaf] <= 0.0:
+                continue
+            out = -_threshold_l1(float(sg[leaf]), lambda_l1) \
+                / (float(sh[leaf]) + lambda_l2)
+            if max_delta_step > 0:
+                out = float(np.clip(out, -max_delta_step, max_delta_step))
+            old = float(tree.leaf_value[leaf])
+            tree.set_leaf_output(
+                leaf, decay_rate * old + (1.0 - decay_rate) * out
+                * shrinkage_rate)
+
+
+def refit_leaves_device(models: List, leaf_preds, grad, hess, *,
+                        lambda_l1: float, lambda_l2: float,
+                        max_delta_step: float, decay_rate: float,
+                        shrinkage_rate: float,
+                        num_tree_per_iteration: int) -> None:
+    """Full device refit: one stats dispatch (+ cross-rank psum when
+    sharded), host finish in place."""
+    if not models:
+        return
+    max_leaves = max(t.num_leaves for t in models)
+    stats = leaf_stats(leaf_preds, grad, hess,
+                       num_tree_per_iteration=num_tree_per_iteration,
+                       max_leaves=max_leaves)
+    stats = reduce_stats(stats)
+    apply_leaf_values(models, stats, lambda_l1=lambda_l1,
+                      lambda_l2=lambda_l2, max_delta_step=max_delta_step,
+                      decay_rate=decay_rate, shrinkage_rate=shrinkage_rate)

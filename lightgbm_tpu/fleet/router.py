@@ -26,7 +26,14 @@ Promotion gate (evaluated per request, O(dict reads)):
 * canary error rate <= `max_error_rate`;
 * canary p99 <= `p99_ratio` x stable p99 (skipped when the stable has
   no latency history);
-* no watchdog fire since deploy (`telemetry.counters` watchdog_fires).
+* no watchdog fire since deploy (`telemetry.counters` watchdog_fires);
+* labeled-feedback quality (when a `serving.feedback.FeedbackStore` is
+  attached with `feedback_min_labels > 0`): hold until the canary has
+  accrued `feedback_min_labels` labels via `POST /feedback`, then
+  demote if its AUC trails the stable's by more than
+  `feedback_auc_epsilon` (stable AUC only compared once the stable has
+  enough labels of its own — counters prove the canary is not
+  *erroring*, labels prove it is not *wrong*).
 
 Demotion fires immediately — before min_requests — on an absolute
 error burst (`demote_errors`), a watchdog fire, or (when an SLO
@@ -70,7 +77,9 @@ class CanaryRouter:
 
     def __init__(self, registry, stats, min_requests: int = 50,
                  max_error_rate: float = 0.02, p99_ratio: float = 3.0,
-                 demote_errors: int = 3, slo=None):
+                 demote_errors: int = 3, slo=None, feedback=None,
+                 feedback_min_labels: int = 0,
+                 feedback_auc_epsilon: float = 0.02):
         self.registry = registry
         self.stats = stats
         self.min_requests = int(min_requests)
@@ -78,6 +87,9 @@ class CanaryRouter:
         self.p99_ratio = float(p99_ratio)
         self.demote_errors = int(demote_errors)
         self.slo = slo                      # optional serving.slo.SloMonitor
+        self.feedback = feedback            # optional FeedbackStore
+        self.feedback_min_labels = int(feedback_min_labels)
+        self.feedback_auc_epsilon = float(feedback_auc_epsilon)
         self._lock = threading.Lock()
         self._stable: Optional[str] = None
         self._canary: Optional[str] = None
@@ -215,7 +227,23 @@ class CanaryRouter:
                                "demote_errors": self.demote_errors}}
         if self.slo is not None:
             gate["slo_violation"] = self.slo.version_violation(canary)
+        if self._feedback_gated():
+            c_auc, c_n = self.feedback.auc(canary)
+            s_auc, s_n = self.feedback.auc(stable)
+            gate["thresholds"]["feedback_min_labels"] = \
+                self.feedback_min_labels
+            gate["thresholds"]["feedback_auc_epsilon"] = \
+                self.feedback_auc_epsilon
+            gate["feedback"] = {
+                "canary_labels": c_n, "stable_labels": s_n,
+                "canary_auc": (round(c_auc, 6) if c_auc is not None
+                               else None),
+                "stable_auc": (round(s_auc, 6) if s_auc is not None
+                               else None)}
         return gate
+
+    def _feedback_gated(self) -> bool:
+        return self.feedback is not None and self.feedback_min_labels > 0
 
     def evaluate(self) -> str:
         """Apply the state machine once: returns "promoted", "demoted",
@@ -262,6 +290,23 @@ class CanaryRouter:
                         f"stable {stable_p99:.1f}ms", missing_ok=True,
                         gate=gate)
             return "demoted"
+        fb = gate.get("feedback")
+        if fb is not None:
+            # quality gate: counters above proved the canary answers
+            # fast and without erroring; labels prove the answers are
+            # RIGHT. Hold (not demote) while labels accrue — absence of
+            # evidence is not a regression.
+            if fb["canary_labels"] < self.feedback_min_labels:
+                return _hold()
+            c_auc, s_auc = fb["canary_auc"], fb["stable_auc"]
+            if (c_auc is not None and s_auc is not None
+                    and fb["stable_labels"] >= self.feedback_min_labels
+                    and c_auc < s_auc - self.feedback_auc_epsilon):
+                self.demote(
+                    f"feedback_auc {c_auc:.3f} < stable {s_auc:.3f} - "
+                    f"{self.feedback_auc_epsilon:g}", missing_ok=True,
+                    gate=gate)
+                return "demoted"
         self.promote(missing_ok=True, gate=gate)
         return "promoted"
 

@@ -205,6 +205,26 @@ class DeviceDataShard:
     def working_set(self) -> Tuple[np.ndarray, Optional[jax.Array]]:
         return self.ws_ids, self._ws_rows
 
+    # -- append-rows (continual/update.py) -----------------------------
+    def append_rows(self, packed_rows: np.ndarray) -> int:
+        """Append already-packed rows to the wire store; returns the new
+        row count. The block must be packed with the SAME
+        item_bits/c_cols layout as construction
+        (`continual.update.pack_codes` / `pack_codes` on the owning
+        learner) — history is never re-encoded, the append is a
+        concatenation of wire words. The stream cursor, working set and
+        byte accounting are untouched: existing row ids keep their
+        meaning, new rows simply extend the chunk iteration space."""
+        block = np.ascontiguousarray(np.asarray(packed_rows))
+        if block.dtype != np.uint32 or block.ndim != 2 \
+                or block.shape[1] != self.code_words:
+            raise ValueError(
+                f"append_rows wants (M, {self.code_words}) u32 packed "
+                f"codes, got {block.dtype} {block.shape}")
+        self.wire = np.concatenate([self.wire, block], axis=0)
+        self.num_rows = int(self.wire.shape[0])
+        return self.num_rows
+
     # -- checkpoint round-trip -----------------------------------------
     def stream_state(self) -> Dict[str, object]:
         return {"cursor": int(self.cursor),

@@ -1007,14 +1007,71 @@ class GBDT:
     def refit_leaves(self, leaf_preds: np.ndarray, decay_rate: float) -> None:
         """Refit leaf values on new data keeping structure (reference:
         gbdt.cpp:298-321 RefitTree + FitByExistingTree): new_value =
-        decay * old + (1 - decay) * regularized mean-gradient estimate."""
-        self.invalidate_ensemble_cache()
+        decay * old + (1 - decay) * regularized mean-gradient estimate.
+        Gradients come from this booster's own objective/score context;
+        the leaf update itself runs through `_refit_leaves_apply`."""
         grad, hess = self._compute_gradients()
+        self._refit_leaves_apply(leaf_preds, grad, hess, decay_rate)
+
+    def refit_leaves_on(self, dataset: Dataset, leaf_preds: np.ndarray,
+                        decay_rate: float) -> None:
+        """In-place `task=refit` against NEW data: gradients of the
+        objective at its zero-score init over `dataset` — the same
+        context the historical rebuild-a-Booster path produced (a fresh
+        ScoreUpdater starts at zero), so the leaf values match it bit
+        for bit — then one in-place leaf update on THIS model."""
+        cfg = self.config
+        obj = (create_objective(cfg.objective, cfg)
+               if cfg.objective != "none" else None)
+        if obj is None:
+            raise ValueError("refit requires an objective "
+                             "(objective=none has no gradients)")
+        obj.init(dataset.metadata, dataset.num_data)
+        num_class = obj.num_model_per_iteration
+        score = jnp.zeros((num_class, dataset.num_data), dtype=jnp.float32)
+        if num_class == 1:
+            g, h = obj.get_gradients(score[0])
+            g, h = g[None, :], h[None, :]
+        else:
+            g, h = obj.get_gradients(score)
+        self._refit_leaves_apply(leaf_preds, g, h, decay_rate,
+                                 num_tree_per_iteration=num_class)
+
+    def _refit_leaves_apply(self, leaf_preds, grad, hess,
+                            decay_rate: float,
+                            num_tree_per_iteration: Optional[int] = None
+                            ) -> None:
+        """Shared refit tail: ONE ensemble-cache invalidation, then the
+        device segment-sum program (continual/refit.py — one dispatch,
+        leaf stats psum'd across ranks when row-sharded) or the
+        historical host loop (LGBM_TPU_HOST_REFIT=1, the parity
+        reference)."""
+        per_iter = (num_tree_per_iteration if num_tree_per_iteration
+                    else self.num_tree_per_iteration)
+        self.invalidate_ensemble_cache()
+        from ..continual import refit as continual_refit
+        cfg = self.config
+        if continual_refit.device_refit_enabled():
+            continual_refit.refit_leaves_device(
+                self.models, leaf_preds, grad, hess,
+                lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+                max_delta_step=cfg.max_delta_step, decay_rate=decay_rate,
+                shrinkage_rate=self.shrinkage_rate,
+                num_tree_per_iteration=per_iter)
+            return
+        self._refit_leaves_host(leaf_preds, grad, hess, decay_rate,
+                                per_iter)
+
+    def _refit_leaves_host(self, leaf_preds, grad, hess,
+                           decay_rate: float,
+                           num_tree_per_iteration: int) -> None:
+        """The original host per-leaf loop, kept as the device path's
+        parity reference (tests/test_continual_refit.py)."""
         g = np.asarray(jax.device_get(grad))
         h = np.asarray(jax.device_get(hess))
         cfg = self.config
         for ti, tree in enumerate(self.models):
-            k = ti % self.num_tree_per_iteration
+            k = ti % num_tree_per_iteration
             leaves = leaf_preds[:, ti]
             for leaf in range(tree.num_leaves):
                 rows = np.nonzero(leaves == leaf)[0]

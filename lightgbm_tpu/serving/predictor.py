@@ -96,6 +96,10 @@ class PreparedModel:
         self.arrays = arrays
         self.tree_class = tree_class
         self.n_trees = n_models
+        # the host-side model the tensors came from: continual-loop
+        # retrains start from the SERVED version's model text, which
+        # only the gbdt can produce (save_model_to_string)
+        self.gbdt = gbdt
         self.num_class = gbdt.num_class
         self.max_depth = arrays.max_depth
         self.num_features = gbdt.max_feature_idx + 1

@@ -30,6 +30,10 @@ Endpoints:
   [, "version", "weight", "shadow"]} — drive the canary state machine
 * ``POST /drain``    graceful drain for rolling restarts: stop
   admitting, flush the queue, reply with the final health snapshot
+* ``POST /feedback`` {"version": "v1", "labels": [...],
+  "scores": [...]} — record ground-truth labels against the version
+  that answered (the /predict response carries it); feeds the router's
+  labeled-feedback AUC promotion gate (serving/feedback.py)
 """
 from __future__ import annotations
 
@@ -69,8 +73,9 @@ class ServingApp:
                  batcher: Optional[MicroBatcher] = None,
                  stats: Optional[ServingStats] = None,
                  router: Optional[CanaryRouter] = None,
-                 slo=None, drift=None, shed=None,
+                 slo=None, drift=None, shed=None, feedback=None,
                  **batcher_kwargs):
+        from .feedback import FeedbackStore
         self.registry = registry or ModelRegistry()
         self.stats = stats or ServingStats()
         self.shed = shed
@@ -80,10 +85,14 @@ class ServingApp:
             self.batcher.shed = shed
         self.slo = slo
         self.drift = drift
+        self.feedback = feedback or FeedbackStore()
         self.router = router or CanaryRouter(self.registry, self.stats,
-                                             slo=slo)
+                                             slo=slo,
+                                             feedback=self.feedback)
         if slo is not None and getattr(self.router, "slo", None) is None:
             self.router.slo = slo
+        if getattr(self.router, "feedback", None) is None:
+            self.router.feedback = self.feedback
         if shed is not None and shed.audit is None:
             # brownout level changes land in the same bounded decision
             # log as canary transitions (GET /router/audit)
@@ -183,6 +192,30 @@ class ServingApp:
         threading.Thread(target=_run, daemon=True,
                          name="lgbm-tpu-shadow").start()
 
+    def feedback_record(self, payload: dict) -> dict:
+        """POST /feedback: ground-truth labels for earlier predictions,
+        keyed by the version that answered them. Labels accumulate in
+        the bounded per-version store the router's AUC promotion gate
+        reads."""
+        version = payload.get("version")
+        if not version:
+            raise BadRequest("feedback needs 'version' (echo the one "
+                             "the /predict response carried)")
+        labels = payload.get("labels")
+        scores = payload.get("scores", payload.get("predictions"))
+        if labels is None or scores is None:
+            raise BadRequest("feedback needs 'labels' and 'scores'")
+        try:
+            count = self.feedback.record(version, labels, scores)
+        except ValueError as exc:
+            raise BadRequest(str(exc)) from exc
+        self.stats.incr("serve_feedback_batches")
+        # fresh labels are gate evidence — re-judge the canary now
+        # rather than waiting for the next predict
+        self.router.evaluate()
+        return {"version": version, "recorded": len(labels),
+                "total_labels": count}
+
     def load_model(self, payload: dict) -> dict:
         if "model_file" in payload:
             source = payload["model_file"]
@@ -203,6 +236,7 @@ class ServingApp:
         snap["predictor_cache"] = self.registry.predictor.cache_info()
         snap["models"] = self.registry.versions()
         snap["router"] = self.router.snapshot()
+        snap["feedback"] = self.feedback.snapshot()
         if self.registry.export_cache is not None:
             snap["export_cache"] = self.registry.export_cache.info()
         if self.slo is not None:
@@ -412,6 +446,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self.app.drain(float(payload.get("timeout_s", 5.0)))
                 return self.app.health()
             self._dispatch(_drain)
+        elif self.path == "/feedback":
+            self._dispatch(
+                lambda: self.app.feedback_record(self._payload()))
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 

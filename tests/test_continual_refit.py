@@ -1,0 +1,143 @@
+"""Device leaf-refit parity against the historical host loop.
+
+The device path (continual/refit.py) must reproduce the host per-leaf
+loop to f32 summation resolution across growth strategies and the
+quantized config, make exactly ONE stats dispatch per refit
+(`continual_refit_dispatches`), preserve leaves no row reaches, and
+produce shard-local stats that SUM to the full-data stats (the
+row-sharded contract: the (T, L, 3) tensor is the only cross-rank
+traffic).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_binary
+from lightgbm_tpu.continual import refit as crefit
+from lightgbm_tpu.telemetry import counters as telem_counters
+
+
+def _leaf_values(bst):
+    return [np.asarray(t.leaf_value, dtype=np.float64).copy()
+            for t in bst._gbdt.models]
+
+
+def _train_model_str(monkeypatch, strategy, extra=None, seed=3):
+    monkeypatch.setenv("LGBM_TPU_STRATEGY", strategy)
+    params = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    params.update(extra or {})
+    x, y = make_binary(n=300, f=8, seed=seed)
+    bst = lgb.train(params, lgb.Dataset(x, y, free_raw_data=False),
+                    num_boost_round=4, verbose_eval=False)
+    return bst._gbdt.save_model_to_string(num_iteration=-1)
+
+
+def _refit_both_paths(model_str, monkeypatch, decay=0.5, seed=11):
+    """Refit two clones of the same model on the same fresh rows, one
+    per path; returns (original, device, host) leaf values."""
+    x2, y2 = make_binary(n=220, f=8, seed=seed)
+    orig = _leaf_values(lgb.Booster(model_str=model_str))
+    monkeypatch.delenv("LGBM_TPU_HOST_REFIT", raising=False)
+    assert crefit.device_refit_enabled()
+    dev = lgb.Booster(model_str=model_str).refit(x2, y2, decay_rate=decay)
+    monkeypatch.setenv("LGBM_TPU_HOST_REFIT", "1")
+    assert not crefit.device_refit_enabled()
+    try:
+        host = lgb.Booster(model_str=model_str).refit(
+            x2, y2, decay_rate=decay)
+    finally:
+        monkeypatch.delenv("LGBM_TPU_HOST_REFIT")
+    return orig, _leaf_values(dev), _leaf_values(host)
+
+
+@pytest.mark.parametrize("strategy", ["masked", "compact"])
+def test_device_host_refit_parity(strategy, monkeypatch):
+    """Same model, same fresh rows: device segment-sum refit matches
+    the host per-leaf loop to f32 summation resolution — and actually
+    moved the leaves (parity of two no-ops would prove nothing)."""
+    ms = _train_model_str(monkeypatch, strategy)
+    orig, dev, host = _refit_both_paths(ms, monkeypatch)
+    moved = 0.0
+    for o, d, h in zip(orig, dev, host):
+        np.testing.assert_allclose(d, h, rtol=1e-5, atol=1e-6)
+        moved += float(np.abs(d - o).max())
+    assert moved > 1e-6, "refit did not change any leaf value"
+
+
+def test_device_host_refit_parity_quantized(monkeypatch):
+    """Quantized-gradient training feeds the same refit tail; parity
+    must hold for a model grown in the integer histogram domain."""
+    ms = _train_model_str(monkeypatch, "compact",
+                          extra={"quantized_grad": True}, seed=5)
+    _, dev, host = _refit_both_paths(ms, monkeypatch, decay=0.0, seed=17)
+    for d, h in zip(dev, host):
+        np.testing.assert_allclose(d, h, rtol=1e-5, atol=1e-6)
+
+
+def test_refit_is_one_dispatch(monkeypatch):
+    """The whole-ensemble refit makes exactly ONE leaf-stats dispatch
+    (counter-asserted); the host escape hatch makes none."""
+    ms = _train_model_str(monkeypatch, "masked", seed=9)
+    x2, y2 = make_binary(n=150, f=8, seed=21)
+    before = telem_counters.get("continual_refit_dispatches")
+    lgb.Booster(model_str=ms).refit(x2, y2, decay_rate=0.5)
+    assert telem_counters.get("continual_refit_dispatches") == before + 1
+    monkeypatch.setenv("LGBM_TPU_HOST_REFIT", "1")
+    lgb.Booster(model_str=ms).refit(x2, y2, decay_rate=0.5)
+    assert telem_counters.get("continual_refit_dispatches") == before + 1
+
+
+class _StubTree:
+    def __init__(self, values):
+        self.leaf_value = np.asarray(values, dtype=np.float64)
+        self.num_leaves = len(values)
+
+    def set_leaf_output(self, leaf, value):
+        self.leaf_value[leaf] = value
+
+
+def test_apply_leaf_values_formula_and_empty_leaf():
+    """Host finish arithmetic: l1 soft-threshold, max_delta_step clip,
+    decay blend — and a leaf with count 0 keeps its old value."""
+    tree = _StubTree([0.5, -2.0, 3.0])
+    stats = np.zeros((1, 3, 3), dtype=np.float32)
+    stats[0, 0] = (-4.0, 2.0, 10.0)    # plain update
+    stats[0, 1] = (0.0, 0.0, 0.0)      # empty: untouched
+    stats[0, 2] = (0.5, 1.0, 4.0)      # |grad| under l1: thresholds to 0
+    crefit.apply_leaf_values(
+        [tree], stats, lambda_l1=1.0, lambda_l2=1.0, max_delta_step=0.8,
+        decay_rate=0.25, shrinkage_rate=0.1)
+    # leaf 0: out = -(−4 ⊣ l1=1)/(2+1) = 3/3 = 1.0, clipped to 0.8
+    assert tree.leaf_value[0] == pytest.approx(0.25 * 0.5
+                                               + 0.75 * 0.8 * 0.1)
+    assert tree.leaf_value[1] == -2.0
+    # leaf 2: |0.5| <= l1 → out 0
+    assert tree.leaf_value[2] == pytest.approx(0.25 * 3.0)
+
+
+def test_sharded_leaf_stats_sum_matches_full():
+    """Row-sharded contract: per-shard leaf stats from the same program
+    SUM to the full-data stats, so a psum over ranks reproduces the
+    single-rank refit. reduce_stats is the identity off-cluster."""
+    rng = np.random.RandomState(0)
+    n, trees, leaves, k = 64, 6, 8, 2
+    leaf_preds = rng.randint(0, leaves, size=(n, trees)).astype(np.int32)
+    grad = rng.randn(k, n).astype(np.float32)
+    hess = (rng.rand(k, n) + 0.1).astype(np.float32)
+    full = crefit.leaf_stats(leaf_preds, grad, hess,
+                             num_tree_per_iteration=k, max_leaves=leaves)
+    assert full.shape == (trees, leaves, 3)
+    cut = 40
+    parts = [
+        crefit.leaf_stats(leaf_preds[:cut], grad[:, :cut], hess[:, :cut],
+                          num_tree_per_iteration=k, max_leaves=leaves),
+        crefit.leaf_stats(leaf_preds[cut:], grad[:, cut:], hess[:, cut:],
+                          num_tree_per_iteration=k, max_leaves=leaves),
+    ]
+    np.testing.assert_allclose(parts[0] + parts[1], full,
+                               rtol=1e-5, atol=1e-5)
+    # counts land exactly: every row routed once per tree
+    np.testing.assert_allclose(
+        full[:, :, crefit.STAT_COUNT].sum(axis=1), np.full(trees, n))
+    assert crefit.reduce_stats(full) is full

@@ -447,10 +447,16 @@ def test_run_report_skips_torn_bundles(tmp_path, monkeypatch):
 # invariance + overhead with the full deep-trace stack on
 
 
+@pytest.mark.slow
 def test_trace_mode_overhead_under_2pct(tmp_path, monkeypatch):
     """Warm-jit A/B on ONE booster: trace mode (span ring + events +
     recorder) vs everything off. Same <2%-or-<2ms gate as the events
-    guard."""
+    guard, taken over the median of 3 timing windows per arm — single
+    windows flake on shared-host weather (2/3 failures on an unchanged
+    baseline), and a wall-clock A/B has no place in the functional
+    tier either way, so it rides the slow tier with the other
+    perf-floor gates."""
+    import statistics
     monkeypatch.delenv("LGBM_TPU_XLA_TRACE", raising=False)
     x, y = make_binary(n=2000, f=10, seed=5)
     bst = lgb.Booster({"objective": "binary", "num_leaves": 15,
@@ -468,10 +474,10 @@ def test_trace_mode_overhead_under_2pct(tmp_path, monkeypatch):
     _ = bst._gbdt.models
     k = 5
     telemetry.set_mode("off")
-    t_off = min(timed(k), timed(k))
+    t_off = statistics.median(timed(k) for _ in range(3))
     telemetry.set_mode("trace")
     timed(1)                            # burn-in after the flip
-    t_on = min(timed(k), timed(k))
+    t_on = statistics.median(timed(k) for _ in range(3))
     assert spans.events(), "trace mode recorded no spans"
     overhead = (t_on - t_off) / t_off
     assert overhead < 0.02 or (t_on - t_off) < 2e-3, (

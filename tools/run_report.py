@@ -242,6 +242,8 @@ def summarize(path: str) -> dict:
     drift_fires = [e for e in others if e["kind"] == "drift"]
     router_log = [e for e in others
                   if e["kind"].startswith("router_")]
+    continual_log = [e for e in others
+                     if e["kind"].startswith("continual_")]
 
     # critical path: the bundle's file wins; else accumulate the rows
     # the fleet aggregation events carried
@@ -267,6 +269,7 @@ def summarize(path: str) -> dict:
         "serve_versions": serve_versions,
         "drift_fires": drift_fires,
         "router_log": router_log,
+        "continual_log": continual_log,
         "critical_path": critical_path,
         "bundle": bundle_manifest,
         "bundles_index": bundles_index,
@@ -397,7 +400,7 @@ def render(summary: dict) -> str:
         w("")
 
     if summary["serve_versions"] or summary["drift_fires"] \
-            or summary["router_log"]:
+            or summary["router_log"] or summary.get("continual_log"):
         w("## Serving")
         w("")
         if summary["serve_versions"]:
@@ -423,6 +426,24 @@ def render(summary: dict) -> str:
                 w(f"| {e.get('ts', t0) - t0:+.3f} | {e.get('version')} "
                   f"| {e.get('worst')} | {e.get('psi', 0):.4f} "
                   f"| {e.get('threshold', 0):g} | {e.get('rows')} |")
+            w("")
+        if summary.get("continual_log"):
+            # the closed continual-learning loop's episode trail:
+            # fire -> retrain -> deploy -> promote/rollback
+            w("### Continual episodes")
+            w("")
+            t0 = min(e.get("ts", 0.0) for e in summary["continual_log"])
+            w("| t+s | step | episode | action | version | detail |")
+            w("|---|---|---|---|---|---|")
+            for e in summary["continual_log"]:
+                detail = ", ".join(
+                    f"{k}={v}" for k, v in sorted(e.items())
+                    if k not in ("kind", "ts", "seq", "episode",
+                                 "action", "version"))
+                w(f"| {e.get('ts', t0) - t0:+.3f} "
+                  f"| {e['kind'][len('continual_'):]} "
+                  f"| {e.get('episode', '')} | {e.get('action', '')} "
+                  f"| {e.get('version', '')} | {detail} |")
             w("")
         if summary["router_log"]:
             w("### Router decisions")
